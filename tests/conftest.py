@@ -1,0 +1,27 @@
+"""Test harness setup.
+
+Force jax onto a virtual 8-device CPU platform BEFORE jax is imported
+anywhere, per SURVEY.md §4 "TPU build translation": multi-device logic is
+tested with ``--xla_force_host_platform_device_count=8`` (the honest
+analogue of the reference's fake-transport distributed tests), and the
+real-TPU path is exercised by ``bench.py`` / the driver instead.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return numpy.random.Generator(numpy.random.PCG64(1234))

@@ -1,0 +1,212 @@
+"""Tests for config tree, mutable Bools, unit graph, and workflow driver
+(SURVEY.md §2.1 components; mechanisms per §4)."""
+
+import io
+
+import numpy
+import pytest
+
+from veles.config import Config, Tune, root
+from veles.mutable import Bool, LinkableAttribute
+from veles.units import Unit, TrivialUnit
+from veles.workflow import Workflow
+from veles import prng
+
+
+# -- config ----------------------------------------------------------------
+
+def test_config_autovivify_and_update():
+    cfg = Config("test")
+    cfg.a.b.c = 3
+    assert cfg.a.b.c == 3
+    cfg.update({"a": {"b": {"d": 4}, "e": "x"}})
+    assert cfg.a.b.c == 3 and cfg.a.b.d == 4 and cfg.a.e == "x"
+    assert cfg.flatten() == {"a.b.c": 3, "a.b.d": 4, "a.e": "x"}
+
+
+def test_config_override_literals_and_strings():
+    cfg = Config("root")
+    cfg.apply_override("root.x.y=10")
+    cfg.apply_override("x.z=[1, 2]")
+    cfg.apply_override("x.name=hello world")
+    assert cfg.x.y == 10
+    assert cfg.x.z == [1, 2]
+    assert cfg.x.name == "hello world"
+    with pytest.raises(ValueError):
+        cfg.apply_override("nonsense")
+
+
+def test_tune_resolution_and_collection():
+    cfg = Config("test")
+    cfg.lr = Tune(0.01, 0.0001, 0.1)
+    cfg.layers.n = Tune(2, 1, 5)
+    assert cfg.lr == 0.01            # reads resolve to default
+    assert cfg.layers.n == 2
+    tunables = cfg.tunables()
+    assert set(tunables) == {"lr", "layers.n"}
+    assert tunables["layers.n"].discrete
+    assert tunables["lr"].clip(5.0) == 0.1
+
+
+def test_root_common_defaults_exist():
+    assert root.common.engine.backend in ("xla", "numpy")
+    assert isinstance(root.common.dirs.cache, str)
+
+
+# -- mutable ---------------------------------------------------------------
+
+def test_bool_algebra_is_live():
+    a, b = Bool(False), Bool(True)
+    both = a & b
+    either = a | b
+    neither = ~(a | b)
+    assert not both and either and not neither
+    a << True
+    assert both
+    b << False
+    a << False
+    assert neither
+    with pytest.raises(ValueError):
+        both << True  # derived bools are read-only
+
+
+def test_linkable_attribute_aliases_and_breaks_on_write():
+    class Src:
+        pass
+
+    class Dst:
+        pass
+
+    src, dst = Src(), Dst()
+    src.output = 42
+    LinkableAttribute.install(dst, "input", src, "output")
+    assert dst.input == 42
+    src.output = 43
+    assert dst.input == 43
+    dst.input = 7          # one-way link: write breaks the alias
+    assert dst.input == 7 and src.output == 43
+
+
+# -- unit graph ------------------------------------------------------------
+
+class Recorder(Unit):
+    log_list = None
+
+    def run(self):
+        self.log_list.append(self.name)
+
+
+def _make_chain(wf, names, log):
+    units = []
+    prev = wf.start_point
+    for name in names:
+        u = Recorder(wf, name=name)
+        u.log_list = log
+        u.link_from(prev)
+        prev = u
+        units.append(u)
+    wf.end_point.link_from(prev)
+    return units
+
+
+def test_linear_workflow_runs_in_order():
+    wf = Workflow(name="wf")
+    log = []
+    _make_chain(wf, ["a", "b", "c"], log)
+    wf.initialize()
+    wf.run()
+    assert log == ["a", "b", "c"]
+    assert wf.end_point.reached
+
+
+def test_gate_skip_propagates_gate_block_stops():
+    wf = Workflow(name="wf")
+    log = []
+    a, b, c = _make_chain(wf, ["a", "b", "c"], log)
+    b.gate_skip << True
+    wf.initialize()
+    wf.run()
+    assert log == ["a", "c"]          # b skipped but propagated
+    log.clear()
+    b.gate_skip << False
+    b.gate_block << True
+    wf.run()
+    assert log == ["a"]               # blocked: nothing downstream
+    assert not wf.end_point.reached
+
+
+def test_cycle_runs_until_gate_opens():
+    """The training-loop shape: a repeater-headed cycle gated into the
+    end point (SURVEY.md §1: loader → ... → gd → repeater → loader until
+    decision.complete)."""
+    from veles.units import Repeater
+
+    wf = Workflow(name="loop")
+    done = Bool(False)
+
+    class Counter(Unit):
+        count = 0
+
+        def run(self):
+            self.count += 1
+            if self.count >= 5:
+                done << True
+
+    rep = Repeater(wf, name="repeater")
+    c = Counter(wf, name="counter")
+    rep.link_from(wf.start_point)
+    c.link_from(rep)
+    rep.link_from(c)                  # the back edge closing the cycle
+    wf.end_point.link_from(c)
+    wf.end_point.gate_block = ~done
+    wf.initialize()
+    wf.run()
+    assert c.count == 5
+    assert wf.end_point.reached
+
+
+def test_fan_in_waits_for_all_open_links():
+    wf = Workflow(name="fanin")
+    log = []
+    a = Recorder(wf, name="a")
+    b = Recorder(wf, name="b")
+    c = Recorder(wf, name="c")
+    for u in (a, b, c):
+        u.log_list = log
+    a.link_from(wf.start_point)
+    b.link_from(wf.start_point)
+    c.link_from(a, b)
+    wf.end_point.link_from(c)
+    wf.initialize()
+    wf.run()
+    assert log.index("c") > log.index("a")
+    assert log.index("c") > log.index("b")
+    assert log.count("c") == 1
+
+
+def test_graph_dump_and_stats():
+    wf = Workflow(name="wf")
+    log = []
+    _make_chain(wf, ["a", "b"], log)
+    wf.initialize()
+    wf.run()
+    dot = wf.generate_graph()
+    assert "digraph" in dot and '"a' in dot
+    buf = io.StringIO()
+    wf.print_stats(buf)
+    assert "a" in buf.getvalue()
+
+
+# -- prng ------------------------------------------------------------------
+
+def test_prng_registry_deterministic():
+    g1 = prng.get("t1")
+    a = g1.uniform(-1, 1, (4,))
+    g1.seed(g1.state_seed)
+    b = g1.uniform(-1, 1, (4,))
+    numpy.testing.assert_array_equal(a, b)
+    prng.seed_all(99)
+    c = prng.get("t1").uniform(-1, 1, (4,))
+    prng.seed_all(99)
+    d = prng.get("t1").uniform(-1, 1, (4,))
+    numpy.testing.assert_array_equal(c, d)

@@ -1,0 +1,18 @@
+"""znicz-tpu: a TPU-native rebuild of the VELES/Znicz platform.
+
+Package layout mirrors the reference's layering (SURVEY.md §1):
+
+* ``veles.*``           — core runtime (units, workflow, config, memory,
+  backends, prng, loader, distribution, launcher, snapshotter).
+* ``veles.parallel``    — device mesh / sharding / collectives (the ICI
+  replacement for the reference's ZeroMQ master↔slave layer).
+* ``veles.znicz_tpu``   — the neural-network plugin: ops, unit pairs,
+  StandardWorkflow, models/samples.
+"""
+
+__version__ = "0.1.0"
+
+from veles.config import root, Config, Tune  # noqa: F401
+from veles.mutable import Bool               # noqa: F401
+from veles.units import Unit, TrivialUnit    # noqa: F401
+from veles.workflow import Workflow          # noqa: F401

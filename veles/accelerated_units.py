@@ -1,0 +1,290 @@
+"""Accelerated units and the graph→jit step compiler.
+
+Re-design of ``veles/accelerated_units.py`` [U] (SURVEY.md §2.1
+"Accelerated unit", §7 design stance). The reference dispatched each
+unit's ``run`` to ``numpy_run`` / ``ocl_run`` / ``cuda_run`` and launched
+one or more hand-written kernels per unit, with host↔device map/unmap
+around every launch (§3.2 "Boundary crossings"). The TPU build keeps the
+per-unit ``numpy_run`` oracle but replaces the per-unit kernel launches
+wholesale: every accelerated unit additionally implements
+
+* ``xla_init()`` — declare parameters/optimizer state (host-side numpy
+  values living in its ``Array`` attrs, as the oracle path uses), and
+* ``xla_run(ctx)`` — a **pure, jax-traceable** function that reads its
+  inputs from a :class:`FlowContext` and writes its outputs back.
+
+:class:`StepCompiler` walks the accelerated subgraph once, calls each
+``xla_run`` under ``jax.jit`` tracing, and produces a single fused step
+function ``step(params, state, batch, hyper) -> (params, state, outputs)``
+— the entire forward/backward/update cycle is ONE XLA computation with
+donated buffers, which is what makes this design TPU-native rather than
+a port (SURVEY.md §3.2: the reference's per-unit launch overhead is
+eliminated by construction).
+"""
+
+from collections import OrderedDict
+
+import numpy
+
+from veles.backends import Device, NumpyDevice, XLADevice, get_device
+from veles.memory import Array
+from veles.units import Unit
+from veles.workflow import Workflow
+
+
+class AcceleratedUnit(Unit):
+    """A unit with a numpy oracle and a pure-jax implementation."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.device = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(**kwargs)
+        if device is not None:
+            self.device = device
+        elif self.device is None and self.workflow is not None:
+            self.device = getattr(self.workflow, "device", None)
+
+    def init_vectors(self, *arrays):
+        """Reference helper: ensure Arrays are allocated [U]."""
+        for arr in arrays:
+            if isinstance(arr, Array) and arr:
+                arr.map_write()
+
+    # -- backend dispatch ---------------------------------------------
+
+    def run(self):
+        """Host-graph execution path: oracle only. The XLA path never
+        runs units one-by-one — it executes the compiled step (see
+        AcceleratedWorkflow.run_step)."""
+        self.numpy_run()
+
+    def numpy_run(self):
+        raise NotImplementedError(
+            "%s lacks numpy_run" % type(self).__name__)
+
+    # -- XLA contract --------------------------------------------------
+
+    #: Names of Array attrs holding trainable parameters; the compiler
+    #: lifts them into the params pytree keyed by unit name.
+    PARAMS = ()
+    #: Names of Array attrs holding mutable non-trainable state
+    #: (momentum accumulators, running stats); lifted into state pytree.
+    STATE = ()
+
+    def xla_init(self):
+        """Prepare parameter/state Arrays (defaults to nothing)."""
+
+    def xla_run(self, ctx):
+        """Pure traced computation; read/write via ctx."""
+        raise NotImplementedError(
+            "%s lacks xla_run" % type(self).__name__)
+
+    # -- pytree lift/sink ---------------------------------------------
+
+    def export_params(self):
+        return {name: numpy.asarray(getattr(self, name).mem)
+                for name in self.PARAMS
+                if isinstance(getattr(self, name, None), Array)
+                and getattr(self, name)}
+
+    def export_state(self):
+        return {name: numpy.asarray(getattr(self, name).mem)
+                for name in self.STATE
+                if isinstance(getattr(self, name, None), Array)
+                and getattr(self, name)}
+
+    def import_params(self, tree):
+        for name, value in tree.items():
+            arr = getattr(self, name, None)
+            if isinstance(arr, Array):
+                arr.map_write()
+                arr.mem = numpy.asarray(value, dtype=arr.dtype
+                                        if arr else None)
+
+
+class FlowContext:
+    """The tracing context handed to each unit's ``xla_run``.
+
+    Holds named tensors produced so far plus this unit's view of the
+    params/state pytrees and the PRNG key / train flag. Units read
+    inputs (resolved through link_attrs wiring by the unit itself) and
+    ``set`` their outputs.
+    """
+
+    def __init__(self, compiler, params, state, hyper, key, train):
+        self._compiler = compiler
+        self.params = params        # full dict: unit name -> {attr: arr}
+        self.state = state
+        self.hyper = hyper          # dict of scalar hyperparams (lr, ...)
+        self.key = key              # jax PRNG key folded per unit
+        self.train = train          # python bool: compile-time variant
+        self.values = {}            # (producer_unit_name, attr) -> tensor
+        self.outputs = {}           # exported outputs (metrics etc.)
+
+    # value routing ----------------------------------------------------
+
+    def get(self, unit, attr):
+        """Value of ``unit.attr``: a traced tensor if some xla_run
+        produced it this trace, else the unit's host Array content as a
+        constant (weights come from params instead)."""
+        key = (unit.name, attr)
+        if key in self.values:
+            return self.values[key]
+        # Follow link_attrs aliasing: reading a linked attr returns the
+        # source object's value; find the real producer.
+        src, src_attr = _resolve_link(unit, attr)
+        key2 = (src.name, src_attr)
+        if key2 in self.values:
+            return self.values[key2]
+        value = getattr(src, src_attr, None)
+        if isinstance(value, Array):
+            if not value:
+                raise ValueError("unset Array %s.%s read during trace"
+                                 % (src.name, src_attr))
+            return value.devmem
+        return value
+
+    def set(self, unit, attr, tensor):
+        self.values[(unit.name, attr)] = tensor
+        # Mirror through any alias chain start as well.
+        src, src_attr = _resolve_link(unit, attr)
+        self.values[(src.name, src_attr)] = tensor
+
+    # params/state ------------------------------------------------------
+
+    def unit_params(self, unit):
+        return self.params.get(unit.name, {})
+
+    def unit_state(self, unit):
+        return self.state.get(unit.name, {})
+
+    def update_params(self, unit, **kv):
+        self.params.setdefault(unit.name, {}).update(kv)
+
+    def update_state(self, unit, **kv):
+        self.state.setdefault(unit.name, {}).update(kv)
+
+    def fold_key(self, unit):
+        """A per-unit PRNG key, stable across steps via the step key."""
+        import jax
+        import zlib
+        h = zlib.crc32(unit.name.encode()) & 0x7FFFFFFF
+        return jax.random.fold_in(self.key, h)
+
+    def export(self, name, tensor):
+        """Expose a tensor in the step outputs (metrics, err counts)."""
+        self.outputs[name] = tensor
+
+
+def _resolve_link(unit, attr):
+    """Follow LinkableAttribute aliases to the producing (unit, attr)."""
+    from veles.mutable import LinkableAttribute
+    seen = set()
+    while True:
+        if (id(unit), attr) in seen:
+            return unit, attr
+        seen.add((id(unit), attr))
+        descr = type(unit).__dict__.get(attr)
+        if isinstance(descr, LinkableAttribute):
+            link = unit.__dict__.get("_linked_" + attr)
+            if link is not None:
+                unit, attr = link[0], link[1]
+                continue
+        return unit, attr
+
+
+class StepCompiler:
+    """Trace an ordered list of accelerated units into one jitted step.
+
+    ``order`` is the execution order of the accelerated cycle body
+    (forwards → evaluator → gds), excluding host-side units (loader,
+    decision, plotters) — exactly the partition SURVEY.md §7 stage 2
+    prescribes.
+    """
+
+    def __init__(self, units, device: XLADevice, donate=True):
+        self.units = list(units)
+        self.device = device
+        self.donate = donate
+        self._compiled = {}
+
+    # pytree assembly ---------------------------------------------------
+
+    def gather_params(self):
+        return {u.name: u.export_params() for u in self.units
+                if u.export_params()}
+
+    def gather_state(self):
+        return {u.name: u.export_state() for u in self.units
+                if u.export_state()}
+
+    def scatter_params(self, params):
+        for u in self.units:
+            if u.name in params:
+                u.import_params(params[u.name])
+
+    def scatter_device_params(self, params):
+        """Keep device values resident: mark unit Arrays device-dirty
+        without a host round-trip."""
+        for u in self.units:
+            tree = params.get(u.name)
+            if not tree:
+                continue
+            for attr, value in tree.items():
+                arr = getattr(u, attr, None)
+                if isinstance(arr, Array):
+                    arr.set_device_value(value)
+
+    # compilation -------------------------------------------------------
+
+    def build_step(self, batch_spec, train=True):
+        """Return ``step(params, state, batch, hyper, key)``.
+
+        ``batch_spec``: dict name -> (unit, attr) describing which unit
+        attrs the batch tensors feed (e.g. the loader's minibatch).
+        """
+        import jax
+
+        units = self.units
+
+        def step(params, state, batch, hyper, key):
+            ctx = FlowContext(self, dict(params), dict(state), hyper,
+                              key, train)
+            for name, (unit, attr) in batch_spec.items():
+                ctx.set(unit, attr, batch[name])
+            for unit in units:
+                if not train and getattr(unit, "train_only", False):
+                    continue
+                unit.xla_run(ctx)
+            return ctx.params, ctx.state, ctx.outputs
+
+        donate = (0, 1) if (self.donate and train) else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def compile(self, batch_spec, train=True):
+        key = (tuple(sorted((name, unit.name, attr)
+                            for name, (unit, attr) in batch_spec.items())),
+               train)
+        if key not in self._compiled:
+            self._compiled[key] = self.build_step(batch_spec, train=train)
+        return self._compiled[key]
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow owning a Device (reference ``AcceleratedWorkflow`` [U])."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.device = None
+
+    def initialize(self, device=None, **kwargs):
+        self.device = get_device(device)
+        return super().initialize(device=self.device, **kwargs)
+
+    @property
+    def on_xla(self):
+        return self.device is not None and self.device.is_xla

@@ -1,0 +1,112 @@
+"""Device backends.
+
+Re-design of ``veles/backends.py`` [U] (SURVEY.md §2.1 "Device
+backends"). The reference enumerated OpenCL/CUDA devices and kept a
+per-device tuned BLOCK_SIZE database for its hand-written kernels. On
+TPU, XLA owns tiling/autotuning, so a Device here is much thinner:
+
+* :class:`NumpyDevice` — the oracle backend; all ``numpy_run`` paths.
+* :class:`XLADevice` — wraps the jax device set (TPU chips, or CPU when
+  ``JAX_PLATFORMS=cpu``), owns the default :class:`jax.sharding.Mesh`,
+  precision policy (bfloat16 matmuls on the MXU, float32 params), and
+  the compile cache directory (the reference cached compiled kernels on
+  disk; jax's persistent compilation cache is the analogue).
+
+Device selection mirrors ``velescli -d``: ``"numpy"`` forces the oracle,
+``"xla"`` / ``"tpu"`` / ``"cpu"`` pick jax platforms.
+"""
+
+import os
+
+import numpy
+
+from veles.config import root
+from veles.logger import Logger
+
+
+class Device(Logger):
+    backend_name = "abstract"
+
+    #: True when jax is the execution engine.
+    is_xla = False
+
+    def __init__(self):
+        self.name = type(self).__name__
+
+    @property
+    def exists(self):
+        return True
+
+    def __repr__(self):
+        return "<%s>" % self.backend_name
+
+
+class NumpyDevice(Device):
+    """Pure-numpy oracle backend (reference ``NumpyDevice`` [U])."""
+
+    backend_name = "numpy"
+
+    def __init__(self, dtype=numpy.float32):
+        super().__init__()
+        self.dtype = numpy.dtype(dtype)
+
+
+class XLADevice(Device):
+    """JAX/XLA execution: TPU when available, CPU otherwise.
+
+    The whole forward/backward/update cycle compiles into one program
+    (SURVEY.md §7 design stance) so, unlike the reference's per-kernel
+    device state, this object mostly carries policy: dtypes, the mesh,
+    and donation settings.
+    """
+
+    backend_name = "xla"
+    is_xla = True
+
+    def __init__(self, platform=None, mesh=None,
+                 compute_dtype=None, param_dtype=None):
+        super().__init__()
+        import jax
+        self._jax = jax
+        if platform:
+            devices = jax.devices(platform)
+        else:
+            devices = jax.devices()
+        self.jax_devices = devices
+        self.platform = devices[0].platform
+        self.mesh = mesh  # set up lazily / by veles.parallel
+        # bfloat16 matmuls feed the MXU at full rate; params stay f32.
+        import jax.numpy as jnp
+        self.compute_dtype = compute_dtype or (
+            jnp.bfloat16 if self.platform == "tpu" else jnp.float32)
+        self.param_dtype = param_dtype or jnp.float32
+        cache_dir = os.path.join(root.common.dirs.cache, "xla")
+        os.makedirs(cache_dir, exist_ok=True)
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except Exception:  # pragma: no cover - older jax
+            pass
+
+    @property
+    def device_count(self):
+        return len(self.jax_devices)
+
+    def __repr__(self):
+        return "<xla:%s x%d>" % (self.platform, self.device_count)
+
+
+def get_device(spec=None) -> Device:
+    """Build a Device from a CLI-ish spec.
+
+    ``None`` → config default (``root.common.engine.backend``);
+    ``"numpy"`` → oracle; ``"xla"`` → default jax platform;
+    ``"tpu"``/``"cpu"`` → that jax platform.
+    """
+    if isinstance(spec, Device):
+        return spec
+    spec = spec or root.common.engine.backend
+    if spec == "numpy":
+        return NumpyDevice()
+    if spec in ("xla", None):
+        return XLADevice()
+    return XLADevice(platform=spec)

@@ -1,0 +1,219 @@
+"""Global mutable configuration tree.
+
+TPU-native re-design of the VELES config system (reference:
+``veles/config.py`` [U] per SURVEY.md §0 — reference mount empty, upstream
+layout reconstructed; see SURVEY.md §2.1 "Config").
+
+Semantics preserved from the reference:
+
+* a process-global tree ``root`` with attribute access (``root.mnist.lr``);
+* sub-trees auto-vivify on attribute access, so python config files can
+  freely write ``root.my_workflow.decision.max_epochs = 3``;
+* ``Config.update(dict)`` deep-merges nested dicts;
+* CLI dot-path overrides (``root.a.b=3`` with python-literal values);
+* ``Tune(default, min, max)`` wrappers marking leaves searchable by the
+  genetic optimizer (SURVEY.md §2.7 "Genetics");
+* pretty-printing of the effective config.
+"""
+
+import ast
+from typing import Any, Dict, Iterator, Tuple
+
+
+class Tune:
+    """A config leaf marked as tunable by the genetic optimizer.
+
+    Behaves like its ``default`` value for normal reads (via
+    :meth:`Config.get` resolution), while carrying the search interval.
+    Mirrors ``veles.genetics.Tune`` [U].
+    """
+
+    __slots__ = ("default", "min_value", "max_value", "discrete")
+
+    def __init__(self, default, min_value, max_value, discrete=None):
+        self.default = default
+        self.min_value = min_value
+        self.max_value = max_value
+        # Discrete if endpoints are ints and default is an int.
+        if discrete is None:
+            discrete = all(
+                isinstance(v, int) and not isinstance(v, bool)
+                for v in (default, min_value, max_value))
+        self.discrete = discrete
+
+    def clip(self, value):
+        value = max(self.min_value, min(self.max_value, value))
+        if self.discrete:
+            value = int(round(value))
+        return value
+
+    def __repr__(self):
+        return ("Tune(%r, %r, %r)"
+                % (self.default, self.min_value, self.max_value))
+
+
+def _resolve(value):
+    return value.default if isinstance(value, Tune) else value
+
+
+class Config:
+    """A node in the global config tree.
+
+    Attribute reads on missing names auto-vivify child :class:`Config`
+    nodes (so config files can assign deep paths without boilerplate);
+    attribute writes store leaves verbatim (including :class:`Tune`).
+    """
+
+    def __init__(self, path: str):
+        # Use object.__setattr__ to dodge our own __setattr__.
+        object.__setattr__(self, "_path", path)
+        object.__setattr__(self, "_items", {})
+
+    # -- tree access --------------------------------------------------
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        items = object.__getattribute__(self, "_items")
+        if name not in items:
+            child = Config("%s.%s" % (self._path, name))
+            items[name] = child
+        return _resolve(items[name])
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, dict):
+            node = Config("%s.%s" % (self._path, name))
+            node.update(value)
+            value = node
+        object.__getattribute__(self, "_items")[name] = value
+
+    def __delattr__(self, name: str) -> None:
+        object.__getattribute__(self, "_items").pop(name, None)
+
+    def __contains__(self, name: str) -> bool:
+        return name in object.__getattribute__(self, "_items")
+
+    def get(self, name: str, default: Any = None) -> Any:
+        items = object.__getattribute__(self, "_items")
+        if name in items:
+            return _resolve(items[name])
+        return default
+
+    def raw(self, name: str) -> Any:
+        """Return the stored leaf without Tune resolution."""
+        return object.__getattribute__(self, "_items")[name]
+
+    # -- bulk update --------------------------------------------------
+
+    def update(self, tree: Dict[str, Any]) -> "Config":
+        """Deep-merge a nested dict into this node (reference
+        ``Config.update`` [U])."""
+        for key, value in tree.items():
+            if isinstance(value, dict):
+                child = getattr(self, key)
+                if not isinstance(child, Config):
+                    child = Config("%s.%s" % (self._path, key))
+                    object.__getattribute__(self, "_items")[key] = child
+                child.update(value)
+            else:
+                setattr(self, key, value)
+        return self
+
+    # -- CLI dot-path overrides --------------------------------------
+
+    def apply_override(self, assignment: str) -> None:
+        """Apply one ``a.b.c=value`` override (value is a python literal;
+        bare words fall back to strings). The leading ``root.`` is
+        optional, matching ``velescli.py`` behaviour [U]."""
+        path, _, literal = assignment.partition("=")
+        if not _:
+            raise ValueError("override must look like path=value: %r"
+                             % assignment)
+        parts = path.strip().split(".")
+        if parts and parts[0] in ("root", self._path.split(".")[0]):
+            parts = parts[1:]
+        if not parts or any(not p.isidentifier() for p in parts):
+            raise ValueError("bad override path in %r" % assignment)
+        node = self
+        for part in parts[:-1]:
+            nxt = getattr(node, part)
+            if not isinstance(nxt, Config):
+                nxt = Config("%s.%s" % (node._path, part))
+                object.__getattribute__(node, "_items")[part] = nxt
+            node = nxt
+        try:
+            value = ast.literal_eval(literal.strip())
+        except (ValueError, SyntaxError):
+            value = literal.strip()
+        setattr(node, parts[-1], value)
+
+    # -- introspection ------------------------------------------------
+
+    def items(self) -> Iterator[Tuple[str, Any]]:
+        return iter(object.__getattribute__(self, "_items").items())
+
+    def flatten(self, prefix: str = "") -> Dict[str, Any]:
+        out = {}
+        for key, value in self.items():
+            full = "%s.%s" % (prefix, key) if prefix else key
+            if isinstance(value, Config):
+                out.update(value.flatten(full))
+            else:
+                out[full] = value
+        return out
+
+    def tunables(self, prefix: str = "") -> Dict[str, Tune]:
+        """All Tune leaves under this node, keyed by dotted path."""
+        return {k: v for k, v in self.flatten(prefix).items()
+                if isinstance(v, Tune)}
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {}
+        for key, value in self.items():
+            out[key] = value.to_dict() if isinstance(value, Config) \
+                else _resolve(value)
+        return out
+
+    def print_config(self, indent: int = 0, stream=None) -> str:
+        lines = []
+
+        def rec(node, depth):
+            for key, value in sorted(node.items()):
+                pad = "  " * depth
+                if isinstance(value, Config):
+                    lines.append("%s%s:" % (pad, key))
+                    rec(value, depth + 1)
+                else:
+                    lines.append("%s%s: %r" % (pad, key, value))
+
+        rec(self, indent)
+        text = "\n".join(lines)
+        if stream is not None:
+            stream.write(text + "\n")
+        return text
+
+    def __repr__(self):
+        return "<Config %s: %d item(s)>" % (
+            self._path, len(object.__getattribute__(self, "_items")))
+
+
+#: The process-global config tree every workflow/config file mutates,
+#: mirroring ``veles.config.root`` [U].
+root = Config("root")
+
+# Defaults under root.common, as in the reference (cache/data dirs,
+# backend selection; SURVEY.md §2.1).
+root.common.update({
+    "dirs": {
+        "cache": "/tmp/znicz_tpu/cache",
+        "datasets": "/tmp/znicz_tpu/datasets",
+        "snapshots": "/tmp/znicz_tpu/snapshots",
+    },
+    "engine": {
+        "backend": "xla",       # "xla" | "numpy"
+        "precision": "float32",  # oracle dtype; TPU path uses bfloat16 matmuls
+    },
+})

@@ -1,0 +1,83 @@
+"""Distribution contract per unit.
+
+Re-design of ``veles/distributable.py`` [U] (SURVEY.md §2.2). In the
+reference, master↔slave data exchange is expressed per unit through the
+``IDistributable`` hooks and carried over ZeroMQ. In the TPU build the
+*hot path* (gradient averaging) is a ``psum`` inside the jitted step
+(see ``veles/parallel``), but the hook API survives as a thin layer:
+
+* tests exercise master/slave merge logic without a cluster (SURVEY.md
+  §4 "Distributed tests");
+* checkpoint/elasticity tooling uses the same hooks to ship state;
+* host-side units (Loader index assignment, Decision aggregation) keep
+  their reference semantics under multi-process launches.
+"""
+
+
+class IDistributable:
+    """Interface (duck-typed): units override any subset."""
+
+    #: True when the unit has state to exchange.
+    negotiates_on_connect = False
+
+    def generate_data_for_slave(self, slave=None):
+        """Master: produce the payload shipped to ``slave`` before its
+        next iteration (e.g. fresh weights, minibatch index ranges)."""
+        return None
+
+    def apply_data_from_master(self, data):
+        """Slave: ingest the master payload."""
+
+    def generate_data_for_master(self):
+        """Slave: produce the update payload (e.g. weight deltas,
+        evaluation counters)."""
+        return None
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Master: merge a slave update (e.g. parameter averaging)."""
+
+    def drop_slave(self, slave=None):
+        """Master: a slave died — requeue its in-flight work."""
+
+
+class TriviallyDistributable(IDistributable):
+    """No-op mixin for units with nothing to exchange [U]."""
+
+
+class DistributionRegistry:
+    """Collects the distributable units of a workflow and runs the
+    master/slave exchange round-trips over them (in-process transport;
+    the wire transport lives in ``veles/server.py``/``client.py``)."""
+
+    def __init__(self, workflow):
+        self.workflow = workflow
+
+    def units(self):
+        for unit in self.workflow:
+            if isinstance(unit, IDistributable):
+                yield unit
+
+    def generate_job(self, slave=None):
+        return {unit.name: unit.generate_data_for_slave(slave)
+                for unit in self.workflow
+                if isinstance(unit, IDistributable)}
+
+    def apply_job(self, job):
+        for unit in self.workflow:
+            if isinstance(unit, IDistributable) and unit.name in job:
+                unit.apply_data_from_master(job[unit.name])
+
+    def generate_update(self):
+        return {unit.name: unit.generate_data_for_master()
+                for unit in self.workflow
+                if isinstance(unit, IDistributable)}
+
+    def apply_update(self, update, slave=None):
+        for unit in self.workflow:
+            if isinstance(unit, IDistributable) and unit.name in update:
+                unit.apply_data_from_slave(update[unit.name], slave)
+
+    def drop_slave(self, slave=None):
+        for unit in self.workflow:
+            if isinstance(unit, IDistributable):
+                unit.drop_slave(slave)

@@ -1,0 +1,105 @@
+"""Logger mixin giving every unit ``info/debug/warning/error`` methods.
+
+Re-design of ``veles/logger.py`` [U] (SURVEY.md §2.1 "Logger"): colored
+console output keyed by logger name; the optional MongoDB shipping of the
+reference is replaced by an optional JSONL sink (no external services in
+the TPU build).
+"""
+
+import json
+import logging
+import os
+import sys
+import time
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def __init__(self, colored: bool):
+        super().__init__(
+            fmt="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+            datefmt="%H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        text = super().format(record)
+        if self._colored:
+            color = _COLORS.get(record.levelno, "")
+            return "%s%s%s" % (color, text, _RESET) if color else text
+        return text
+
+
+class _JsonlHandler(logging.Handler):
+    """Optional structured sink (stands in for the reference's MongoDB
+    log shipping, which needs a server we don't assume)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fp = open(path, "a", buffering=1)
+
+    def emit(self, record):
+        try:
+            self._fp.write(json.dumps({
+                "t": time.time(),
+                "level": record.levelname,
+                "name": record.name,
+                "msg": record.getMessage(),
+            }) + "\n")
+        except Exception:  # pragma: no cover - never break on logging
+            self.handleError(record)
+
+
+_configured = False
+_jsonl_paths = set()
+
+
+def setup_logging(level=logging.INFO, jsonl_path=None):
+    global _configured
+    root_logger = logging.getLogger()
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_ColorFormatter(sys.stderr.isatty()))
+        root_logger.addHandler(handler)
+        _configured = True
+    root_logger.setLevel(level)
+    if jsonl_path and jsonl_path not in _jsonl_paths:
+        _jsonl_paths.add(jsonl_path)
+        root_logger.addHandler(_JsonlHandler(jsonl_path))
+
+
+class Logger:
+    """Mixin: self.info/debug/warning/error, named after the class (and
+    the unit name when mixed into :class:`veles.units.Unit`)."""
+
+    @property
+    def logger(self) -> logging.Logger:
+        cached = self.__dict__.get("_logger")
+        name = getattr(self, "name", None) or type(self).__name__
+        if cached is None or cached.name != name:
+            cached = logging.getLogger(name)
+            self.__dict__["_logger"] = cached
+        return cached
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+    def exception(self, msg, *args):
+        self.logger.exception(msg, *args)

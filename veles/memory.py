@@ -1,0 +1,144 @@
+"""Array: the unit-graph tensor container.
+
+Re-design of ``veles/memory.py`` [U] (SURVEY.md §2.1 "Array memory").
+The reference ``Array`` pairs a host numpy buffer (``.mem``) with a
+device buffer (``.devmem``) and an explicit ``map_read`` / ``map_write``
+/ ``map_invalidate`` / ``unmap`` state machine that turns host/device
+coherence races into deterministic assertion failures (SURVEY.md §5.2).
+
+On TPU the jitted step owns device residency and jax arrays are
+immutable, so the hazard class the state machine guarded against is
+gone. The API survives because ~every unit touches it, but semantics
+shift:
+
+* ``.mem`` is the host numpy value — the oracle truth.
+* ``.devmem`` lazily materialises ``.mem`` as a ``jax.Array`` (with
+  optional sharding) and is invalidated by ``map_write``/``map_invalidate``.
+* The map-state machine still *tracks* states and asserts on the one
+  residual race (reading ``.mem`` while marked device-dirty after a
+  compiled step wrote it), keeping the reference's debugging value.
+"""
+
+import numpy
+
+from veles.logger import Logger
+
+# Map states (names per reference).
+UNMAPPED = 0          # device copy (if any) is current; host may be stale
+MAPPED_READ = 1       # host current for reading
+MAPPED_WRITE = 2      # host current and being written; device stale
+
+
+def roundup(value: int, multiple: int) -> int:
+    """Round ``value`` up to a multiple (reference helper [U]; used here
+    for TPU-friendly padding: 8/128 sublane-lane tiles)."""
+    rem = value % multiple
+    return value if rem == 0 else value + multiple - rem
+
+
+class Array(Logger):
+    """Host-first tensor with optional jax mirror."""
+
+    def __init__(self, data=None, shape=None, dtype=numpy.float32):
+        self.name = "Array"
+        self._mem = None
+        self._devmem = None
+        self._state = MAPPED_WRITE
+        self.sharding = None  # jax sharding hint, set by parallel layer
+        if data is not None:
+            self.reset(numpy.asarray(data, dtype=dtype))
+        elif shape is not None:
+            self.reset(numpy.zeros(shape, dtype=dtype))
+
+    # -- allocation ---------------------------------------------------
+
+    def reset(self, data=None) -> "Array":
+        self._mem = None if data is None else numpy.asarray(data)
+        self._devmem = None
+        self._state = MAPPED_WRITE
+        return self
+
+    @property
+    def mem(self) -> numpy.ndarray:
+        if self._state == UNMAPPED and self._devmem is not None:
+            raise RuntimeError(
+                "reading host .mem of %s while device copy is newer; "
+                "call map_read()/map_write() first (reference Array "
+                "coherence contract)" % self.name)
+        return self._mem
+
+    @mem.setter
+    def mem(self, value):
+        self.reset(None if value is None else numpy.asarray(value))
+
+    def __bool__(self):
+        return self._mem is not None
+
+    @property
+    def shape(self):
+        return self._mem.shape if self._mem is not None else None
+
+    @property
+    def dtype(self):
+        return self._mem.dtype if self._mem is not None else None
+
+    @property
+    def size(self):
+        return self._mem.size if self._mem is not None else 0
+
+    @property
+    def nbytes(self):
+        return self._mem.nbytes if self._mem is not None else 0
+
+    # -- map/unmap state machine --------------------------------------
+
+    def map_read(self) -> "Array":
+        if self._state == UNMAPPED and self._devmem is not None:
+            host = numpy.asarray(self._devmem)
+            if self._mem is not None and host.dtype != self._mem.dtype:
+                host = host.astype(self._mem.dtype)
+            self._mem = host
+        self._state = MAPPED_READ
+        return self
+
+    def map_write(self) -> "Array":
+        self.map_read()
+        self._state = MAPPED_WRITE
+        self._devmem = None
+        return self
+
+    def map_invalidate(self) -> "Array":
+        """Host will be overwritten wholesale: skip device readback."""
+        self._state = MAPPED_WRITE
+        self._devmem = None
+        return self
+
+    def unmap(self) -> "Array":
+        self._state = UNMAPPED
+        return self
+
+    # -- device mirror ------------------------------------------------
+
+    @property
+    def devmem(self):
+        """The jax.Array mirror (lazily uploaded)."""
+        if self._devmem is None and self._mem is not None:
+            import jax
+            if self.sharding is not None:
+                self._devmem = jax.device_put(self._mem, self.sharding)
+            else:
+                self._devmem = jax.device_put(self._mem)
+        return self._devmem
+
+    def set_device_value(self, value) -> "Array":
+        """A compiled step produced a new device value; host is stale
+        until the next map_read (how training keeps weights on-device
+        across thousands of steps without host round-trips)."""
+        self._devmem = value
+        self._state = UNMAPPED
+        return self
+
+    def __repr__(self):
+        shp = "x".join(map(str, self.shape)) if self else "empty"
+        return "<Array %s %s st=%d>" % (
+            shp, self.dtype if self else "-", self._state)

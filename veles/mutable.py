@@ -1,0 +1,149 @@
+"""Mutable gate booleans and cross-unit attribute links.
+
+Re-design of ``veles/mutable.py`` [U] (SURVEY.md §2.1 "Mutable bools /
+links"). ``Bool`` is a shared, mutable truth value used as a unit gate
+(``gate_block`` / ``gate_skip``); boolean algebra over Bools produces
+*derived* bools that re-evaluate lazily, so ``decision.complete &
+~loader.epoch_ended`` stays live as its operands flip. ``LinkableAttribute``
+aliases an attribute of one object to an attribute of another (the data
+edges created by ``Unit.link_attrs``).
+"""
+
+import operator
+
+_MISSING = object()
+
+
+class Bool:
+    """A mutable boolean with lazy operator composition.
+
+    ``b << True`` (or ``b.set(True)``) mutates in place; ``&``, ``|``,
+    ``^`` and ``~`` build derived Bools that track their operands.
+    """
+
+    __slots__ = ("_value", "_op", "_operands", "on_change")
+
+    def __init__(self, value=False):
+        self._value = bool(value)
+        self._op = None
+        self._operands = ()
+        self.on_change = None
+
+    # -- mutation -----------------------------------------------------
+
+    def set(self, value) -> "Bool":
+        if self._op is not None:
+            raise ValueError("cannot assign to a derived Bool")
+        value = bool(value)
+        changed = value != self._value
+        self._value = value
+        if changed and self.on_change is not None:
+            self.on_change(self)
+        return self
+
+    def __lshift__(self, value) -> "Bool":
+        return self.set(value)
+
+    def toggle(self) -> "Bool":
+        return self.set(not self._value)
+
+    # -- evaluation ---------------------------------------------------
+
+    def __bool__(self) -> bool:
+        if self._op is None:
+            return self._value
+        return bool(self._op(*[bool(b) for b in self._operands]))
+
+    @classmethod
+    def _derived(cls, op, *operands) -> "Bool":
+        b = cls()
+        b._op = op
+        b._operands = operands
+        return b
+
+    def __and__(self, other):
+        return Bool._derived(operator.and_, self, _coerce(other))
+
+    __rand__ = __and__
+
+    def __or__(self, other):
+        return Bool._derived(operator.or_, self, _coerce(other))
+
+    __ror__ = __or__
+
+    def __xor__(self, other):
+        return Bool._derived(operator.xor, self, _coerce(other))
+
+    __rxor__ = __xor__
+
+    def __invert__(self):
+        return Bool._derived(operator.not_, self)
+
+    def __repr__(self):
+        kind = "derived " if self._op is not None else ""
+        return "<%sBool %s>" % (kind, bool(self))
+
+
+def _coerce(value) -> Bool:
+    return value if isinstance(value, Bool) else Bool(value)
+
+
+class LinkableAttribute:
+    """Alias ``getattr(dst, dst_attr)`` to ``getattr(src, src_attr)``.
+
+    Installed as a class-level descriptor slot on the destination's type
+    with a per-instance mapping, so different instances of one unit class
+    can link to different sources (matching the reference's per-instance
+    ``link_attrs`` behaviour [U]).
+    """
+
+    def __init__(self, attr_name, class_default=_MISSING):
+        self._attr = attr_name
+        self._key = "_linked_" + attr_name
+        self._class_default = class_default
+
+    @staticmethod
+    def install(dst, dst_attr, src, src_attr, two_way=False):
+        cls = type(dst)
+        descr = cls.__dict__.get(dst_attr)
+        if not isinstance(descr, LinkableAttribute):
+            # Capture any shadowed class-level default (from this class
+            # or the MRO) so unlinked instances keep seeing it.
+            default = _MISSING
+            for base in cls.__mro__:
+                if dst_attr in base.__dict__:
+                    default = base.__dict__[dst_attr]
+                    break
+            # Preserve any plain value already on the instance: keep it
+            # in __dict__, where __get__ falls back to it.
+            descr = LinkableAttribute(dst_attr, default)
+            setattr(cls, dst_attr, descr)
+        dst.__dict__[descr._key] = (src, src_attr, two_way)
+        return descr
+
+    def __get__(self, instance, owner=None):
+        if instance is None:
+            return self
+        link = instance.__dict__.get(self._key)
+        if link is None:
+            if self._attr in instance.__dict__:
+                return instance.__dict__[self._attr]
+            if self._class_default is not _MISSING:
+                return self._class_default
+            raise AttributeError(self._attr)
+        src, src_attr, _ = link
+        return getattr(src, src_attr)
+
+    def __set__(self, instance, value):
+        link = instance.__dict__.get(self._key)
+        if link is None:
+            instance.__dict__[self._attr] = value
+            return
+        src, src_attr, two_way = link
+        if two_way:
+            setattr(src, src_attr, value)
+        else:
+            # Writing to a one-way linked attribute breaks the link,
+            # mirroring the reference's unlink-on-assign behaviour.
+            instance.__dict__.pop(self._key, None)
+            instance.__dict__[self._attr] = value

@@ -1,0 +1,102 @@
+"""Seeded deterministic PRNG facade.
+
+Re-design of ``veles/prng/random_generator.py`` [U] (SURVEY.md §2.1
+"PRNG"). The reference keeps a registry of named, seeded generators so
+runs are exactly reproducible and the CLI can seed them from files/specs.
+
+TPU translation (SURVEY.md §7 "Exact-parity RNG"): the **numpy** side
+(weight init, shuffling, oracle dropout) uses ``numpy.random.Generator``
+and defines golden values bitwise; the **jax** side threads
+``jax.random`` keys through the step state and matches the oracle only
+statistically (convergence), never bitwise.
+"""
+
+import hashlib
+
+import numpy
+
+_generators = {}
+
+
+class RandomGenerator:
+    """A named, seedable wrapper over ``numpy.random.Generator`` with the
+    handful of draws the framework uses."""
+
+    def __init__(self, key: str, seed=None):
+        self.key = key
+        self.seed(seed if seed is not None else self._default_seed(key))
+
+    @staticmethod
+    def _default_seed(key: str) -> int:
+        # Stable across processes/pythons (unlike hash()).
+        return int.from_bytes(
+            hashlib.sha256(key.encode()).digest()[:4], "little")
+
+    def seed(self, seed) -> None:
+        self._seed = int(seed)
+        self._gen = numpy.random.Generator(numpy.random.PCG64(self._seed))
+
+    @property
+    def state_seed(self) -> int:
+        return self._seed
+
+    # -- draws --------------------------------------------------------
+
+    def fill_uniform(self, arr: numpy.ndarray, vmin=-1.0, vmax=1.0):
+        arr[...] = self._gen.uniform(vmin, vmax, size=arr.shape) \
+            .astype(arr.dtype)
+
+    def fill_normal(self, arr: numpy.ndarray, mean=0.0, stddev=1.0):
+        arr[...] = self._gen.normal(mean, stddev, size=arr.shape) \
+            .astype(arr.dtype)
+
+    def uniform(self, vmin, vmax, shape, dtype=numpy.float32):
+        return self._gen.uniform(vmin, vmax, size=shape).astype(dtype)
+
+    def normal(self, mean, stddev, shape, dtype=numpy.float32):
+        return self._gen.normal(mean, stddev, size=shape).astype(dtype)
+
+    def permutation(self, n: int) -> numpy.ndarray:
+        return self._gen.permutation(n)
+
+    def randint(self, low, high=None, size=None):
+        return self._gen.integers(low, high, size=size)
+
+    def random_sample(self, shape) -> numpy.ndarray:
+        return self._gen.random(size=shape, dtype=numpy.float64)
+
+    def jax_key(self):
+        """Derive a jax PRNG key from this generator's seed (lazy import
+        so the oracle path never touches jax)."""
+        import jax
+        return jax.random.PRNGKey(self._seed)
+
+
+def get(key: str = "default") -> RandomGenerator:
+    """Registry access, mirroring ``veles.prng.get`` [U]."""
+    gen = _generators.get(key)
+    if gen is None:
+        seed = None if _master_seed is None \
+            else _key_seed(_master_seed, key)
+        gen = _generators[key] = RandomGenerator(key, seed)
+    return gen
+
+
+_master_seed = None
+
+
+def _key_seed(master: int, key: str) -> int:
+    return (master * 1000003 + RandomGenerator._default_seed(key)) \
+        % (2 ** 63)
+
+
+def seed_all(seed: int) -> None:
+    """Re-seed every registered generator deterministically from one
+    master seed (CLI ``--seed`` behaviour). Per-key seeds derive from
+    the key *name* so results don't depend on registration order; later
+    ``get()`` of a fresh key under the same master seed is deterministic
+    too."""
+    global _master_seed
+    _master_seed = int(seed)
+    for key, gen in _generators.items():
+        gen.seed(_key_seed(_master_seed, key))

@@ -1,0 +1,155 @@
+"""The dataflow-graph unit runtime.
+
+Re-design of ``veles/units.py`` [U] (SURVEY.md §1 L1, §2.1 "Unit graph").
+A :class:`Unit` is a node in a workflow DAG with
+
+* **control edges** — ``b.link_from(a)`` means "b becomes ready after a
+  finishes"; a unit runs when *all* its open incoming links have fired
+  since its last run;
+* **gates** — ``gate_block`` (unit neither runs nor propagates) and
+  ``gate_skip`` (unit does not run but propagates), both live
+  :class:`veles.mutable.Bool` values so host logic (Decision) can flip
+  them mid-epoch;
+* **data edges** — ``link_attrs`` aliases attributes across units via
+  :class:`veles.mutable.LinkableAttribute`.
+
+Execution is single-threaded and deterministic (the reference used a
+thread pool; on TPU all device work is inside one jitted step, so host
+scheduling parallelism buys nothing and determinism matters more).
+Per-unit wall time is accumulated for the profiling report (SURVEY.md
+§5.1).
+"""
+
+import time
+from collections import OrderedDict
+
+from veles.logger import Logger
+from veles.mutable import Bool, LinkableAttribute
+
+
+class Unit(Logger):
+    """Base dataflow node."""
+
+    def __init__(self, workflow, name=None, **kwargs):
+        self.name = name or type(self).__name__
+        self.workflow = None
+        self.links_from = OrderedDict()   # src unit -> fired flag
+        self.links_to = OrderedDict()     # dst unit -> None
+        self.gate_block = Bool(False)
+        self.gate_skip = Bool(False)
+        self._initialized = False
+        self.run_calls = 0
+        self.run_time = 0.0
+        if workflow is not None:
+            workflow.add_unit(self)
+
+    # -- graph wiring -------------------------------------------------
+
+    def link_from(self, *units) -> "Unit":
+        """Add control edges ``unit -> self`` for each argument."""
+        for unit in units:
+            self.links_from[unit] = False
+            unit.links_to[self] = None
+        return self
+
+    def unlink_from(self, *units) -> "Unit":
+        for unit in units:
+            self.links_from.pop(unit, None)
+            unit.links_to.pop(self, None)
+        return self
+
+    def unlink_all(self) -> "Unit":
+        for unit in list(self.links_from):
+            self.unlink_from(unit)
+        for unit in list(self.links_to):
+            unit.unlink_from(self)
+        return self
+
+    def link_attrs(self, other, *specs, two_way=False) -> "Unit":
+        """Alias attributes of ``self`` to attributes of ``other``.
+
+        Each spec is either a name (same on both sides) or a pair
+        ``(my_name, other_name)`` — the reference's ``link_attrs``
+        convention [U].
+        """
+        for spec in specs:
+            if isinstance(spec, str):
+                mine = theirs = spec
+            else:
+                mine, theirs = spec
+            LinkableAttribute.install(self, mine, other, theirs,
+                                      two_way=two_way)
+        return self
+
+    # -- lifecycle ----------------------------------------------------
+
+    def initialize(self, **kwargs):
+        """Resolve shapes / allocate state. Subclasses override; must be
+        idempotent (re-initialize happens on snapshot resume)."""
+        self._initialized = True
+
+    @property
+    def is_initialized(self):
+        return self._initialized
+
+    def run(self):
+        """One execution of this unit. Subclasses override."""
+
+    def stop(self):
+        """Called once when the workflow stops (cleanup hook)."""
+
+    #: If True the unit runs as soon as ANY incoming link fires (the
+    #: reference Repeater's open_gate override [U]); default is an AND
+    #: barrier over all open incoming links.
+    or_gate = False
+
+    # -- scheduler internals ------------------------------------------
+
+    def _ready(self) -> bool:
+        if bool(self.gate_block):
+            return False
+        if not self.links_from:
+            return False
+        if self.or_gate:
+            return any(self.links_from.values())
+        return all(self.links_from.values())
+
+    def _clear_inbox(self):
+        for src in self.links_from:
+            self.links_from[src] = False
+
+    def _execute(self):
+        """Run (honouring gate_skip) and return units signalled next."""
+        self._clear_inbox()
+        if not bool(self.gate_skip):
+            start = time.perf_counter()
+            self.run()
+            self.run_time += time.perf_counter() - start
+            self.run_calls += 1
+        out = []
+        for dst in self.links_to:
+            if bool(dst.gate_block):
+                continue
+            dst.links_from[self] = True
+            out.append(dst)
+        return out
+
+    def __repr__(self):
+        return "<%s %r>" % (type(self).__name__, self.name)
+
+
+class TrivialUnit(Unit):
+    """A unit with an empty run (start/end points, barriers)."""
+
+
+class Repeater(TrivialUnit):
+    """Cycle re-entry point: fires downstream whenever ANY of its
+    predecessors fires (reference ``Repeater`` [U]; SURVEY.md §1 — the
+    training loop is a cycle in the DAG, and the repeater is what lets
+    both ``start_point`` and the last GD unit feed the loader)."""
+
+    or_gate = True
+
+
+class Container:
+    """Marker mixin for units that contain other units (Workflow)."""

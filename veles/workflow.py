@@ -1,0 +1,196 @@
+"""Workflow: a unit container and gated-DAG driver.
+
+Re-design of ``veles/workflow.py`` [U] (SURVEY.md §2.1 "Workflow",
+§3.1/§3.2 call stacks). The workflow owns ``start_point`` / ``end_point``
+units; ``run()`` fires the start point and keeps scheduling units whose
+incoming open links have all signalled, until the end point runs (the
+training loop is a *cycle* in the graph, re-entered until Decision opens
+the gate into the end point — SURVEY.md §1 "Key architectural fact").
+
+The reference drove this with a thread pool; here the scheduler is a
+deterministic single-threaded worklist (see rationale in
+``veles/units.py``). A workflow is itself a :class:`Unit` so workflows
+nest, and it aggregates per-unit timing into the profiling report.
+"""
+
+import sys
+import time
+from collections import OrderedDict, deque
+
+from veles.units import Unit, TrivialUnit, Container
+
+
+class StartPoint(TrivialUnit):
+    pass
+
+
+class EndPoint(TrivialUnit):
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.reached = False
+
+    def run(self):
+        self.reached = True
+
+
+class Workflow(Unit, Container):
+    """Container of units + graph driver."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        self._units = []
+        super().__init__(workflow, name=name, **kwargs)
+        self.start_point = StartPoint(self, name="start_point")
+        self.end_point = EndPoint(self, name="end_point")
+        self._stopped = False
+        self.run_number = 0
+
+    # -- container ----------------------------------------------------
+
+    def add_unit(self, unit: Unit):
+        if unit in self._units:
+            return
+        # Uniquify names: params/state pytrees, FlowContext routing and
+        # the distribution registry are all keyed by unit.name, so two
+        # same-named units would silently collide.
+        base = unit.name
+        taken = {u.name for u in self._units}
+        if unit.name in taken:
+            i = 2
+            while "%s_%d" % (base, i) in taken:
+                i += 1
+            unit.name = "%s_%d" % (base, i)
+        self._units.append(unit)
+        unit.workflow = self
+
+    def del_unit(self, unit: Unit):
+        if unit in self._units:
+            self._units.remove(unit)
+            unit.unlink_all()
+            unit.workflow = None
+
+    @property
+    def units(self):
+        return list(self._units)
+
+    def __iter__(self):
+        return iter(self._units)
+
+    def __len__(self):
+        return len(self._units)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def initialize(self, **kwargs):
+        """Initialize in (cycle-tolerant) topological order so producers
+        resolve shapes before consumers (§3.1). Kahn's algorithm over
+        the control edges; units stuck on cycle back-edges are released
+        in discovery order."""
+        super().initialize(**kwargs)
+        order = self.topo_order()
+        for unit in order:
+            if unit is not self:
+                unit.initialize(**kwargs)
+        return order
+
+    def topo_order(self):
+        """Cycle-tolerant topological order of all units, start_point
+        first; unreachable units (plotters linked later) at the end."""
+        indeg = {id(u): 0 for u in self._units}
+        unit_by_id = {id(u): u for u in self._units}
+        for u in self._units:
+            for dst in u.links_to:
+                if id(dst) in indeg:
+                    indeg[id(dst)] += 1
+        ready = deque(u for u in self._units if indeg[id(u)] == 0)
+        order, seen = [], set()
+        pending = set(indeg) - {id(u) for u in ready}
+        while ready or pending:
+            if not ready:
+                # Cycle: release the earliest-added pending unit.
+                for u in self._units:
+                    if id(u) in pending:
+                        ready.append(u)
+                        pending.discard(id(u))
+                        break
+            unit = ready.popleft()
+            if id(unit) in seen:
+                continue
+            seen.add(id(unit))
+            order.append(unit)
+            for dst in unit.links_to:
+                if id(dst) in indeg and id(dst) not in seen:
+                    indeg[id(dst)] -= 1
+                    if indeg[id(dst)] <= 0 and id(dst) in pending:
+                        pending.discard(id(dst))
+                        ready.append(dst)
+        return order
+
+    def run(self):
+        """Drive the gated DAG until end_point runs or stop() is called.
+
+        Timing note: run_time/run_calls are updated by Unit._execute
+        when this workflow is nested inside another; a top-level run is
+        timed by the caller (Launcher) — updating here as well would
+        double-count nested workflows in print_stats.
+        """
+        self._stopped = False
+        self.end_point.reached = False
+        self.run_number += 1
+        # Clear stale fired-link flags from a previous stopped run: a
+        # leftover True on a fan-in unit would let it fire early.
+        for unit in self._units:
+            unit._clear_inbox()
+        worklist = deque(self.start_point._execute())
+        while worklist and not self._stopped:
+            unit = worklist.popleft()
+            if unit is self.end_point:
+                # End point still honours the all-links rule.
+                if unit._ready():
+                    unit._execute()
+                    break
+                continue
+            if unit._ready():
+                worklist.extend(unit._execute())
+
+    def stop(self):
+        self._stopped = True
+        for unit in self._units:
+            if unit is not self:
+                unit.stop()
+
+    # -- introspection / observability --------------------------------
+
+    def generate_graph(self) -> str:
+        """Graphviz dot dump of the unit DAG (the reference's
+        ``--workflow-graph``; SURVEY.md §5.1)."""
+        lines = ["digraph %s {" % self.name.replace(" ", "_"),
+                 "  rankdir=TB;"]
+        index = {unit: "u%d" % i for i, unit in enumerate(self._units)}
+        for unit, uid in index.items():
+            shape = "oval" if isinstance(unit, TrivialUnit) else "box"
+            lines.append('  %s [label="%s\\n%s" shape=%s];'
+                         % (uid, unit.name, type(unit).__name__, shape))
+        for unit, uid in index.items():
+            for dst in unit.links_to:
+                if dst in index:
+                    lines.append("  %s -> %s;" % (uid, index[dst]))
+        lines.append("}")
+        return "\n".join(lines)
+
+    def print_stats(self, stream=sys.stderr):
+        """Per-unit wall-time table (SURVEY.md §5.1)."""
+        rows = sorted(((u.run_time, u.run_calls, u.name)
+                       for u in self._units if u.run_calls),
+                      reverse=True)
+        total = sum(r[0] for r in rows) or 1e-12
+        stream.write("%-32s %10s %8s %7s\n"
+                     % ("unit", "time(s)", "calls", "share"))
+        for t, calls, name in rows:
+            stream.write("%-32s %10.4f %8d %6.1f%%\n"
+                         % (name, t, calls, 100.0 * t / total))
+
+    def unit_by_name(self, name: str) -> Unit:
+        for unit in self._units:
+            if unit.name == name:
+                return unit
+        raise KeyError(name)
