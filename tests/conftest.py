@@ -10,11 +10,20 @@ real-TPU path is exercised by ``bench.py`` / the driver instead.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the outer environment pins JAX_PLATFORMS to
+# the real TPU tunnel (and a sitecustomize imports jax at interpreter
+# startup), but tests must run on the virtual CPU mesh. Overriding the
+# env var alone is not enough once jax is already imported, so also
+# flip the live jax config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,191 @@
+"""Per-op golden tests for the dense stack, reference-style (SURVEY.md
+§4 "Unit tests"): numpy_run is the oracle; the traced xla path must
+allclose it. jax.grad serves as a second oracle for the hand-written
+backward (SURVEY.md §7 "Hard parts": autodiff only in tests)."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.backends import XLADevice
+from veles.memory import Array
+from veles.accelerated_units import AcceleratedUnit, StepCompiler
+from veles.workflow import Workflow
+from veles.znicz_tpu.ops.all2all import (
+    All2All, All2AllTanh, All2AllRELU, All2AllStrictRELU,
+    All2AllSigmoid, All2AllSoftmax)
+from veles.znicz_tpu.nn_units import gradient_unit_for
+
+
+class FeedUnit(AcceleratedUnit):
+    """Minimal producer holding a minibatch Array."""
+
+    def __init__(self, workflow, data):
+        super().__init__(workflow, name="feed")
+        self.minibatch_data = Array(data)
+
+    def numpy_run(self):
+        pass
+
+    def xla_run(self, ctx):
+        pass
+
+
+def make_pair(cls, batch=8, n_in=20, n_out=12, transposed=False):
+    prng.seed_all(42)
+    wf = Workflow(None, name="wf")
+    gen = prng.get("t")
+    x = gen.normal(0, 1.0, (batch, n_in))
+    feed = FeedUnit(wf, x)
+    fwd = cls(wf, output_sample_shape=n_out,
+              weights_transposed=transposed)
+    fwd.link_attrs(feed, ("input", "minibatch_data"))
+    fwd.initialize(device=None)
+    return wf, feed, fwd, x
+
+
+@pytest.mark.parametrize("cls", [
+    All2All, All2AllTanh, All2AllRELU, All2AllStrictRELU,
+    All2AllSigmoid, All2AllSoftmax])
+def test_forward_numpy_vs_xla(cls):
+    wf, feed, fwd, x = make_pair(cls)
+    fwd.numpy_run()
+    golden = numpy.array(fwd.output.mem)
+
+    dev = XLADevice(platform="cpu")
+    comp = StepCompiler([fwd], dev)
+    import jax
+    from veles.accelerated_units import FlowContext
+
+    def fn(p, xv):
+        ctx = FlowContext(comp, p, {}, {}, jax.random.PRNGKey(0), False)
+        ctx.set(feed, "minibatch_data", xv)
+        fwd.xla_run(ctx)
+        return ctx.get(fwd, "output")
+
+    y = jax.jit(fn)(comp.gather_params(), x)
+    assert numpy.allclose(numpy.asarray(y), golden, atol=2e-5), cls
+
+
+@pytest.mark.parametrize("cls,transposed", [
+    (All2All, False), (All2AllTanh, False), (All2AllTanh, True),
+    (All2AllRELU, False), (All2AllSigmoid, False)])
+def test_gd_matches_jax_grad(cls, transposed):
+    """Hand-written backward vs jax.grad on an L = sum(err_output * y)
+    surrogate (so dL/dy == err_output)."""
+    import jax
+    import jax.numpy as jnp
+
+    wf, feed, fwd, x = make_pair(cls, transposed=transposed)
+    gd_cls = gradient_unit_for(cls)
+    gd = gd_cls(wf, learning_rate=0.0)  # lr=0: only check gradients
+    gd.setup_forward(fwd)
+    gen = prng.get("t2")
+    err_out = gen.normal(0, 1.0, (x.shape[0], fwd.neurons))
+    gd.err_output = Array(err_out)
+    fwd.numpy_run()
+    gd.initialize(device=None)
+    w0 = numpy.array(fwd.weights.mem)
+    b0 = numpy.array(fwd.bias.mem)
+    gd.numpy_run()
+    err_input = numpy.array(gd.err_input.mem)
+
+    # jax.grad oracle over the surrogate loss
+    from veles.znicz_tpu.ops import activations as A
+
+    def loss(w, b, xv):
+        v = xv @ (w.T if transposed else w) + b
+        y = A.ACTIVATIONS[cls.ACTIVATION][0](jnp, v)
+        return jnp.sum(jnp.asarray(err_out) * y)
+
+    gw, gb, gx = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(w0), jnp.asarray(b0), jnp.asarray(x))
+    assert numpy.allclose(err_input, numpy.asarray(gx), atol=1e-4)
+
+    # now check the actual weight update applies -lr * grad
+    gd.learning_rate = 0.5
+    gd.learning_rate_bias = 0.5
+    fwd.weights.mem = w0.copy()
+    fwd.bias.mem = b0.copy()
+    gd.vel_weights.mem = numpy.zeros_like(w0)
+    gd.vel_bias.mem = numpy.zeros_like(b0)
+    gd.numpy_run()
+    assert numpy.allclose(fwd.weights.mem, w0 - 0.5 * numpy.asarray(gw),
+                          atol=1e-4)
+    assert numpy.allclose(fwd.bias.mem, b0 - 0.5 * numpy.asarray(gb),
+                          atol=1e-4)
+
+
+def test_gd_xla_matches_numpy():
+    """Full train-step parity: numpy unit-by-unit vs one fused XLA step."""
+    import jax
+
+    wf, feed, fwd, x = make_pair(All2AllTanh)
+    gd = gradient_unit_for(All2AllTanh)(
+        wf, learning_rate=0.1, gradient_moment=0.9, weights_decay=0.01)
+    gd.setup_forward(fwd)
+    gen = prng.get("t3")
+    err_out = gen.normal(0, 1.0, (x.shape[0], fwd.neurons))
+    gd.err_output = Array(err_out)
+    fwd.numpy_run()
+    gd.initialize(device=None)
+
+    dev = XLADevice(platform="cpu")
+    comp = StepCompiler([fwd, gd], dev)
+    params0 = comp.gather_params()
+    state0 = comp.gather_state()
+    hyper = {gd.name: gd.hyperparams()}
+    step = comp.build_step({"data": (feed, "minibatch_data")},
+                           train=True)
+    params1, state1, _ = step(params0, state0, {"data": x}, hyper,
+                              jax.random.PRNGKey(0))
+
+    # oracle
+    gd.numpy_run()
+    assert numpy.allclose(numpy.asarray(params1[fwd.name]["weights"]),
+                          fwd.weights.mem, atol=2e-4)
+    assert numpy.allclose(numpy.asarray(params1[fwd.name]["bias"]),
+                          fwd.bias.mem, atol=2e-4)
+    assert numpy.allclose(numpy.asarray(state1[gd.name]["vel_weights"]),
+                          gd.vel_weights.mem, atol=2e-4)
+
+
+def test_gradient_accumulation_parity():
+    """accumulate_gradient=2: one update every 2 minibatches, identical
+    between numpy oracle and the compiled step."""
+    import jax
+
+    wf, feed, fwd, x = make_pair(All2AllTanh)
+    gd = gradient_unit_for(All2AllTanh)(
+        wf, learning_rate=0.1, accumulate_gradient=2)
+    gd.setup_forward(fwd)
+    gen = prng.get("t4")
+    errs = [gen.normal(0, 1.0, (x.shape[0], fwd.neurons))
+            for _ in range(2)]
+    gd.err_output = Array(errs[0])
+    fwd.numpy_run()
+    gd.initialize(device=None)
+    w0 = numpy.array(fwd.weights.mem)
+
+    dev = XLADevice(platform="cpu")
+    comp = StepCompiler([fwd, gd], dev)
+    params = comp.gather_params()
+    state = comp.gather_state()
+    step = comp.build_step({"data": (feed, "minibatch_data"),
+                            "err": (gd, "err_output")}, train=True)
+    hyper = {gd.name: gd.hyperparams()}
+    for e in errs:
+        params, state, _ = step(params, state,
+                                {"data": x, "err": e}, hyper,
+                                jax.random.PRNGKey(0))
+
+    for e in errs:
+        gd.err_output.mem = e
+        fwd.numpy_run()
+        gd.numpy_run()
+
+    # after step 1 no change; after step 2 both applied the summed grad
+    assert not numpy.allclose(fwd.weights.mem, w0)
+    assert numpy.allclose(numpy.asarray(params[fwd.name]["weights"]),
+                          fwd.weights.mem, atol=2e-4)
+    assert int(gd.acc_count.map_read().mem) == 0

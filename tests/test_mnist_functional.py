@@ -1,0 +1,56 @@
+"""Functional test: the MNIST sample converges, and the XLA backend
+reaches the numpy oracle's accuracy (BASELINE.json north star:
+"samples/MNIST converging to the same accuracy as the numpy backend";
+SURVEY.md §4 "Functional tests" — fixed seeds, per-epoch metrics)."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+def build_and_run(backend):
+    prng.seed_all(1337)
+    # fresh generator registry state for exact reproducibility
+    from veles.znicz_tpu.models import mnist
+    root.mnist.decision.max_epochs = 3
+    wf = mnist.create_workflow(name="MnistTest_%s" % backend)
+    wf.initialize(device=backend)
+    wf.run()
+    return wf
+
+
+def final_valid_error(wf):
+    last = wf.decision.history[-1]
+    return last["validation"]["metric"]
+
+
+@pytest.fixture(scope="module")
+def numpy_wf():
+    return build_and_run("numpy")
+
+
+def test_numpy_converges(numpy_wf):
+    err = final_valid_error(numpy_wf)
+    first = numpy_wf.decision.history[0]["validation"]["metric"]
+    assert err < 0.15, "validation error %.3f too high" % err
+    assert err <= first, "no improvement over training"
+
+
+def test_xla_matches_numpy(numpy_wf):
+    wf = build_and_run("cpu")
+    err_np = final_valid_error(numpy_wf)
+    err_x = final_valid_error(wf)
+    assert abs(err_np - err_x) < 0.02, (err_np, err_x)
+    # weights synced back to host after run(): finite and same shape
+    w = wf.forwards[0].weights.map_read().mem
+    assert numpy.isfinite(w).all()
+
+
+def test_deterministic_rerun(numpy_wf):
+    """Fixed-seed functional determinism (reference contract, §4)."""
+    wf2 = build_and_run("numpy")
+    h1 = [e["validation"]["metric"] for e in numpy_wf.decision.history]
+    h2 = [e["validation"]["metric"] for e in wf2.decision.history]
+    assert h1 == h2

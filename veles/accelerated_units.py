@@ -115,13 +115,15 @@ class FlowContext:
     ``set`` their outputs.
     """
 
-    def __init__(self, compiler, params, state, hyper, key, train):
+    def __init__(self, compiler, params, state, hyper, key, train,
+                 axis_name=None):
         self._compiler = compiler
         self.params = params        # full dict: unit name -> {attr: arr}
         self.state = state
         self.hyper = hyper          # dict of scalar hyperparams (lr, ...)
         self.key = key              # jax PRNG key folded per unit
         self.train = train          # python bool: compile-time variant
+        self.axis_name = axis_name  # set when traced under shard_map
         self.values = {}            # (producer_unit_name, attr) -> tensor
         self.outputs = {}           # exported outputs (metrics etc.)
 
@@ -178,6 +180,27 @@ class FlowContext:
     def export(self, name, tensor):
         """Expose a tensor in the step outputs (metrics, err counts)."""
         self.outputs[name] = tensor
+
+    # collectives -------------------------------------------------------
+
+    def pmean(self, tensor):
+        """Cross-replica gradient mean. Under plain ``jit`` with sharded
+        batches this is the identity — the batch contraction already
+        sums across shards and XLA inserts the all-reduce (SURVEY.md §7
+        stage 5). Under ``shard_map`` (explicit-collective mode) it is a
+        real ``lax.pmean`` over the data axis."""
+        if self.axis_name is None:
+            return tensor
+        import jax
+        return jax.lax.pmean(tensor, self.axis_name)
+
+    def dot(self, a, b):
+        """MXU-friendly matmul: inputs cast to the device compute dtype
+        (bfloat16 on TPU), accumulation in float32."""
+        import jax.numpy as jnp
+        cd = self._compiler.device.compute_dtype
+        return jnp.matmul(a.astype(cd), b.astype(cd),
+                          preferred_element_type=jnp.float32)
 
 
 def _resolve_link(unit, attr):

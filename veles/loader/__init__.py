@@ -1,0 +1,19 @@
+"""Data loading layer (SURVEY.md §2.3).
+
+Re-design of ``veles/loader/`` [U]: the :class:`Loader` unit serves
+minibatches of the three sample classes (TEST=0, VALID=1, TRAIN=2) with
+seeded per-epoch shuffling, and exposes the epoch bookkeeping ``Bool``s
+(``epoch_ended`` / ``last_minibatch``) the Decision unit consumes.
+
+TPU adaptation: minibatches are always *padded to a static
+``max_minibatch_size``* (XLA wants static shapes; SURVEY.md §7 "Design
+stance"), with the true row count published as ``minibatch_size`` so
+evaluators mask padding. The numpy oracle uses the identical padding so
+both backends see the same numbers.
+"""
+
+from veles.loader.base import (  # noqa: F401
+    CLASS_TEST, CLASS_VALID, CLASS_TRAIN, TRIAGE,
+    Loader,
+)
+from veles.loader.fullbatch import FullBatchLoader  # noqa: F401

@@ -1,0 +1,215 @@
+"""Abstract minibatch server.
+
+Re-design of ``veles/loader/base.py`` [U] (SURVEY.md §2.3 "Loader
+base"). Semantics preserved: three sample classes served in class order
+(TEST=0 → VALID=1 → TRAIN=2) within each epoch; the train class is
+reshuffled every epoch with a seeded generator; ``last_minibatch`` fires
+on the final minibatch of each class and ``epoch_ended`` on the final
+minibatch of the epoch; in distributed runs the loader is the unit whose
+master→slave payload is minibatch index ranges (SURVEY.md §3.3).
+"""
+
+import numpy
+
+from veles import prng
+from veles.distributable import IDistributable
+from veles.memory import Array
+from veles.mutable import Bool
+from veles.units import Unit
+
+CLASS_TEST, CLASS_VALID, CLASS_TRAIN = 0, 1, 2
+TRIAGE = ("test", "validation", "train")
+
+
+class Loader(Unit, IDistributable):
+    """Base minibatch server unit.
+
+    Subclasses implement :meth:`load_data` (fill ``class_lengths``,
+    prepare storage) and :meth:`fill_minibatch` (materialise the rows of
+    ``minibatch_indices`` into ``minibatch_data``/``minibatch_labels``).
+    """
+
+    negotiates_on_connect = True
+
+    def __init__(self, workflow, minibatch_size=100, shuffle=True,
+                 prng_key="loader", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.max_minibatch_size = int(minibatch_size)
+        self.shuffle_enabled = bool(shuffle)
+        self.prng = prng.get(prng_key)
+
+        #: samples per class: [test, valid, train]
+        self.class_lengths = [0, 0, 0]
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        #: regression targets (MSE workflows); empty when unused
+        self.minibatch_targets = Array()
+        self.minibatch_indices = Array()
+        #: number of *valid* (non-padding) rows in the current minibatch
+        self.minibatch_size = 0
+        self.minibatch_class = CLASS_TRAIN
+        self.minibatch_offset = 0
+
+        self.epoch_number = 0
+        self.epoch_ended = Bool(False)
+        self.last_minibatch = Bool(False)
+        #: live gate mirror: True while serving train minibatches (GD
+        #: units' gate_skip is its inverse)
+        self.train_phase = Bool(True)
+
+        # epoch iteration state
+        self._order = []          # [(cls, ndarray-of-global-indices)]
+        self._cls_pos = 0
+        self._idx_pos = 0
+
+        # distributed: master-side queue of pending (cls, lo, hi) jobs
+        self._pending_jobs = []
+        self._inflight = {}
+
+    # -- to be implemented by subclasses ------------------------------
+
+    def load_data(self):
+        """Discover the dataset: set class_lengths, allocate storage."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        """Allocate ``minibatch_data`` (padded to max_minibatch_size)."""
+        raise NotImplementedError
+
+    def fill_minibatch(self):
+        """Fill minibatch arrays for ``minibatch_indices[:minibatch_size]``."""
+        raise NotImplementedError
+
+    # -- derived quantities -------------------------------------------
+
+    @property
+    def total_samples(self):
+        return int(sum(self.class_lengths))
+
+    def class_offset(self, cls):
+        return int(sum(self.class_lengths[:cls]))
+
+    @property
+    def effective_batches_per_epoch(self):
+        mb = self.max_minibatch_size
+        return sum((n + mb - 1) // mb for n in self.class_lengths)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        if self.total_samples == 0:
+            self.load_data()
+        if self.total_samples == 0:
+            raise ValueError("%s loaded an empty dataset" % self.name)
+        self.create_minibatch_data()
+        if not self.minibatch_indices:
+            self.minibatch_indices.reset(
+                numpy.zeros(self.max_minibatch_size, dtype=numpy.int32))
+        self._start_epoch(first=True)
+
+    def _class_indices(self, cls):
+        off = self.class_offset(cls)
+        idx = numpy.arange(off, off + self.class_lengths[cls],
+                           dtype=numpy.int32)
+        if cls == CLASS_TRAIN and self.shuffle_enabled:
+            idx = idx[self.prng.permutation(len(idx))]
+        return idx
+
+    def _start_epoch(self, first=False):
+        if not first:
+            self.epoch_number += 1
+        self._order = [(cls, self._class_indices(cls))
+                       for cls in (CLASS_TEST, CLASS_VALID, CLASS_TRAIN)
+                       if self.class_lengths[cls] > 0]
+        self._cls_pos = 0
+        self._idx_pos = 0
+
+    # -- serving -------------------------------------------------------
+
+    def _serve_chunk(self, cls, chunk):
+        """Publish one minibatch: class/gates bookkeeping + static-shape
+        index padding (pad rows repeat the last index; evaluators mask
+        them via ``minibatch_size``)."""
+        mb = self.max_minibatch_size
+        self.minibatch_class = cls
+        self.train_phase << (cls == CLASS_TRAIN)
+        self.minibatch_size = len(chunk)
+        padded = numpy.empty(mb, dtype=numpy.int32)
+        padded[:len(chunk)] = chunk
+        if len(chunk) < mb:
+            padded[len(chunk):] = chunk[-1] if len(chunk) else 0
+        self.minibatch_indices.map_invalidate()
+        self.minibatch_indices.mem[...] = padded
+        self.fill_minibatch()
+
+    def run(self):
+        self.epoch_ended << False
+        self.last_minibatch << False
+        if self._cls_pos >= len(self._order):
+            self._start_epoch()
+        cls, indices = self._order[self._cls_pos]
+        mb = self.max_minibatch_size
+        lo = self._idx_pos
+        hi = min(lo + mb, len(indices))
+        self.minibatch_offset = lo
+        self._serve_chunk(cls, indices[lo:hi])
+        self._idx_pos = hi
+        if hi >= len(indices):
+            self.last_minibatch << True
+            self._cls_pos += 1
+            self._idx_pos = 0
+            if self._cls_pos >= len(self._order):
+                self.epoch_ended << True
+
+    # -- IDistributable: ship minibatch index ranges (SURVEY.md §3.3) --
+
+    def generate_data_for_slave(self, slave=None):
+        """Pop the next minibatch job; ``None`` signals the epoch's job
+        queue is exhausted (the master then aggregates the epoch and
+        calls :meth:`master_start_epoch` for the next one)."""
+        if not self._pending_jobs:
+            return None
+        job = self._pending_jobs.pop(0)
+        self._inflight.setdefault(slave, []).append(job)
+        return job
+
+    def master_start_epoch(self):
+        """Master side: (re)fill the job queue for one epoch. Uses a
+        dedicated generator derived from the loader seed, so master-mode
+        shuffles never desynchronize the local serving PRNG (fixed-seed
+        reproducibility contract)."""
+        if not hasattr(self, "_dist_prng"):
+            from veles.prng import RandomGenerator
+            self._dist_prng = RandomGenerator(
+                "%s.dist" % self.name, self.prng.state_seed + 0x9E3779B9)
+        mb = self.max_minibatch_size
+        for cls in (CLASS_TEST, CLASS_VALID, CLASS_TRAIN):
+            if self.class_lengths[cls] == 0:
+                continue
+            off = self.class_offset(cls)
+            indices = numpy.arange(off, off + self.class_lengths[cls],
+                                   dtype=numpy.int32)
+            if cls == CLASS_TRAIN and self.shuffle_enabled:
+                indices = indices[self._dist_prng.permutation(len(indices))]
+            for lo in range(0, len(indices), mb):
+                self._pending_jobs.append(
+                    (cls, indices[lo:lo + mb].tolist()))
+
+    def apply_data_from_master(self, data):
+        if data is None:
+            return
+        cls, idx_list = data
+        self._serve_chunk(cls, numpy.asarray(idx_list, dtype=numpy.int32))
+
+    def generate_data_for_master(self):
+        return None
+
+    def apply_data_from_slave(self, data, slave=None):
+        if slave in self._inflight and self._inflight[slave]:
+            self._inflight[slave].pop(0)
+
+    def drop_slave(self, slave=None):
+        """Re-queue in-flight minibatches of a dead slave (§5.3)."""
+        for job in self._inflight.pop(slave, []):
+            self._pending_jobs.insert(0, job)

@@ -1,0 +1,70 @@
+"""Whole-dataset-resident loader.
+
+Re-design of ``veles/loader/fullbatch.py`` [U] (SURVEY.md §2.3
+"Full-batch loader"): the entire dataset lives in host ``Array``s
+(``original_data`` / ``original_labels``); a minibatch is a gather by
+indices. Subclasses (or callers) fill the originals in
+:meth:`load_data`.
+"""
+
+import numpy
+
+from veles.loader.base import Loader
+from veles.memory import Array
+
+
+class FullBatchLoader(Loader):
+    """Dataset-in-memory loader; minibatch = row gather."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.original_data = Array()
+        self.original_labels = Array()
+        #: regression targets (MSE workflows; reference FullBatchLoaderMSE)
+        self.original_targets = Array()
+        #: dtype the minibatch is served in (normalized float input)
+        self.serve_dtype = numpy.float32
+
+    def load_data(self):
+        """Default: originals were assigned externally before
+        initialize(); subclasses override to actually read a dataset."""
+        if not self.original_data:
+            raise ValueError(
+                "%s: original_data unset and load_data not overridden"
+                % self.name)
+        if sum(self.class_lengths) == 0:
+            raise ValueError(
+                "%s: class_lengths must be set with original_data"
+                % self.name)
+        n = len(self.original_data.mem)
+        if n != self.total_samples:
+            raise ValueError(
+                "%s: %d samples but class_lengths sums to %d"
+                % (self.name, n, self.total_samples))
+
+    def create_minibatch_data(self):
+        sample_shape = self.original_data.mem.shape[1:]
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + sample_shape, self.serve_dtype))
+        if self.original_labels:
+            self.minibatch_labels.reset(numpy.zeros(
+                self.max_minibatch_size,
+                self.original_labels.mem.dtype))
+        if self.original_targets:
+            self.minibatch_targets.reset(numpy.zeros(
+                (self.max_minibatch_size,)
+                + self.original_targets.mem.shape[1:],
+                self.serve_dtype))
+
+    def fill_minibatch(self):
+        idx = self.minibatch_indices.mem
+        self.minibatch_data.map_invalidate()
+        self.minibatch_data.mem[...] = \
+            self.original_data.mem[idx].astype(self.serve_dtype)
+        if self.original_labels:
+            self.minibatch_labels.map_invalidate()
+            self.minibatch_labels.mem[...] = self.original_labels.mem[idx]
+        if self.original_targets:
+            self.minibatch_targets.map_invalidate()
+            self.minibatch_targets.mem[...] = \
+                self.original_targets.mem[idx].astype(self.serve_dtype)

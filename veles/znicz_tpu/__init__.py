@@ -1,0 +1,22 @@
+"""veles.znicz_tpu — the neural-network plugin, TPU-native.
+
+Rebuild of the reference znicz repo (SURVEY.md §2.4): every op is a
+*pair* of units — a ``Forward`` and a matching ``GradientDescent*``
+(explicit backprop as graph nodes, no autodiff on the main path;
+``jax.grad`` appears only as a test oracle, SURVEY.md §7 "Hard parts").
+
+Subpackages:
+
+* ``ops``      — the unit zoo (all2all, conv, pooling, gd*, evaluator,
+  normalization, dropout, activation, kohonen, rbm, attention, ...).
+* ``models``   — sample workflows (MNIST, CIFAR10, AlexNet, Kohonen,
+  RBM, Transformer LM), mirroring reference ``samples/``.
+* ``parallel`` — mesh / sharding / collectives (ICI replacement for the
+  reference's ZeroMQ master↔slave layer).
+* ``utils``    — diagnostics, lr scheduling, rollback, image saving.
+"""
+
+from veles.znicz_tpu.nn_units import (  # noqa: F401
+    Forward, GradientDescentBase, NNWorkflow,
+    forward_unit, gradient_unit_for, gradient_for,
+)

@@ -1,0 +1,162 @@
+"""Decision units — the training-loop brain.
+
+Re-design of znicz ``decision.py`` [U] (SURVEY.md §2.4 "Decision"):
+host-side unit that consumes the loader's epoch Bools and the
+evaluator's per-minibatch metrics, accumulates them per sample class,
+tracks the best validation error, and drives the gates:
+
+* ``improved``  — validation metric hit a new best (opens the
+  snapshotter gate);
+* ``complete``  — stop criterion met (max epochs, or no improvement for
+  ``fail_iterations`` epochs) — opens the gate into ``end_point``.
+
+Decision stays imperative Python between compiled steps — exactly the
+host/device partition SURVEY.md §7 prescribes.
+"""
+
+import numpy
+
+from veles.loader.base import CLASS_TEST, CLASS_VALID, CLASS_TRAIN, TRIAGE
+from veles.mutable import Bool
+from veles.units import Unit
+
+
+class DecisionBase(Unit):
+    """Epoch bookkeeping + stop criteria."""
+
+    def __init__(self, workflow, max_epochs=None, fail_iterations=100,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.max_epochs = max_epochs
+        self.fail_iterations = fail_iterations
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.epoch_ended = Bool(False)
+
+        # linked from loader
+        self.loader = None
+        self.evaluator = None
+
+        self.epoch_number = 0
+        self.minibatch_count = 0
+        #: per-class accumulated metrics for the current epoch
+        self.epoch_metrics = [None, None, None]
+        #: last finished epoch's metrics, per class
+        self.last_epoch_metrics = [None, None, None]
+        self.best_metric = numpy.inf
+        self.best_epoch = -1
+        self._epochs_since_best = 0
+        #: history of per-epoch summary dicts (plotters consume this)
+        self.history = []
+
+    def link_loader_evaluator(self, loader, evaluator):
+        self.loader = loader
+        self.evaluator = evaluator
+        return self
+
+    # metric extraction (subclass point) -------------------------------
+
+    def minibatch_metric(self):
+        """(sortable_scalar, extras_dict) for the evaluator's last
+        minibatch."""
+        raise NotImplementedError
+
+    def _zero_acc(self):
+        return {"samples": 0, "loss": 0.0, "metric": 0.0}
+
+    def run(self):
+        self.epoch_ended << False
+        self.improved << False
+        cls = self.loader.minibatch_class
+        if self.epoch_metrics[cls] is None:
+            self.epoch_metrics[cls] = self._zero_acc()
+        acc = self.epoch_metrics[cls]
+        n = int(self.loader.minibatch_size)
+        metric, extras = self.minibatch_metric()
+        acc["samples"] += n
+        acc["metric"] += metric
+        acc["loss"] += float(getattr(self.evaluator, "loss", 0.0)) * n
+        for k, v in extras.items():
+            acc[k] = acc.get(k, 0) + v
+        self.minibatch_count += 1
+
+        if bool(self.loader.last_minibatch) \
+                and cls in (CLASS_VALID, CLASS_TRAIN):
+            self._on_class_ended(cls)
+        if bool(self.loader.epoch_ended):
+            self._on_epoch_ended()
+
+    def _on_class_ended(self, cls):
+        acc = self.epoch_metrics[cls]
+        # Improvement judged on validation when present, else train.
+        has_valid = self.loader.class_lengths[CLASS_VALID] > 0
+        judge = CLASS_VALID if has_valid else CLASS_TRAIN
+        if cls == judge and acc and acc["samples"]:
+            value = self.normalized_metric(acc)
+            if value < self.best_metric - 1e-12:
+                self.best_metric = value
+                self.best_epoch = self.epoch_number
+                self._epochs_since_best = 0
+                self.improved << True
+            else:
+                self._epochs_since_best += 1
+
+    def normalized_metric(self, acc):
+        return acc["metric"] / max(acc["samples"], 1)
+
+    def _on_epoch_ended(self):
+        self.epoch_ended << True
+        self.last_epoch_metrics = list(self.epoch_metrics)
+        summary = {"epoch": self.epoch_number}
+        for cls in (CLASS_TEST, CLASS_VALID, CLASS_TRAIN):
+            acc = self.epoch_metrics[cls]
+            if acc and acc["samples"]:
+                summary[TRIAGE[cls]] = {
+                    "metric": self.normalized_metric(acc),
+                    "loss": acc["loss"] / acc["samples"],
+                    "samples": acc["samples"],
+                }
+        self.history.append(summary)
+        self.on_epoch_summary(summary)
+        self.epoch_metrics = [None, None, None]
+        self.epoch_number += 1
+        if self.max_epochs is not None \
+                and self.epoch_number >= self.max_epochs:
+            self.complete << True
+        if self._epochs_since_best >= self.fail_iterations:
+            self.complete << True
+
+    def on_epoch_summary(self, summary):
+        parts = ["epoch %d" % summary["epoch"]]
+        for cls in (CLASS_TRAIN, CLASS_VALID, CLASS_TEST):
+            s = summary.get(TRIAGE[cls])
+            if s:
+                parts.append("%s: metric=%.6g loss=%.6g"
+                             % (TRIAGE[cls], s["metric"], s["loss"]))
+        self.info(" | ".join(parts))
+
+    def stop(self):
+        self.complete << True
+
+
+class DecisionGD(DecisionBase):
+    """Classification decision: metric = number of errors (reference
+    ``DecisionGD`` tracks ``n_err`` [U])."""
+
+    def minibatch_metric(self):
+        n_err = int(getattr(self.evaluator, "n_err", 0))
+        return n_err, {"n_err": n_err}
+
+    def normalized_metric(self, acc):
+        # error fraction in [0,1]
+        return acc["metric"] / max(acc["samples"], 1)
+
+
+class DecisionMSE(DecisionBase):
+    """Regression decision: metric = summed MSE (reference
+    ``DecisionMSE`` [U])."""
+
+    def minibatch_metric(self):
+        mse = float(getattr(self.evaluator, "mse",
+                            getattr(self.evaluator, "loss", 0.0)))
+        return mse * int(self.loader.minibatch_size), {}

@@ -1,0 +1,6 @@
+"""Sample workflows (reference ``samples/`` — SURVEY.md §2.8).
+
+Each sample module exposes the reference's entrypoint shape
+``run(load, main)`` (invoked by ``velescli``) plus a direct
+``create_workflow()`` helper used by tests and benchmarks.
+"""

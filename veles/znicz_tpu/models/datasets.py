@@ -1,0 +1,127 @@
+"""Dataset acquisition for the samples.
+
+The reference samples download MNIST/CIFAR from the network [U]. This
+environment has zero egress, so each ``load_*`` looks for the real
+dataset under ``root.common.dirs.datasets`` first and otherwise
+generates a **deterministic synthetic stand-in** with the same shapes
+and class structure (seeded class prototypes + noise). Convergence and
+numpy↔XLA parity — the properties BASELINE.json tracks — are fully
+exercised either way; accuracy numbers on synthetic data are not
+comparable to the real dataset and are labelled as such.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy
+
+from veles import prng
+from veles.config import root
+
+
+# -- real MNIST (idx files), if present -------------------------------------
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, = struct.unpack(">i", f.read(4))
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "i" * ndim, f.read(4 * ndim))
+        data = numpy.frombuffer(f.read(), dtype=numpy.uint8)
+    return data.reshape(shape)
+
+
+def _find_mnist_dir():
+    cands = [os.path.join(root.common.dirs.datasets, "MNIST"),
+             root.common.dirs.datasets]
+    for d in cands:
+        for suffix in ("", ".gz"):
+            if os.path.exists(os.path.join(
+                    d, "train-images-idx3-ubyte" + suffix)):
+                return d
+    return None
+
+
+def load_mnist():
+    """(train_x, train_y, test_x, test_y) floats in [0,1]; real data if
+    on disk, synthetic otherwise."""
+    d = _find_mnist_dir()
+    if d is not None:
+        def rd(stem):
+            for suffix in ("", ".gz"):
+                p = os.path.join(d, stem + suffix)
+                if os.path.exists(p):
+                    return _read_idx(p)
+            raise FileNotFoundError(stem)
+        tx = rd("train-images-idx3-ubyte").astype(numpy.float32) / 255.0
+        ty = rd("train-labels-idx1-ubyte").astype(numpy.int32)
+        vx = rd("t10k-images-idx3-ubyte").astype(numpy.float32) / 255.0
+        vy = rd("t10k-labels-idx1-ubyte").astype(numpy.int32)
+        return tx, ty, vx, vy
+    return synthetic_images(n_train=6000, n_valid=1000,
+                            shape=(28, 28), n_classes=10,
+                            key="mnist_synth")
+
+
+# -- synthetic generators ---------------------------------------------------
+
+def synthetic_images(n_train, n_valid, shape, n_classes, key,
+                     channels=None, noise=0.35):
+    """Class-prototype images + Gaussian noise. Deterministic per key.
+
+    Prototypes are smooth random fields (low-frequency), so nearby
+    pixels correlate like strokes do; classes are linearly separable
+    but not trivially so once noise is added.
+    """
+    gen = prng.get(key)
+    full_shape = shape if channels is None else (channels,) + shape
+    protos = []
+    for _ in range(n_classes):
+        base = gen.normal(0.0, 1.0, full_shape, numpy.float32)
+        protos.append(_smooth(base))
+    protos = numpy.stack(protos)
+
+    def make(n):
+        labels = gen.randint(0, n_classes, n).astype(numpy.int32)
+        x = protos[labels] + gen.normal(
+            0.0, noise, (n,) + protos.shape[1:], numpy.float32)
+        x = (x - x.min()) / max(x.max() - x.min(), 1e-6)
+        return x.astype(numpy.float32), labels
+
+    tx, ty = make(n_train)
+    vx, vy = make(n_valid)
+    return tx, ty, vx, vy
+
+
+def _smooth(img):
+    """Cheap separable box blur ×2 along the trailing two axes."""
+    for axis in (-2, -1):
+        for _ in range(2):
+            img = (numpy.roll(img, 1, axis) + img
+                   + numpy.roll(img, -1, axis)) / 3.0
+    return img
+
+
+def load_cifar10():
+    """(train_x, train_y, test_x, test_y), x in CHW float [0,1]."""
+    d = os.path.join(root.common.dirs.datasets, "cifar-10-batches-bin")
+    if os.path.isdir(d):
+        xs, ys = [], []
+        for i in range(1, 6):
+            x, y = _read_cifar_bin(os.path.join(d, "data_batch_%d.bin" % i))
+            xs.append(x)
+            ys.append(y)
+        tx = numpy.concatenate(xs)
+        ty = numpy.concatenate(ys)
+        vx, vy = _read_cifar_bin(os.path.join(d, "test_batch.bin"))
+        return tx, ty, vx, vy
+    return synthetic_images(n_train=5000, n_valid=1000, shape=(32, 32),
+                            channels=3, n_classes=10, key="cifar_synth")
+
+
+def _read_cifar_bin(path):
+    raw = numpy.fromfile(path, dtype=numpy.uint8).reshape(-1, 3073)
+    labels = raw[:, 0].astype(numpy.int32)
+    images = raw[:, 1:].reshape(-1, 3, 32, 32).astype(numpy.float32) / 255.
+    return images, labels

@@ -1,0 +1,67 @@
+"""MNIST sample: 2-layer MLP (All2AllTanh → All2AllSoftmax).
+
+Rebuild of reference ``samples/MNIST/mnist.py`` + ``mnist_config.py``
+[U] (SURVEY.md §2.8): the acceptance workload for BASELINE config #1
+("samples/MNIST: 2-layer All2All softmax"). Config lives under
+``root.mnist`` and can be overridden from the CLI
+(``velescli ... root.mnist.decision.max_epochs=5``).
+"""
+
+import numpy
+
+from veles.config import root
+from veles.loader.fullbatch import FullBatchLoader
+from veles.znicz_tpu.models import datasets
+from veles.znicz_tpu.standard_workflow import StandardWorkflow
+
+root.mnist.update({
+    "loader": {"minibatch_size": 100},
+    "layers": [
+        {"type": "all2all_tanh",
+         "->": {"output_sample_shape": 100},
+         "<-": {"learning_rate": 0.02, "weights_decay": 0.0,
+                "gradient_moment": 0.5}},
+        {"type": "softmax",
+         "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.02, "weights_decay": 0.0,
+                "gradient_moment": 0.5}},
+    ],
+    "decision": {"max_epochs": 5, "fail_iterations": 50},
+})
+
+
+class MnistLoader(FullBatchLoader):
+    """Flattened-image full-batch loader (real MNIST if on disk, else
+    the deterministic synthetic stand-in — see models/datasets.py)."""
+
+    def load_data(self):
+        tx, ty, vx, vy = datasets.load_mnist()
+        tx = tx.reshape(len(tx), -1)
+        vx = vx.reshape(len(vx), -1)
+        # sample order: [test | valid | train] per loader class layout
+        self.original_data.mem = numpy.concatenate([vx, tx])
+        self.original_labels.mem = numpy.concatenate([vy, ty])
+        self.class_lengths = [0, len(vx), len(tx)]
+
+
+def create_workflow(name="MnistWorkflow"):
+    cfg = root.mnist
+    return StandardWorkflow(
+        None, name=name,
+        layers=cfg.layers,
+        loader_factory=lambda wf: MnistLoader(
+            wf, name="loader",
+            minibatch_size=cfg.loader.minibatch_size),
+        decision_config=cfg.decision.to_dict(),
+    )
+
+
+def run(load, main):
+    """Reference sample entry shape [U]: velescli calls this."""
+    load(StandardWorkflow,
+         layers=root.mnist.layers,
+         loader_factory=lambda wf: MnistLoader(
+             wf, name="loader",
+             minibatch_size=root.mnist.loader.minibatch_size),
+         decision_config=root.mnist.decision.to_dict())
+    main()

@@ -1,0 +1,390 @@
+"""NN base unit families + forward↔gradient registry.
+
+Re-design of znicz ``nn_units.py`` [U] (SURVEY.md §2.4 "NN base units"):
+
+* :class:`Forward` — base for forward-propagation units: owns
+  ``weights``/``bias`` with configurable fillings, ``weights_transposed``
+  and ``include_bias`` knobs.
+* :class:`GradientDescentBase` — base for explicit backward units:
+  learning rate (+ bias multiplier), L1/L2 ``weights_decay``,
+  ``gradient_moment`` momentum, gradient accumulation; emits
+  ``err_input`` for the preceding GD unit.
+* The **MatchingObject registry**: forwards register a config name
+  (``"all2all_tanh"``) and each gradient unit registers which forward it
+  backpropagates, so StandardWorkflow can auto-wire the reversed GD
+  chain (SURVEY.md §2.4 intro).
+* :class:`NNWorkflow` — AcceleratedWorkflow with the canonical slots
+  (loader / forwards / evaluator / decision / gds) of the reference.
+
+DP note (SURVEY.md §2.2): per-unit ``generate_data_for_slave`` /
+``apply_data_from_slave`` weight-averaging hooks live on
+GradientDescentBase, preserving the reference's master↔slave contract
+for the compat layer; the hot path is sharded-batch ``psum`` inside the
+jitted step instead.
+"""
+
+import numpy
+
+from veles import prng
+from veles.accelerated_units import AcceleratedUnit, AcceleratedWorkflow
+from veles.distributable import IDistributable
+from veles.memory import Array
+
+# ---------------------------------------------------------------------------
+# MatchingObject registry (reference: metaclass MatchingObject [U])
+
+_FORWARD_BY_NAME = {}
+_GRADIENT_FOR = {}
+
+
+def forward_unit(name):
+    """Class decorator: register a Forward unit under a config name."""
+    def deco(cls):
+        cls.MAPPING = name
+        _FORWARD_BY_NAME[name] = cls
+        return cls
+    return deco
+
+
+def gradient_for(forward_cls):
+    """Class decorator: register a GD unit as the backward pair of a
+    Forward class."""
+    def deco(cls):
+        cls.FORWARD = forward_cls
+        _GRADIENT_FOR[forward_cls] = cls
+        return cls
+    return deco
+
+
+def forward_by_name(name):
+    try:
+        return _FORWARD_BY_NAME[name]
+    except KeyError:
+        raise KeyError("unknown layer type %r (known: %s)"
+                       % (name, ", ".join(sorted(_FORWARD_BY_NAME))))
+
+
+def gradient_unit_for(forward_cls):
+    for cls in forward_cls.__mro__:
+        if cls in _GRADIENT_FOR:
+            return _GRADIENT_FOR[cls]
+    raise KeyError("no gradient unit registered for %s"
+                   % forward_cls.__name__)
+
+
+def known_layer_types():
+    return sorted(_FORWARD_BY_NAME)
+
+
+# ---------------------------------------------------------------------------
+
+
+class Forward(AcceleratedUnit):
+    """Base forward unit: input → output with optional weights/bias."""
+
+    MAPPING = None
+    PARAMS = ("weights", "bias")
+    #: hint for StandardWorkflow: unit consumes loss gradient chain
+    trainable = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None            # linked from producer
+        self.output = Array()
+        self.weights = Array()
+        self.bias = Array()
+        self.include_bias = kwargs.get("include_bias", True)
+        self.weights_transposed = kwargs.get("weights_transposed", False)
+        self.weights_filling = kwargs.get("weights_filling", "uniform")
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.bias_filling = kwargs.get("bias_filling", "constant")
+        self.bias_stddev = kwargs.get("bias_stddev", 0.0)
+        self.prng = prng.get(kwargs.get("prng_key", "default"))
+
+    # weight materialisation ------------------------------------------
+
+    def fill_array(self, arr, filling, stddev):
+        if filling == "uniform":
+            bound = stddev * numpy.sqrt(3.0)
+            self.prng.fill_uniform(arr.mem, -bound, bound)
+        elif filling == "gaussian":
+            self.prng.fill_normal(arr.mem, 0.0, stddev)
+        elif filling == "constant":
+            arr.mem[...] = stddev
+        else:
+            raise ValueError("unknown filling %r" % filling)
+
+    def default_weights_stddev(self, fan_in, fan_out):
+        # Glorot scale: keeps activations in range across depths.
+        return float(numpy.sqrt(2.0 / (fan_in + fan_out)))
+
+    def init_weights(self, w_shape, fan_in, fan_out):
+        stddev = self.weights_stddev or \
+            self.default_weights_stddev(fan_in, fan_out)
+        if not self.weights or self.weights.shape != tuple(w_shape):
+            self.weights.reset(numpy.zeros(w_shape, numpy.float32))
+            self.fill_array(self.weights, self.weights_filling, stddev)
+        if self.include_bias and (
+                not self.bias or self.bias.shape != (fan_out,)):
+            self.bias.reset(numpy.zeros(fan_out, numpy.float32))
+            if self.bias_filling != "constant" or self.bias_stddev:
+                self.fill_array(self.bias, self.bias_filling,
+                                self.bias_stddev or 0.01)
+
+    @property
+    def batch_size(self):
+        return self.input.shape[0]
+
+    def output_shape_for(self, input_shape):
+        """Static shape inference; subclasses override."""
+        raise NotImplementedError
+
+
+class GradientDescentBase(AcceleratedUnit, IDistributable):
+    """Base backward unit: err_output → err_input + parameter update.
+
+    Update rule (reference semantics [U], SURVEY.md §2.4 "FC backward"):
+    ``grad += l2 * (1-l1_vs_l2) * W + l1 * l1_vs_l2 * sign(W)``;
+    ``vel = moment * vel - lr * grad``; ``W += vel``. Separate lr /
+    decay / moment multipliers for bias.
+    """
+
+    FORWARD = None
+    STATE = ("vel_weights", "vel_bias", "acc_weights", "acc_bias",
+             "acc_count")
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.err_output = None       # linked from the unit after us
+        self.err_input = Array()     # produced for the unit before us
+        self.forward = None          # paired Forward unit
+        self.need_err_input = kwargs.get("need_err_input", True)
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get(
+            "learning_rate_bias", self.learning_rate)
+        self.weights_decay = kwargs.get("weights_decay", 0.0)
+        self.weights_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        self.l1_vs_l2 = kwargs.get("l1_vs_l2", 0.0)
+        self.l1_vs_l2_bias = kwargs.get("l1_vs_l2_bias", self.l1_vs_l2)
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        self.gradient_moment_bias = kwargs.get(
+            "gradient_moment_bias", self.gradient_moment)
+        #: accumulate gradients over N steps before applying
+        self.accumulate_gradient = int(kwargs.get("accumulate_gradient", 1))
+        self.vel_weights = Array()
+        self.vel_bias = Array()
+        self.acc_weights = Array()
+        self.acc_bias = Array()
+        self.acc_count = Array()
+
+    # pairing ----------------------------------------------------------
+
+    def setup_forward(self, forward):
+        """Bind to the paired forward unit (weights/input/output access)."""
+        self.forward = forward
+        return self
+
+    @property
+    def include_bias(self):
+        return self.forward.include_bias
+
+    @property
+    def weights_transposed(self):
+        return self.forward.weights_transposed
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        if self.forward is None:
+            raise ValueError("%s: setup_forward() not called" % self.name)
+        f = self.forward
+        if f.weights and (not self.vel_weights
+                          or self.vel_weights.shape != f.weights.shape):
+            self.vel_weights.reset(
+                numpy.zeros_like(f.weights.mem))
+        if f.include_bias and f.bias and (
+                not self.vel_bias
+                or self.vel_bias.shape != f.bias.shape):
+            self.vel_bias.reset(numpy.zeros_like(f.bias.mem))
+        if self.need_err_input and f.input is not None \
+                and getattr(f.input, "shape", None):
+            if not self.err_input \
+                    or self.err_input.shape != f.input.shape:
+                self.err_input.reset(
+                    numpy.zeros(f.input.shape, numpy.float32))
+        if self.accumulate_gradient > 1:
+            if f.weights and not self.acc_weights:
+                self.acc_weights.reset(numpy.zeros_like(f.weights.mem))
+            if f.include_bias and f.bias and not self.acc_bias:
+                self.acc_bias.reset(numpy.zeros_like(f.bias.mem))
+            if not self.acc_count:
+                self.acc_count.reset(numpy.zeros((), numpy.int32))
+
+    # hyper-parameters (traced scalars; changing them never retraces) --
+
+    def hyperparams(self):
+        return {
+            "lr": numpy.float32(self.learning_rate),
+            "lr_bias": numpy.float32(self.learning_rate_bias),
+            "l2": numpy.float32(self.weights_decay),
+            "l2_bias": numpy.float32(self.weights_decay_bias),
+            "l1_vs_l2": numpy.float32(self.l1_vs_l2),
+            "l1_vs_l2_bias": numpy.float32(self.l1_vs_l2_bias),
+            "moment": numpy.float32(self.gradient_moment),
+            "moment_bias": numpy.float32(self.gradient_moment_bias),
+        }
+
+    # shared update math (xp = numpy or jax.numpy) ---------------------
+
+    @staticmethod
+    def apply_update(xp, w, vel, grad, lr, moment, l2, l1_vs_l2):
+        reg = grad + w * (l2 * (1.0 - l1_vs_l2)) \
+            + xp.sign(w) * (l2 * l1_vs_l2)
+        vel = vel * moment - lr * reg
+        return w + vel, vel
+
+    def _step_param(self, xp, w, vel, acc, grad, apply_now,
+                    lr, moment, l2, l1_vs_l2):
+        """One (possibly accumulated) parameter step. With gradient
+        accumulation, the update applies only when ``apply_now`` and
+        the accumulator resets; otherwise the gradient just adds up.
+        Returns (w, vel, acc)."""
+        if acc is None:
+            nw, nv = self.apply_update(xp, w, vel, grad, lr, moment,
+                                       l2, l1_vs_l2)
+            return nw, nv, None
+        acc = acc + grad
+        nw, nv = self.apply_update(xp, w, vel, acc, lr, moment,
+                                   l2, l1_vs_l2)
+        w = xp.where(apply_now, nw, w)
+        vel = xp.where(apply_now, nv, vel)
+        acc = xp.where(apply_now, xp.zeros_like(acc), acc)
+        return w, vel, acc
+
+    # numpy oracle update ---------------------------------------------
+
+    def update_weights_numpy(self, grad_w, grad_b):
+        f = self.forward
+        accumulating = self.accumulate_gradient > 1
+        apply_now = True
+        acc_w = acc_b = None
+        if accumulating:
+            self.acc_count.map_write()
+            count = int(self.acc_count.mem) + 1
+            apply_now = count >= self.accumulate_gradient
+            self.acc_count.mem[...] = 0 if apply_now else count
+            acc_w = self.acc_weights.map_write().mem
+        f.weights.map_write()
+        self.vel_weights.map_write()
+        w, vel, acc = self._step_param(
+            numpy, f.weights.mem, self.vel_weights.mem, acc_w, grad_w,
+            apply_now, self.learning_rate, self.gradient_moment,
+            self.weights_decay, self.l1_vs_l2)
+        f.weights.mem[...] = w
+        self.vel_weights.mem[...] = vel
+        if acc is not None:
+            self.acc_weights.mem[...] = acc
+        if f.include_bias and grad_b is not None:
+            if accumulating:
+                acc_b = self.acc_bias.map_write().mem
+            f.bias.map_write()
+            self.vel_bias.map_write()
+            b, velb, accb = self._step_param(
+                numpy, f.bias.mem, self.vel_bias.mem, acc_b, grad_b,
+                apply_now, self.learning_rate_bias,
+                self.gradient_moment_bias, self.weights_decay_bias,
+                self.l1_vs_l2_bias)
+            f.bias.mem[...] = b
+            self.vel_bias.mem[...] = velb
+            if accb is not None:
+                self.acc_bias.mem[...] = accb
+
+    # traced update ----------------------------------------------------
+
+    def update_weights_xla(self, ctx, grad_w, grad_b):
+        import jax.numpy as jnp
+        f = self.forward
+        h = ctx.hyper[self.name]
+        params = ctx.unit_params(f)
+        state = ctx.unit_state(self)
+        accumulating = self.accumulate_gradient > 1
+        apply_now = True
+        acc_w = acc_b = None
+        if accumulating:
+            count = state["acc_count"] + 1
+            apply_now = count >= self.accumulate_gradient
+            ctx.update_state(
+                self, acc_count=jnp.where(apply_now, 0, count)
+                .astype(jnp.int32))
+            acc_w = state["acc_weights"]
+        w, vel = params["weights"], state["vel_weights"]
+        grad_w = ctx.pmean(grad_w)
+        w, vel, acc = self._step_param(
+            jnp, w, vel, acc_w, grad_w.astype(w.dtype), apply_now,
+            h["lr"], h["moment"], h["l2"], h["l1_vs_l2"])
+        ctx.update_params(f, weights=w)
+        ctx.update_state(self, vel_weights=vel)
+        if acc is not None:
+            ctx.update_state(self, acc_weights=acc)
+        if f.include_bias and grad_b is not None:
+            if accumulating:
+                acc_b = state["acc_bias"]
+            b, velb = params["bias"], state["vel_bias"]
+            grad_b = ctx.pmean(grad_b)
+            b, velb, accb = self._step_param(
+                jnp, b, velb, acc_b, grad_b.astype(b.dtype), apply_now,
+                h["lr_bias"], h["moment_bias"], h["l2_bias"],
+                h["l1_vs_l2_bias"])
+            ctx.update_params(f, bias=b)
+            ctx.update_state(self, vel_bias=velb)
+            if accb is not None:
+                ctx.update_state(self, acc_bias=accb)
+
+    # IDistributable compat layer (SURVEY.md §2.2) ---------------------
+
+    def generate_data_for_slave(self, slave=None):
+        f = self.forward
+        out = {"weights": numpy.array(f.weights.map_read().mem)}
+        if f.include_bias and f.bias:
+            out["bias"] = numpy.array(f.bias.map_read().mem)
+        return out
+
+    def apply_data_from_master(self, data):
+        if not data:
+            return
+        f = self.forward
+        f.weights.map_write()
+        f.weights.mem[...] = data["weights"]
+        if "bias" in data and f.bias:
+            f.bias.map_write()
+            f.bias.mem[...] = data["bias"]
+
+    def generate_data_for_master(self):
+        return self.generate_data_for_slave()
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Asynchronous parameter averaging (reference semantics [U]):
+        master's canonical weights move halfway toward the slave's."""
+        if not data:
+            return
+        f = self.forward
+        f.weights.map_write()
+        f.weights.mem[...] = 0.5 * (f.weights.mem + data["weights"])
+        if "bias" in data and f.bias:
+            f.bias.map_write()
+            f.bias.mem[...] = 0.5 * (f.bias.mem + data["bias"])
+
+
+class NNWorkflow(AcceleratedWorkflow):
+    """Workflow with the canonical NN slots (reference ``NNWorkflow``
+    [U]): loader → forwards → evaluator → decision → gds cycle."""
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.loader = None
+        self.forwards = []
+        self.evaluator = None
+        self.decision = None
+        self.gds = []
+        self.repeater = None
+        self.snapshotter = None
+        self.xla_step = None

@@ -1,0 +1,18 @@
+"""The znicz unit zoo (SURVEY.md §2.4).
+
+Importing this package registers every forward/gradient unit pair in
+the MatchingObject registry, so ``StandardWorkflow`` layer types
+resolve. Modules mirror the reference file layout (``all2all.py``,
+``gd.py``, ``conv.py``, ...) with TPU-native internals.
+"""
+
+from veles.znicz_tpu.ops.all2all import (  # noqa: F401
+    All2All, All2AllTanh, All2AllRELU, All2AllStrictRELU,
+    All2AllSigmoid, All2AllSoftmax,
+)
+from veles.znicz_tpu.ops.gd import (  # noqa: F401
+    GradientDescent, GDTanh, GDRELU, GDStrictRELU, GDSigmoid, GDSoftmax,
+)
+from veles.znicz_tpu.ops.evaluator import (  # noqa: F401
+    EvaluatorBase, EvaluatorSoftmax, EvaluatorMSE,
+)

@@ -1,0 +1,79 @@
+"""Activation function library shared by forward and backward units.
+
+The reference implements these as macro snippets included into every
+kernel (SURVEY.md §2.5 "defines.cl-style macro header"); here they are
+plain array functions generic over the array module ``xp`` (numpy for
+the oracle, jax.numpy traced), so each forward unit and its GD pair use
+literally the same formula on both backends.
+
+Derivatives are expressed **in terms of the forward output** ``y`` (the
+reference convention — backward kernels only keep the output around):
+
+* tanh:   y = 1.7159·tanh(2/3·x)        dy/dx = ab − (b/a)·y²
+* relu:   y = log(1+eˣ)  ("soft" relu)  dy/dx = 1 − e^{−y}
+* strict: y = max(0,x)                  dy/dx = 1[y>0]
+* sigmoid: y = σ(x)                     dy/dx = y·(1−y)
+"""
+
+TANH_A = 1.7159
+TANH_B = 2.0 / 3.0
+
+
+def linear(xp, v):
+    return v
+
+
+def dlinear(xp, y):
+    return 1.0
+
+
+def tanh(xp, v):
+    return TANH_A * xp.tanh(TANH_B * v)
+
+
+def dtanh(xp, y):
+    return (TANH_A * TANH_B) - (TANH_B / TANH_A) * y * y
+
+
+def softrelu(xp, v):
+    # log(1+exp(v)) without overflow
+    return xp.logaddexp(0.0, v)
+
+
+def dsoftrelu(xp, y):
+    return 1.0 - xp.exp(-y)
+
+
+def strict_relu(xp, v):
+    return xp.maximum(v, 0.0)
+
+
+def dstrict_relu(xp, y):
+    return (y > 0.0).astype(y.dtype)
+
+
+def sigmoid(xp, v):
+    # 0.5*(tanh(v/2)+1): overflow-safe in numpy and jnp alike
+    return 0.5 * (xp.tanh(0.5 * v) + 1.0)
+
+
+def dsigmoid(xp, y):
+    return y * (1.0 - y)
+
+
+def softmax(xp, v):
+    e = xp.exp(v - xp.max(v, axis=-1, keepdims=True))
+    return e / xp.sum(e, axis=-1, keepdims=True)
+
+
+#: name -> (forward(xp, v), derivative_by_output(xp, y))
+ACTIVATIONS = {
+    "linear": (linear, dlinear),
+    "tanh": (tanh, dtanh),
+    "relu": (softrelu, dsoftrelu),
+    "strict_relu": (strict_relu, dstrict_relu),
+    "sigmoid": (sigmoid, dsigmoid),
+    # softmax derivative is fused with cross-entropy in the evaluator:
+    # GDSoftmax passes err through untouched (SURVEY.md §2.4 "FC backward")
+    "softmax": (softmax, dlinear),
+}

@@ -1,0 +1,135 @@
+"""Fully-connected forward units.
+
+Re-design of znicz ``all2all.py`` [U] (SURVEY.md §2.4
+"Fully-connected"): dense layer ± fused activation. The reference hand
+-tiles a GEMM kernel per device; here the layer is one
+``jnp.matmul`` (+ activation) that XLA maps onto the MXU and fuses with
+neighbours — the whole point of the TPU redesign (SURVEY.md §2.5
+"TPU equivalent").
+
+Weights layout: ``(input_features, neurons)`` by default;
+``weights_transposed=True`` stores ``(neurons, input_features)``
+(reference option, needed by deconv-style tying).
+"""
+
+import numpy
+
+from veles.memory import Array
+from veles.znicz_tpu.nn_units import Forward, forward_unit
+from veles.znicz_tpu.ops import activations as A
+
+
+class All2AllBase(Forward):
+    """Dense layer: output = act(input·W + b)."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, output_sample_shape=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if output_sample_shape is None:
+            raise ValueError("%s needs output_sample_shape (neuron count)"
+                             % type(self).__name__)
+        if isinstance(output_sample_shape, int):
+            output_sample_shape = (output_sample_shape,)
+        self.output_sample_shape = tuple(output_sample_shape)
+        self.neurons = int(numpy.prod(self.output_sample_shape))
+
+    # -- shape/param setup --------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        ishape = self.input.shape
+        fan_in = int(numpy.prod(ishape[1:]))
+        w_shape = (self.neurons, fan_in) if self.weights_transposed \
+            else (fan_in, self.neurons)
+        self.init_weights(w_shape, fan_in, self.neurons)
+        oshape = (ishape[0],) + self.output_sample_shape
+        if not self.output or self.output.shape != oshape:
+            self.output.reset(numpy.zeros(oshape, numpy.float32))
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0],) + self.output_sample_shape
+
+    # -- math shared by both backends ---------------------------------
+
+    def _forward(self, xp, x, w, b, dot):
+        x2 = x.reshape(x.shape[0], -1)
+        v = dot(x2, w.T if self.weights_transposed else w)
+        if self.include_bias:
+            v = v + b
+        y = A.ACTIVATIONS[self.ACTIVATION][0](xp, v)
+        return y.reshape((x.shape[0],) + self.output_sample_shape)
+
+    # -- oracle --------------------------------------------------------
+
+    def numpy_run(self):
+        x = self.input.map_read().mem
+        w = self.weights.map_read().mem
+        b = self.bias.map_read().mem if self.include_bias else None
+        self.output.map_invalidate()
+        self.output.mem[...] = self._forward(
+            numpy, x.astype(numpy.float32), w, b, numpy.matmul)
+
+    # -- traced --------------------------------------------------------
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        p = ctx.unit_params(self)
+        y = self._forward(jnp, x, p["weights"], p.get("bias"), ctx.dot)
+        ctx.set(self, "output", y.astype(jnp.float32))
+
+
+@forward_unit("all2all")
+class All2All(All2AllBase):
+    ACTIVATION = "linear"
+
+
+@forward_unit("all2all_tanh")
+class All2AllTanh(All2AllBase):
+    ACTIVATION = "tanh"
+
+
+@forward_unit("all2all_relu")
+class All2AllRELU(All2AllBase):
+    ACTIVATION = "relu"
+
+
+@forward_unit("all2all_str")
+class All2AllStrictRELU(All2AllBase):
+    ACTIVATION = "strict_relu"
+
+
+@forward_unit("all2all_sigmoid")
+class All2AllSigmoid(All2AllBase):
+    ACTIVATION = "sigmoid"
+
+
+@forward_unit("softmax")
+class All2AllSoftmax(All2AllBase):
+    """Dense + softmax; also records the argmax for accuracy counting
+    (reference ``max_idx`` [U])."""
+
+    ACTIVATION = "softmax"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.max_idx = Array()
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if not self.max_idx or self.max_idx.shape != (self.input.shape[0],):
+            self.max_idx.reset(
+                numpy.zeros(self.input.shape[0], numpy.int32))
+
+    def numpy_run(self):
+        super().numpy_run()
+        self.max_idx.map_invalidate()
+        self.max_idx.mem[...] = numpy.argmax(self.output.mem, axis=-1)
+
+    def xla_run(self, ctx):
+        super().xla_run(ctx)
+        import jax.numpy as jnp
+        y = ctx.get(self, "output")
+        ctx.set(self, "max_idx",
+                jnp.argmax(y, axis=-1).astype(jnp.int32))

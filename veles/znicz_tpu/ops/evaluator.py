@@ -1,0 +1,149 @@
+"""Loss evaluator units.
+
+Re-design of znicz ``evaluator.py`` [U] (SURVEY.md §2.4 "Evaluators"):
+
+* :class:`EvaluatorSoftmax` — consumes softmax probabilities + integer
+  labels; emits the fused softmax+CE gradient ``err_output =
+  (p − onehot)/batch``, the minibatch wrong-count ``n_err``, the mean
+  cross-entropy ``loss`` and (optionally) a confusion matrix.
+* :class:`EvaluatorMSE` — consumes any output + a target array; emits
+  ``err_output = 2(y−t)/batch`` and per-minibatch MSE metrics.
+
+Padding contract (see ``veles/loader``): rows ≥ ``batch_size`` (the
+true count) are masked out of both the gradient and the metrics, so
+XLA static shapes and the numpy oracle agree exactly.
+"""
+
+import numpy
+
+from veles.accelerated_units import AcceleratedUnit
+from veles.memory import Array
+
+
+class EvaluatorBase(AcceleratedUnit):
+    """Common attrs: input (net output), err_output, batch_size."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None           # linked: last forward's output
+        self.err_output = Array()   # gradient seed for the GD chain
+        self.batch_size = None      # linked: loader.minibatch_size
+        #: host metrics for Decision
+        self.loss = 0.0
+        self.n_err = 0
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        ishape = self.input.shape
+        if not self.err_output or self.err_output.shape != ishape:
+            self.err_output.reset(numpy.zeros(ishape, numpy.float32))
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Fused softmax + cross-entropy loss."""
+
+    def __init__(self, workflow, compute_confusion=False, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.labels = None          # linked: loader.minibatch_labels
+        self.max_idx = None         # linked: softmax unit's argmax
+        self.compute_confusion = compute_confusion
+        self.confusion_matrix = Array()
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        n_classes = self.input.shape[-1]
+        if self.compute_confusion and (
+                not self.confusion_matrix
+                or self.confusion_matrix.shape != (n_classes, n_classes)):
+            self.confusion_matrix.reset(
+                numpy.zeros((n_classes, n_classes), numpy.int32))
+
+    # shared math ------------------------------------------------------
+
+    def _compute(self, xp, probs, labels, max_idx, valid):
+        b, n_classes = probs.shape
+        mask = (xp.arange(b) < valid)
+        fmask = mask.astype(probs.dtype)
+        onehot = (labels[:, None] ==
+                  xp.arange(n_classes)[None, :]).astype(probs.dtype)
+        err = (probs - onehot) * fmask[:, None] / valid.astype(probs.dtype)
+        p_true = xp.sum(probs * onehot, axis=-1)
+        logp = xp.log(xp.maximum(p_true, 1e-30))
+        loss = -xp.sum(logp * fmask) / valid.astype(probs.dtype)
+        wrong = xp.sum((max_idx != labels) & mask)
+        return err, loss, wrong
+
+    # oracle -----------------------------------------------------------
+
+    def numpy_run(self):
+        probs = self.input.map_read().mem
+        labels = numpy.asarray(self.labels.map_read().mem, numpy.int32)
+        max_idx = numpy.argmax(probs, axis=-1).astype(numpy.int32)
+        valid = numpy.int32(int(self.batch_size))
+        err, loss, wrong = self._compute(
+            numpy, probs.astype(numpy.float32), labels, max_idx, valid)
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = err
+        self.loss = float(loss)
+        self.n_err = int(wrong)
+        if self.compute_confusion:
+            self.confusion_matrix.map_write()
+            m = self.confusion_matrix.mem
+            for i in range(int(valid)):
+                m[max_idx[i], labels[i]] += 1
+
+    # traced -----------------------------------------------------------
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        probs = ctx.get(self, "input")
+        labels = ctx.get(self, "labels").astype(jnp.int32)
+        max_idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+        valid = ctx.get(self, "batch_size")  # traced int scalar
+        err, loss, wrong = self._compute(
+            jnp, probs, labels, max_idx, valid)
+        ctx.set(self, "err_output", err)
+        ctx.export("loss", loss)
+        ctx.export("n_err", wrong.astype(jnp.int32))
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error loss vs a target array."""
+
+    def __init__(self, workflow, root_metric=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.target = None          # linked: loader.minibatch_targets
+        self.root_metric = root_metric
+        self.mse = 0.0
+
+    def _compute(self, xp, y, t, valid):
+        b = y.shape[0]
+        y2 = y.reshape(b, -1)
+        t2 = t.reshape(b, -1)
+        fmask = (xp.arange(b) < valid).astype(y2.dtype)
+        diff = (y2 - t2) * fmask[:, None]
+        err = 2.0 * diff / valid.astype(y2.dtype)
+        per_sample = xp.mean(diff * diff, axis=1)
+        mse = xp.sum(per_sample) / valid.astype(y2.dtype)
+        return err, mse
+
+    def numpy_run(self):
+        y = self.input.map_read().mem.astype(numpy.float32)
+        t = self.target.map_read().mem.astype(numpy.float32)
+        valid = numpy.float32(int(self.batch_size))
+        err, mse = self._compute(numpy, y, t, valid)
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = err.reshape(self.err_output.shape)
+        self.mse = float(mse)
+        self.loss = float(mse)
+        self.n_err = 0
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        y = ctx.get(self, "input")
+        t = ctx.get(self, "target")
+        valid = ctx.get(self, "batch_size").astype(jnp.float32)
+        err, mse = self._compute(jnp, y, t, valid)
+        ctx.set(self, "err_output", err.reshape(y.shape))
+        ctx.export("loss", mse)
+        ctx.export("n_err", jnp.int32(0))

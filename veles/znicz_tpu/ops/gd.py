@@ -1,0 +1,110 @@
+"""Gradient-descent units for fully-connected layers.
+
+Re-design of znicz ``gd.py`` [U] (SURVEY.md §2.4 "FC backward"):
+given ``err_output`` (dL/d output) the unit
+
+1. multiplies by the activation derivative expressed via the forward
+   output (``err ∘ act'(y)``) → dL/dz;
+2. emits ``err_input = dL/dz · Wᵀ`` for the preceding GD unit;
+3. computes ``ΔW = xᵀ · dL/dz``, ``Δb = Σ dL/dz`` and applies the
+   momentum/decay update from :class:`GradientDescentBase`.
+
+``GDSoftmax`` is the fused softmax+cross-entropy backward: the
+evaluator already emitted dL/dz, so the derivative step is the identity
+(reference behaviour [U]).
+
+Both backends share the same formulas; the traced path uses
+``ctx.dot`` (bfloat16 MXU matmuls, f32 accumulation).
+"""
+
+import numpy
+
+from veles.znicz_tpu.nn_units import GradientDescentBase, gradient_for
+from veles.znicz_tpu.ops import activations as A
+from veles.znicz_tpu.ops.all2all import (
+    All2All, All2AllTanh, All2AllRELU, All2AllStrictRELU,
+    All2AllSigmoid, All2AllSoftmax,
+)
+
+
+class GDBase(GradientDescentBase):
+    """Shared math for dense backward units."""
+
+    ACTIVATION = "linear"
+
+    def _deriv(self, xp, err, y):
+        d = A.ACTIVATIONS[self.ACTIVATION][1](xp, y)
+        if isinstance(d, float):  # linear / softmax pass-through
+            return err
+        return err * d
+
+    # -- oracle --------------------------------------------------------
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        y = f.output.map_read().mem
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32)
+        err = err.reshape(err.shape[0], -1)
+        dz = self._deriv(numpy, err, y.reshape(err.shape))
+        w = f.weights.map_read().mem
+        x2 = x.reshape(x.shape[0], -1)
+        if self.need_err_input:
+            ei = dz @ (w if self.weights_transposed else w.T)
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = ei.reshape(f.input.shape)
+        grad_w = dz.T @ x2 if self.weights_transposed else x2.T @ dz
+        grad_b = dz.sum(axis=0) if self.include_bias else None
+        self.update_weights_numpy(grad_w, grad_b)
+
+    # -- traced --------------------------------------------------------
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        y = ctx.get(f, "output")
+        err = ctx.get(self, "err_output")
+        err = err.reshape(err.shape[0], -1)
+        dz = self._deriv(jnp, err, y.reshape(err.shape))
+        w = ctx.unit_params(f)["weights"]
+        x2 = x.reshape(x.shape[0], -1)
+        if self.need_err_input:
+            ei = ctx.dot(dz, w if self.weights_transposed else w.T)
+            ctx.set(self, "err_input", ei.reshape(x.shape))
+        grad_w = ctx.dot(dz.T, x2) if self.weights_transposed \
+            else ctx.dot(x2.T, dz)
+        grad_b = dz.sum(axis=0) if self.include_bias else None
+        self.update_weights_xla(ctx, grad_w, grad_b)
+
+
+@gradient_for(All2All)
+class GradientDescent(GDBase):
+    ACTIVATION = "linear"
+
+
+@gradient_for(All2AllTanh)
+class GDTanh(GDBase):
+    ACTIVATION = "tanh"
+
+
+@gradient_for(All2AllRELU)
+class GDRELU(GDBase):
+    ACTIVATION = "relu"
+
+
+@gradient_for(All2AllStrictRELU)
+class GDStrictRELU(GDBase):
+    ACTIVATION = "strict_relu"
+
+
+@gradient_for(All2AllSigmoid)
+class GDSigmoid(GDBase):
+    ACTIVATION = "sigmoid"
+
+
+@gradient_for(All2AllSoftmax)
+class GDSoftmax(GDBase):
+    """Fused softmax+CE backward: err passes through (see module doc)."""
+    ACTIVATION = "softmax"

@@ -1,0 +1,200 @@
+"""StandardWorkflow — the config-driven graph builder.
+
+Re-design of znicz ``standard_workflow.py`` [U] (SURVEY.md §2.4
+"StandardWorkflow"): builds the canonical training graph from a
+``layers`` list:
+
+    layers=[{"type": "all2all_tanh", "->": {...fwd kwargs...},
+             "<-": {...gd kwargs...}}, ...]
+
+(ints are shorthand: hidden all2all_tanh, final softmax). Auto-creates
+forwards via the MatchingObject registry, the evaluator matching the
+last layer, the Decision, and the reversed GD chain; wires the training
+cycle
+
+    start → repeater → loader → forwards… → evaluator → decision
+          → gds (reverse) → repeater,  decision.complete → end
+
+On an XLA device the graph is re-wired at initialize time so the whole
+accelerated body runs as ONE compiled step (see
+``veles/znicz_tpu/xla_step.py``):
+
+    start → repeater → loader → xla_step → decision → repeater
+"""
+
+from veles.backends import get_device
+from veles.units import Repeater
+from veles.znicz_tpu.decision import DecisionGD, DecisionMSE
+from veles.znicz_tpu.nn_units import (
+    NNWorkflow, forward_by_name, gradient_unit_for)
+from veles.znicz_tpu.ops.all2all import All2AllSoftmax
+from veles.znicz_tpu.ops.evaluator import EvaluatorSoftmax, EvaluatorMSE
+from veles.znicz_tpu.xla_step import XLAStep
+
+
+def normalize_layers(layers):
+    """Expand int shorthands into layer dicts."""
+    out = []
+    for i, layer in enumerate(layers):
+        if isinstance(layer, int):
+            kind = "softmax" if i == len(layers) - 1 else "all2all_tanh"
+            layer = {"type": kind, "->": {"output_sample_shape": layer}}
+        out.append(dict(layer))
+    return out
+
+
+class StandardWorkflowBase(NNWorkflow):
+    """Builds forwards from a layers config; subclasses add the rest."""
+
+    def __init__(self, workflow=None, layers=None, loader_factory=None,
+                 decision_config=None, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.layers_config = normalize_layers(layers or [])
+        self.loader_factory = loader_factory
+        self.decision_config = dict(decision_config or {})
+
+    # -- builders (each mirrors a reference link_* method [U]) ---------
+
+    def link_repeater(self):
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+        return self.repeater
+
+    def link_loader(self):
+        if self.loader_factory is None:
+            raise ValueError("no loader_factory given")
+        self.loader = self.loader_factory(self)
+        self.loader.link_from(self.repeater)
+        return self.loader
+
+    def link_forwards(self, src_unit=None, src_attr="minibatch_data"):
+        src = src_unit or self.loader
+        prev_unit, prev_attr = src, src_attr
+        for spec in self.layers_config:
+            cls = forward_by_name(spec["type"])
+            fwd = cls(self, **spec.get("->", {}))
+            fwd.link_from(prev_unit)
+            fwd.link_attrs(prev_unit, ("input", prev_attr))
+            self.forwards.append(fwd)
+            prev_unit, prev_attr = fwd, "output"
+        return self.forwards
+
+    def link_evaluator(self):
+        last = self.forwards[-1]
+        if isinstance(last, All2AllSoftmax):
+            ev = EvaluatorSoftmax(self, name="evaluator")
+            ev.link_attrs(last, ("input", "output"), "max_idx")
+            ev.link_attrs(self.loader,
+                          ("labels", "minibatch_labels"),
+                          ("batch_size", "minibatch_size"))
+        else:
+            ev = EvaluatorMSE(self, name="evaluator")
+            ev.link_attrs(last, ("input", "output"))
+            ev.link_attrs(self.loader,
+                          ("target", "minibatch_targets"),
+                          ("batch_size", "minibatch_size"))
+        ev.link_from(last)
+        self.evaluator = ev
+        return ev
+
+    def link_decision(self):
+        cls = DecisionGD if isinstance(self.evaluator, EvaluatorSoftmax) \
+            else DecisionMSE
+        self.decision = cls(self, name="decision", **self.decision_config)
+        self.decision.link_loader_evaluator(self.loader, self.evaluator)
+        self.decision.link_from(self.evaluator)
+        return self.decision
+
+    def link_gds(self):
+        """Create the reversed gradient chain; gds[i] pairs
+        forwards[i]."""
+        self.gds = [None] * len(self.forwards)
+        prev = self.decision
+        for i in reversed(range(len(self.forwards))):
+            fwd = self.forwards[i]
+            spec = self.layers_config[i]
+            cls = gradient_unit_for(type(fwd))
+            gd = cls(self, need_err_input=(i > 0), **spec.get("<-", {}))
+            gd.setup_forward(fwd)
+            if i == len(self.forwards) - 1:
+                gd.link_attrs(self.evaluator, "err_output")
+            else:
+                gd.link_attrs(self.gds[i + 1],
+                              ("err_output", "err_input"))
+            gd.link_from(prev)
+            # GD runs only on train minibatches, and not once complete.
+            gd.gate_skip = ~self.loader.train_phase | \
+                self.decision.complete
+            self.gds[i] = gd
+            prev = gd
+        self.repeater.link_from(prev)
+        return self.gds
+
+    def link_end_point(self):
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+        return self.end_point
+
+    def create_workflow(self):
+        self.link_repeater()
+        self.link_loader()
+        self.link_forwards()
+        self.link_evaluator()
+        self.link_decision()
+        self.link_gds()
+        self.link_end_point()
+        return self
+
+    # -- XLA rewiring ---------------------------------------------------
+
+    def _rewire_xla(self):
+        """Replace per-unit execution of the accelerated body with the
+        fused XLAStep (SURVEY.md §7 design stance)."""
+        step = XLAStep(self, loader=self.loader, forwards=self.forwards,
+                       evaluator=self.evaluator, gds=self.gds,
+                       name="xla_step")
+        for u in self.forwards + [self.evaluator] + self.gds:
+            u.unlink_all()
+        step.link_from(self.loader)
+        self.decision.link_from(step)
+        self.repeater.link_from(self.decision)
+        self.xla_step = step
+        return step
+
+    # -- initialization -------------------------------------------------
+
+    def initialize(self, device=None, snapshot=False, **kwargs):
+        """Slot-ordered init (loader first so shapes resolve), then the
+        XLA rewire + step compiler when on an XLA device."""
+        self.device = get_device(device)
+        if self.on_xla and self.xla_step is None and self.forwards:
+            self._rewire_xla()
+        ordered = [self.repeater, self.loader] + self.forwards
+        if self.evaluator is not None:
+            ordered.append(self.evaluator)
+        ordered += [g for g in self.gds if g is not None]
+        if self.decision is not None:
+            ordered.append(self.decision)
+        if self.xla_step is not None:
+            ordered.append(self.xla_step)
+        seen = set(id(u) for u in ordered)
+        rest = [u for u in self._units
+                if id(u) not in seen and u is not self]
+        self._initialized = True
+        for unit in ordered + rest:
+            unit.initialize(device=self.device, **kwargs)
+        return ordered + rest
+
+    def run(self):
+        super().run()
+        if self.xla_step is not None:
+            self.xla_step.sync_host()
+
+
+class StandardWorkflow(StandardWorkflowBase):
+    """The batteries-included variant: builds the full graph in the
+    constructor, as every reference sample expects [U]."""
+
+    def __init__(self, workflow=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.create_workflow()

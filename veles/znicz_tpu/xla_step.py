@@ -1,0 +1,164 @@
+"""XLAStep — the unit that executes the compiled training step.
+
+This is the keystone of the TPU redesign (SURVEY.md §7 design stance &
+stage 2). On the numpy backend the workflow executes units one-by-one;
+on the XLA backend the whole accelerated cycle body (forwards →
+evaluator → reversed GD chain) is traced ONCE by
+:class:`veles.accelerated_units.StepCompiler` into a single jitted
+``step(params, state, batch, hyper, key)`` with donated buffers, and
+this unit replaces those units in the running graph:
+
+    repeater → loader → **XLAStep** → decision → repeater
+
+Parameters stay device-resident across steps (no host round-trips;
+contrast the reference's per-unit map/unmap in SURVEY.md §3.2); the
+loader's padded minibatch is placed onto the mesh with a batch
+sharding, so data parallelism falls out of XLA auto-partitioning with
+collectives over ICI.
+"""
+
+import numpy
+
+from veles.accelerated_units import StepCompiler
+from veles.loader.base import CLASS_TRAIN
+from veles.units import Unit
+
+
+class XLAStep(Unit):
+    """Runs the fused step; publishes evaluator metrics to the host."""
+
+    def __init__(self, workflow, loader=None, forwards=(), evaluator=None,
+                 gds=(), **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.loader = loader
+        self.forwards = list(forwards)
+        self.evaluator = evaluator
+        self.gds = list(gds)
+        self.device = None
+        self.compiler = None
+        self.params = None
+        self.state = None
+        self.base_key = None
+        self.step_index = 0
+        #: jax.sharding.NamedSharding for batch tensors (set by the
+        #: parallel layer; None = single device)
+        self.batch_sharding = None
+
+    # -- assembly ------------------------------------------------------
+
+    @property
+    def train_units(self):
+        return self.forwards + [self.evaluator] + \
+            list(reversed(self.gds))
+
+    @property
+    def eval_units(self):
+        return self.forwards + [self.evaluator]
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(**kwargs)
+        self.device = device or getattr(self.workflow, "device", None)
+        self.compiler = StepCompiler(self.train_units, self.device)
+        self.params = _device_tree(self.compiler.gather_params())
+        self.state = _device_tree(self.compiler.gather_state())
+        from veles import prng
+        self.base_key = prng.get("xla_step").jax_key()
+        self._batch_spec = self._build_batch_spec()
+        self._train_fn = None
+        self._eval_fn = None
+
+    def _build_batch_spec(self):
+        spec = {
+            "data": (self.loader, "minibatch_data"),
+            "batch_size": (self.loader, "minibatch_size"),
+        }
+        if self.loader.minibatch_labels:
+            spec["labels"] = (self.loader, "minibatch_labels")
+        targets = getattr(self.loader, "minibatch_targets", None)
+        if targets is not None and targets:
+            spec["targets"] = (self.loader, "minibatch_targets")
+        return spec
+
+    # -- per-step ------------------------------------------------------
+
+    def _gather_batch(self):
+        import jax
+        batch = {}
+        for name, (unit, attr) in self._batch_spec.items():
+            value = getattr(unit, attr)
+            if hasattr(value, "map_read"):
+                value = value.map_read().mem
+            batch[name] = numpy.asarray(value)
+        if self.batch_sharding is not None:
+            batch = {
+                k: jax.device_put(
+                    v, self.batch_sharding if v.ndim else None)
+                for k, v in batch.items()}
+        return batch
+
+    def _gather_hyper(self):
+        return {gd.name: gd.hyperparams() for gd in self.gds}
+
+    def run(self):
+        import jax
+        train = self.loader.minibatch_class == CLASS_TRAIN
+        if train:
+            if self._train_fn is None:
+                self._train_fn = self.compiler.compile(
+                    self._batch_spec, train=True)
+            fn = self._train_fn
+        else:
+            if self._eval_fn is None:
+                self.compiler.units = self.eval_units
+                self._eval_fn = self.compiler.compile(
+                    self._batch_spec, train=False)
+                self.compiler.units = self.train_units
+            fn = self._eval_fn
+        batch = self._gather_batch()
+        key = jax.random.fold_in(self.base_key, self.step_index)
+        self.step_index += 1
+        params, state, outputs = fn(
+            self.params, self.state, batch, self._gather_hyper(), key)
+        if train:
+            self.params, self.state = params, state
+        # publish metrics for Decision (host sync point — one per step)
+        if self.evaluator is not None:
+            if "n_err" in outputs:
+                self.evaluator.n_err = int(outputs["n_err"])
+            if "loss" in outputs:
+                loss = float(outputs["loss"])
+                self.evaluator.loss = loss
+                if hasattr(self.evaluator, "mse"):
+                    self.evaluator.mse = loss
+
+    # -- host sync -----------------------------------------------------
+
+    def sync_host(self):
+        """Write device-resident params/state back into the unit
+        Arrays (before snapshot / numpy cross-check)."""
+        self.compiler.scatter_device_params(self.params)
+        for u in self.compiler.units:
+            tree = self.state.get(u.name)
+            if not tree:
+                continue
+            for attr, value in tree.items():
+                arr = getattr(u, attr, None)
+                if arr is not None and hasattr(arr, "set_device_value"):
+                    arr.set_device_value(value)
+        for u in self.compiler.units:
+            for name in getattr(u, "PARAMS", ()) + getattr(u, "STATE", ()):
+                arr = getattr(u, name, None)
+                if arr is not None and getattr(arr, "map_read", None) \
+                        and arr:
+                    arr.map_read()
+
+    def refresh_device(self):
+        """Re-upload params/state after host-side mutation (snapshot
+        resume, master weight push)."""
+        self.params = _device_tree(self.compiler.gather_params())
+        self.state = _device_tree(self.compiler.gather_state())
+
+
+def _device_tree(tree):
+    import jax
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a), tree)
