@@ -1,0 +1,84 @@
+"""Benchmark entry: prints ONE JSON line for the driver.
+
+Metric: MNIST training steps/sec on the XLA device (TPU when present),
+``vs_baseline`` = speedup over the reference-style numpy backend on the
+same host (BASELINE.json: "samples/MNIST: 2-layer All2All softmax
+(numpy_run CPU baseline)"). The whole fwd+loss+bwd+update cycle is one
+compiled XLA program per step in the measured path.
+"""
+
+import json
+import sys
+import time
+
+
+def build(backend, name):
+    import veles.prng as prng
+    prng.seed_all(99)
+    from veles.config import root
+    from veles.znicz_tpu.models import mnist
+    root.mnist.loader.minibatch_size = 100
+    root.mnist.loader.n_train = 6000
+    root.mnist.loader.n_valid = 1000
+    wf = mnist.create_workflow(name=name)
+    wf.initialize(device=backend)
+    return wf
+
+
+def numpy_steps_per_sec(n_steps=30):
+    from veles.loader.base import CLASS_TRAIN
+    wf = build("numpy", "BenchNumpy")
+    loader = wf.loader
+
+    def one_step():
+        loader.run()
+        while loader.minibatch_class != CLASS_TRAIN:
+            loader.run()
+        for u in wf.forwards:
+            u.run()
+        wf.evaluator.run()
+        for gd in reversed(wf.gds):
+            gd.run()
+
+    one_step()  # warm caches
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        one_step()
+    return n_steps / (time.perf_counter() - t0)
+
+
+def xla_steps_per_sec(n_steps=300):
+    import jax
+    from veles.loader.base import CLASS_TRAIN
+    wf = build("xla", "BenchXLA")
+    loader, step = wf.loader, wf.xla_step
+
+    def one_step():
+        loader.run()
+        while loader.minibatch_class != CLASS_TRAIN:
+            loader.run()
+        step.run()
+
+    for _ in range(3):  # compile + warm
+        one_step()
+    jax.block_until_ready(step.params)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        one_step()
+    jax.block_until_ready(step.params)
+    return n_steps / (time.perf_counter() - t0)
+
+
+def main():
+    base = numpy_steps_per_sec()
+    fast = xla_steps_per_sec()
+    print(json.dumps({
+        "metric": "mnist_train_steps_per_sec",
+        "value": round(fast, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(fast / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -264,6 +264,19 @@ class StepCompiler:
 
     # compilation -------------------------------------------------------
 
+    def trace_step(self, params, state, hyper, key, train, units, bind):
+        """The ONE step-body trace shared by per-step and scan
+        compilation: build the context, bind the batch (caller-supplied
+        closure), run every unit's ``xla_run``."""
+        ctx = FlowContext(self, dict(params), dict(state), hyper,
+                          key, train)
+        bind(ctx)
+        for unit in units:
+            if not train and getattr(unit, "train_only", False):
+                continue
+            unit.xla_run(ctx)
+        return ctx
+
     def build_step(self, batch_spec, train=True):
         """Return ``step(params, state, batch, hyper, key)``.
 
@@ -275,14 +288,11 @@ class StepCompiler:
         units = self.units
 
         def step(params, state, batch, hyper, key):
-            ctx = FlowContext(self, dict(params), dict(state), hyper,
-                              key, train)
-            for name, (unit, attr) in batch_spec.items():
-                ctx.set(unit, attr, batch[name])
-            for unit in units:
-                if not train and getattr(unit, "train_only", False):
-                    continue
-                unit.xla_run(ctx)
+            def bind(ctx):
+                for name, (unit, attr) in batch_spec.items():
+                    ctx.set(unit, attr, batch[name])
+            ctx = self.trace_step(params, state, hyper, key, train,
+                                  units, bind)
             return ctx.params, ctx.state, ctx.outputs
 
         donate = (0, 1) if (self.donate and train) else ()
@@ -294,6 +304,75 @@ class StepCompiler:
                train)
         if key not in self._compiled:
             self._compiled[key] = self.build_step(batch_spec, train=train)
+        return self._compiled[key]
+
+    # class-scan compilation (SURVEY.md §7 design stance, taken one
+    # step further: not just one fused step, but a whole class segment
+    # of an epoch as ONE lax.scan program — zero per-minibatch dispatch
+    # or host sync; the dataset stays device-resident and minibatches
+    # are gathered by index on device) -------------------------------
+
+    def build_epoch_scan(self, batch_spec, segments):
+        """Return ``epoch(params, state, full, idxs, valids, hyper,
+        key0) -> (params, state, {seg: stacked_outputs})``.
+
+        ``segments``: list of ``(seg_key, train_flag, units)`` — one
+        per loader class served this epoch, in serving order. ``full``:
+        dict name -> whole-dataset device array; ``idxs[seg_key]``:
+        (n_mb, mb) int32 row indices; ``valids[seg_key]``: (n_mb,) true
+        row counts. Each segment is a ``lax.scan`` whose iterations
+        gather their minibatch from ``full`` on device and run the
+        fused step body — an entire epoch becomes one XLA program with
+        a single host round-trip for its metrics.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        segments = [(k, t, list(us)) for k, t, us in segments]
+        spec = dict(batch_spec)
+
+        def epoch_fn(params, state, full, idxs, valids, hyper, key0):
+            outs_all = {}
+            for seg_i, (seg_key, train, units) in enumerate(segments):
+                seg_base_key = jax.random.fold_in(key0, seg_i)
+
+                def body(carry, xs, _units=units, _train=train,
+                         _key=seg_base_key):
+                    params, state = carry
+                    i, idx, valid = xs
+
+                    def bind(ctx):
+                        for name, (unit, attr) in spec.items():
+                            if name == "batch_size":
+                                ctx.set(unit, attr, valid)
+                            else:
+                                ctx.set(unit, attr, full[name][idx])
+                    ctx = self.trace_step(
+                        params, state, hyper,
+                        jax.random.fold_in(_key, i), _train, _units,
+                        bind)
+                    return (ctx.params, ctx.state), ctx.outputs
+
+                idx_mat = idxs[seg_key]
+                n_mb = idx_mat.shape[0]
+                (params, state), outs = jax.lax.scan(
+                    body, (params, state),
+                    (jnp.arange(n_mb), idx_mat, valids[seg_key]))
+                outs_all[seg_key] = outs
+            return params, state, outs_all
+
+        donate = (0, 1) if self.donate else ()
+        return jax.jit(epoch_fn, donate_argnums=donate)
+
+    def compile_epoch_scan(self, batch_spec, segments):
+        key = ("epoch",
+               tuple(sorted((name, unit.name, attr)
+                            for name, (unit, attr) in batch_spec.items())),
+               tuple((k, t, tuple(u.name for u in us))
+                     for k, t, us in segments))
+        if key not in self._compiled:
+            self._compiled[key] = self.build_epoch_scan(
+                batch_spec, segments)
         return self._compiled[key]
 
 

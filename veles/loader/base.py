@@ -30,6 +30,10 @@ class Loader(Unit, IDistributable):
     """
 
     negotiates_on_connect = True
+    #: True when the whole dataset can live device-resident and
+    #: minibatches can be gathered by index on device (enables the
+    #: class-scan fast path in XLAStep)
+    supports_device_gather = False
 
     def __init__(self, workflow, minibatch_size=100, shuffle=True,
                  prng_key="loader", **kwargs):
@@ -49,6 +53,10 @@ class Loader(Unit, IDistributable):
         self.minibatch_size = 0
         self.minibatch_class = CLASS_TRAIN
         self.minibatch_offset = 0
+
+        #: set by XLAStep in scan mode: host minibatch filling is
+        #: skipped (the device gathers rows itself)
+        self.device_gather = False
 
         self.epoch_number = 0
         self.epoch_ended = Bool(False)
@@ -127,21 +135,45 @@ class Loader(Unit, IDistributable):
 
     # -- serving -------------------------------------------------------
 
+    @staticmethod
+    def pad_indices(chunk, size):
+        """THE static-shape padding convention, used identically by the
+        per-step and scan paths: pad rows repeat the last index (and
+        evaluators mask rows past the true count)."""
+        padded = numpy.empty(size, dtype=numpy.int32)
+        padded[:len(chunk)] = chunk
+        if len(chunk) < size:
+            padded[len(chunk):] = chunk[-1] if len(chunk) else 0
+        return padded
+
     def _serve_chunk(self, cls, chunk):
-        """Publish one minibatch: class/gates bookkeeping + static-shape
-        index padding (pad rows repeat the last index; evaluators mask
-        them via ``minibatch_size``)."""
-        mb = self.max_minibatch_size
+        """Publish one minibatch: class/gates bookkeeping + padding."""
         self.minibatch_class = cls
         self.train_phase << (cls == CLASS_TRAIN)
         self.minibatch_size = len(chunk)
-        padded = numpy.empty(mb, dtype=numpy.int32)
-        padded[:len(chunk)] = chunk
-        if len(chunk) < mb:
-            padded[len(chunk):] = chunk[-1] if len(chunk) else 0
         self.minibatch_indices.map_invalidate()
-        self.minibatch_indices.mem[...] = padded
-        self.fill_minibatch()
+        self.minibatch_indices.mem[...] = self.pad_indices(
+            chunk, self.max_minibatch_size)
+        if not self.device_gather:
+            self.fill_minibatch()
+
+    def class_schedule(self, cls):
+        """(idx_mat (n_mb, mb) int32, valids (n_mb,) int32) — the full
+        minibatch schedule of ``cls`` for the CURRENT epoch order (the
+        class-scan fast path consumes a whole class in one dispatch)."""
+        for c, indices in self._order:
+            if c != cls:
+                continue
+            mb = self.max_minibatch_size
+            n_mb = (len(indices) + mb - 1) // mb
+            idx_mat = numpy.empty((n_mb, mb), numpy.int32)
+            valids = numpy.empty(n_mb, numpy.int32)
+            for i in range(n_mb):
+                chunk = indices[i * mb:(i + 1) * mb]
+                idx_mat[i] = self.pad_indices(chunk, mb)
+                valids[i] = len(chunk)
+            return idx_mat, valids
+        raise ValueError("class %d not in this epoch's order" % cls)
 
     def run(self):
         self.epoch_ended << False

@@ -16,6 +16,8 @@ from veles.memory import Array
 class FullBatchLoader(Loader):
     """Dataset-in-memory loader; minibatch = row gather."""
 
+    supports_device_gather = True
+
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
         self.original_data = Array()
@@ -55,6 +57,27 @@ class FullBatchLoader(Loader):
                 (self.max_minibatch_size,)
                 + self.original_targets.mem.shape[1:],
                 self.serve_dtype))
+
+    def device_full_arrays(self, sharding=None):
+        """Upload the whole dataset once; returns the dict the
+        class-scan gathers minibatches from (keys match XLAStep's
+        batch spec names). ``sharding`` places the dataset onto a mesh
+        (replicated for DP gathers) instead of a single device."""
+        import jax
+        if getattr(self, "_device_full_sharding", None) is not sharding:
+            self._device_full = None
+        if getattr(self, "_device_full", None) is None:
+            put = (lambda a: jax.device_put(a, sharding))
+            full = {"data": put(
+                self.original_data.mem.astype(self.serve_dtype))}
+            if self.original_labels:
+                full["labels"] = put(self.original_labels.mem)
+            if self.original_targets:
+                full["targets"] = put(
+                    self.original_targets.mem.astype(self.serve_dtype))
+            self._device_full = full
+            self._device_full_sharding = sharding
+        return self._device_full
 
     def fill_minibatch(self):
         idx = self.minibatch_indices.mem

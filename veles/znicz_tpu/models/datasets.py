@@ -43,9 +43,9 @@ def _find_mnist_dir():
     return None
 
 
-def load_mnist():
+def load_mnist(n_train=6000, n_valid=1000):
     """(train_x, train_y, test_x, test_y) floats in [0,1]; real data if
-    on disk, synthetic otherwise."""
+    on disk, synthetic otherwise (sizes apply to synthetic only)."""
     d = _find_mnist_dir()
     if d is not None:
         def rd(stem):
@@ -59,7 +59,7 @@ def load_mnist():
         vx = rd("t10k-images-idx3-ubyte").astype(numpy.float32) / 255.0
         vy = rd("t10k-labels-idx1-ubyte").astype(numpy.int32)
         return tx, ty, vx, vy
-    return synthetic_images(n_train=6000, n_valid=1000,
+    return synthetic_images(n_train=n_train, n_valid=n_valid,
                             shape=(28, 28), n_classes=10,
                             key="mnist_synth")
 

@@ -15,7 +15,8 @@ from veles.znicz_tpu.models import datasets
 from veles.znicz_tpu.standard_workflow import StandardWorkflow
 
 root.mnist.update({
-    "loader": {"minibatch_size": 100},
+    "loader": {"minibatch_size": 100,
+               "n_train": 6000, "n_valid": 1000},
     "layers": [
         {"type": "all2all_tanh",
          "->": {"output_sample_shape": 100},
@@ -35,7 +36,9 @@ class MnistLoader(FullBatchLoader):
     the deterministic synthetic stand-in — see models/datasets.py)."""
 
     def load_data(self):
-        tx, ty, vx, vy = datasets.load_mnist()
+        tx, ty, vx, vy = datasets.load_mnist(
+            n_train=root.mnist.loader.get("n_train", 6000),
+            n_valid=root.mnist.loader.get("n_valid", 1000))
         tx = tx.reshape(len(tx), -1)
         vx = vx.reshape(len(vx), -1)
         # sample order: [test | valid | train] per loader class layout

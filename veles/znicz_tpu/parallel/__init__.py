@@ -1,0 +1,79 @@
+"""Device mesh / sharding / collectives — the distribution layer.
+
+This replaces the reference's ZeroMQ+Twisted master↔slave fabric
+(SURVEY.md §2.2, §5.8) with the TPU-native story: a
+``jax.sharding.Mesh`` over the chips, named-sharding annotations on the
+step's inputs, and XLA-inserted collectives riding ICI. Data
+parallelism falls out of batch sharding (the weight-gradient
+contraction over the sharded batch axis becomes an all-reduce — the
+compiled analogue of ``apply_data_from_slave`` weight averaging, but
+synchronous, SURVEY.md §3.3 note). Axis conventions:
+
+* ``data``  — batch / data parallelism (DP)
+* ``model`` — tensor parallelism (TP) for the Transformer units
+* ``seq``   — sequence/context parallelism (ring attention)
+
+Multi-host: `jax.distributed.initialize` + the same mesh spanning all
+processes; DCN handles the inter-slice hops. See ``veles/server.py``
+for the retained job-queue compat layer.
+"""
+
+import numpy
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh. ``axes``: dict name->size (ordered); ``None``
+    means one 'data' axis over all visible devices."""
+    import jax
+    from jax.sharding import Mesh
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"data": len(devices)}
+    names = tuple(axes)
+    sizes = tuple(int(axes[n]) for n in names)
+    n_need = int(numpy.prod(sizes))
+    if n_need > len(devices):
+        raise ValueError("mesh %r needs %d devices, have %d"
+                         % (axes, n_need, len(devices)))
+    grid = numpy.array(devices[:n_need], dtype=object).reshape(sizes)
+    return Mesh(grid, names)
+
+
+def batch_sharding(mesh, axis="data"):
+    """Shard dim 0 (batch) over the data axis; replicate the rest."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def grad_sync_bytes(params):
+    """The per-step gradient all-reduce volume (the analogue of the
+    reference's 'slave grad-sync bandwidth' metric, SURVEY.md §6):
+    bytes of every trainable parameter, which is what the DP
+    all-reduce moves per step per link direction."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(params)
+    return int(sum(numpy.prod(l.shape) * l.dtype.itemsize
+                   for l in leaves))
+
+
+def setup_data_parallel(workflow, mesh=None):
+    """Configure an initialized XLA workflow for DP over ``mesh``:
+    batch tensors sharded over 'data', params/state replicated."""
+    if mesh is None:
+        mesh = make_mesh()
+    step = workflow.xla_step
+    if step is None:
+        raise ValueError("workflow has no xla_step (numpy backend?)")
+    step.batch_sharding = batch_sharding(mesh)
+    step.param_sharding = replicated(mesh)
+    workflow.device.mesh = mesh
+    step.refresh_device()
+    return mesh

@@ -43,6 +43,8 @@ class XLAStep(Unit):
         #: jax.sharding.NamedSharding for batch tensors (set by the
         #: parallel layer; None = single device)
         self.batch_sharding = None
+        #: sharding for params/state (replicated under DP)
+        self.param_sharding = None
 
     # -- assembly ------------------------------------------------------
 
@@ -59,13 +61,30 @@ class XLAStep(Unit):
         super().initialize(**kwargs)
         self.device = device or getattr(self.workflow, "device", None)
         self.compiler = StepCompiler(self.train_units, self.device)
-        self.params = _device_tree(self.compiler.gather_params())
-        self.state = _device_tree(self.compiler.gather_state())
+        self.params = _device_tree(self.compiler.gather_params(),
+                                   self.param_sharding)
+        self.state = _device_tree(self.compiler.gather_state(),
+                                  self.param_sharding)
         from veles import prng
         self.base_key = prng.get("xla_step").jax_key()
         self._batch_spec = self._build_batch_spec()
         self._train_fn = None
         self._eval_fn = None
+        # class-scan fast path: whole class segments in one dispatch
+        # when the dataset can live on device (SURVEY.md §3.2: the
+        # reference pays per-unit launch overhead; we pay one launch
+        # per epoch *class*)
+        # Scan mode requires the loader to own its own minibatch order;
+        # a distributed SLAVE gets index ranges pushed by the master
+        # (apply_data_from_master), so it must stay per-step.
+        self.scan_mode = bool(
+            getattr(self.loader, "supports_device_gather", False)
+            and not getattr(self.workflow, "is_slave", False))
+        if self.scan_mode:
+            self.loader.device_gather = True
+        self._dispatched_epoch = None
+        self._epoch_outs = {}
+        self._epoch_pos = {}
 
     def _build_batch_spec(self):
         spec = {
@@ -100,6 +119,82 @@ class XLAStep(Unit):
         return {gd.name: gd.hyperparams() for gd in self.gds}
 
     def run(self):
+        if self.scan_mode:
+            self._run_scan_mode()
+        else:
+            self._run_per_step()
+
+    def _run_scan_mode(self):
+        loader = self.loader
+        if self._dispatched_epoch != loader.epoch_number:
+            self._dispatch_epoch()
+        cls = loader.minibatch_class
+        pos = self._epoch_pos[cls]
+        self._publish_metrics(
+            {k: v[pos] for k, v in self._epoch_outs[cls].items()})
+        self._epoch_pos[cls] = pos + 1
+
+    def _dispatch_epoch(self):
+        """Run the WHOLE epoch (every class segment, serving order) as
+        one compiled program; fetch all stacked metrics in one host
+        round-trip."""
+        import jax
+        loader = self.loader
+        full = loader.device_full_arrays(
+            None if self.batch_sharding is None
+            else self.param_sharding)  # replicate dataset on the mesh
+        classes = [cls for cls, _ in loader._order]
+        segments, idxs, valids = [], {}, {}
+        for cls in classes:
+            train = cls == CLASS_TRAIN
+            seg_key = "c%d" % cls
+            segments.append((
+                seg_key, train,
+                self.train_units if train else self.eval_units))
+            idx_mat, vl = loader.class_schedule(cls)
+            if self.batch_sharding is not None:
+                # shard the within-minibatch (batch) dim over the data
+                # axis: on-device gathers execute shard-local and DP
+                # falls out of XLA auto-partitioning
+                from jax.sharding import NamedSharding, PartitionSpec
+                from veles.memory import roundup
+                mesh = self.batch_sharding.mesh
+                axis = self.batch_sharding.spec[0]
+                n_dev = mesh.shape[axis]
+                mb = idx_mat.shape[1]
+                mb_pad = roundup(mb, n_dev)
+                if mb_pad != mb:
+                    # pad rows repeat the last index; `valids` masking
+                    # already zeroes their loss/gradient contribution
+                    pad = numpy.repeat(idx_mat[:, -1:],
+                                       mb_pad - mb, axis=1)
+                    idx_mat = numpy.concatenate([idx_mat, pad], axis=1)
+                idx_mat = jax.device_put(idx_mat, NamedSharding(
+                    mesh, PartitionSpec(None, axis)))
+                vl = jax.device_put(vl, NamedSharding(
+                    mesh, PartitionSpec()))
+            idxs[seg_key] = idx_mat
+            valids[seg_key] = vl
+        fn = self.compiler.compile_epoch_scan(self._batch_spec, segments)
+        key = jax.random.fold_in(self.base_key, self.step_index)
+        self.step_index += sum(idxs[k].shape[0] for k in idxs)
+        # Stash the epoch-entry params (the ones the epoch's validation
+        # metrics describe — valid is served before train): improved-
+        # gated snapshots must save THESE, not the post-train params
+        # (per-step-mode / reference semantics, SURVEY.md §3.4).
+        import jax.numpy as jnp
+        self._pre_epoch_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        self.params, self.state, outs = fn(
+            self.params, self.state, full, idxs, valids,
+            self._gather_hyper(), key)
+        host_outs = _fetch_tree(outs)
+        self._epoch_outs = {cls: host_outs["c%d" % cls]
+                            for cls in classes}
+        self._epoch_pos = {cls: 0 for cls in classes}
+        self._dispatched_epoch = loader.epoch_number
+
+    def _run_per_step(self):
         import jax
         train = self.loader.minibatch_class == CLASS_TRAIN
         if train:
@@ -121,22 +216,35 @@ class XLAStep(Unit):
             self.params, self.state, batch, self._gather_hyper(), key)
         if train:
             self.params, self.state = params, state
-        # publish metrics for Decision (host sync point — one per step)
-        if self.evaluator is not None:
-            if "n_err" in outputs:
-                self.evaluator.n_err = int(outputs["n_err"])
-            if "loss" in outputs:
-                loss = float(outputs["loss"])
-                self.evaluator.loss = loss
-                if hasattr(self.evaluator, "mse"):
-                    self.evaluator.mse = loss
+        self._publish_metrics(outputs)
+
+    def _publish_metrics(self, outputs):
+        """Hand the evaluator's step metrics to the host-side Decision."""
+        if self.evaluator is None:
+            return
+        if "n_err" in outputs:
+            self.evaluator.n_err = int(outputs["n_err"])
+        if "loss" in outputs:
+            loss = float(outputs["loss"])
+            self.evaluator.loss = loss
+            if hasattr(self.evaluator, "mse"):
+                self.evaluator.mse = loss
 
     # -- host sync -----------------------------------------------------
 
-    def sync_host(self):
+    def sync_host(self, at_valid=False):
         """Write device-resident params/state back into the unit
-        Arrays (before snapshot / numpy cross-check)."""
-        self.compiler.scatter_device_params(self.params)
+        Arrays (before snapshot / numpy cross-check).
+
+        ``at_valid=True`` syncs the params the current epoch's
+        validation metric was measured on (scan mode trains the whole
+        epoch in one dispatch, so the live params are one train segment
+        ahead of the metric that gated the snapshot)."""
+        params = self.params
+        if at_valid and getattr(self, "_pre_epoch_params", None) \
+                is not None:
+            params = self._pre_epoch_params
+        self.compiler.scatter_device_params(params)
         for u in self.compiler.units:
             tree = self.state.get(u.name)
             if not tree:
@@ -154,11 +262,62 @@ class XLAStep(Unit):
 
     def refresh_device(self):
         """Re-upload params/state after host-side mutation (snapshot
-        resume, master weight push)."""
-        self.params = _device_tree(self.compiler.gather_params())
-        self.state = _device_tree(self.compiler.gather_state())
+        resume, master weight push). For a mid-run sharding change call
+        sync_host() first — host Arrays are the source of truth here."""
+        self.params = _device_tree(self.compiler.gather_params(),
+                                   self.param_sharding)
+        self.state = _device_tree(self.compiler.gather_state(),
+                                  self.param_sharding)
 
 
-def _device_tree(tree):
+def _device_tree(tree, sharding=None):
     import jax
-    return jax.tree_util.tree_map(lambda a: jax.device_put(a), tree)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding), tree)
+
+
+_PACK_CACHE = {}
+
+
+def _fetch_tree(tree):
+    """Fetch a pytree of device arrays with ONE d2h transfer: pack all
+    leaves into a single f32 vector on device, transfer once, unpack on
+    host (remote-tunnel TPUs pay a full round-trip per transfer).
+
+    32-bit leaves are BITCAST (lossless, however large the ints);
+    narrower dtypes widen losslessly through f32; 64-bit dtypes are
+    rejected rather than silently truncated."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    for leaf in leaves:
+        if leaf.dtype.itemsize > 4:
+            raise TypeError(
+                "_fetch_tree cannot pack %s losslessly" % leaf.dtype)
+    sig = tuple((l.shape, str(l.dtype)) for l in leaves)
+    if sig not in _PACK_CACHE:
+        def pack(ls):
+            parts = []
+            for l in ls:
+                if l.dtype.itemsize == 4:
+                    parts.append(lax.bitcast_convert_type(
+                        l, jnp.float32).ravel())
+                else:
+                    parts.append(l.astype(jnp.float32).ravel())
+            return jnp.concatenate(parts)
+        _PACK_CACHE[sig] = jax.jit(pack)
+    flat = numpy.asarray(_PACK_CACHE[sig](leaves))
+    out, off = [], 0
+    for leaf in leaves:
+        size = int(numpy.prod(leaf.shape)) if leaf.shape else 1
+        piece = flat[off:off + size]
+        if leaf.dtype.itemsize == 4:
+            piece = piece.view(numpy.dtype(str(leaf.dtype)))
+        else:
+            piece = piece.astype(leaf.dtype)
+        out.append(piece.reshape(leaf.shape))
+        off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
