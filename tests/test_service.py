@@ -1,0 +1,152 @@
+"""Service layer: snapshot/resume, CLI, launcher, master↔slave wire
+protocol (SURVEY.md §2.7, §3.3, §3.4, §4 "Distributed tests")."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_wf(name, backend="numpy", max_epochs=2, snapdir=None):
+    prng.seed_all(555)
+    from veles.znicz_tpu.models import mnist
+    root.mnist.loader.minibatch_size = 50
+    root.mnist.loader.n_train = 500
+    root.mnist.loader.n_valid = 100
+    root.mnist.decision.max_epochs = max_epochs
+    cfg = dict(snapshotter_config={"directory": snapdir}) \
+        if snapdir else {}
+    from veles.znicz_tpu.standard_workflow import StandardWorkflow
+    wf = StandardWorkflow(
+        None, name=name,
+        layers=root.mnist.layers,
+        loader_factory=lambda w: mnist.MnistLoader(
+            w, name="loader", minibatch_size=50),
+        decision_config=root.mnist.decision.to_dict(),
+        **cfg)
+    wf.initialize(device=backend)
+    return wf
+
+
+def test_snapshot_resume(tmp_path):
+    snapdir = str(tmp_path)
+    wf = make_wf("SnapWf", snapdir=snapdir)
+    wf.run()
+    assert wf.snapshotter.destination, "no snapshot written"
+    assert os.path.exists(wf.snapshotter.destination)
+
+    # resume into a FRESH workflow; training continues from the saved
+    # best state rather than from scratch
+    from veles.snapshotter import load_snapshot
+    state = load_snapshot(wf.snapshotter.destination)
+    wf2 = make_wf("SnapWf2", max_epochs=3)
+    wf2.restore_state(state)
+    # the snapshot is of the BEST point (improved gate), not the end
+    assert wf2.decision.epoch_number == wf.decision.best_epoch
+    assert numpy.allclose(
+        wf2.forwards[0].weights.map_read().mem,
+        state["params"][wf.forwards[0].name]["weights"])
+    wf2.run()
+    assert wf2.decision.epoch_number == 3
+
+
+def test_snapshot_resume_xla(tmp_path):
+    wf = make_wf("SnapX", backend="cpu", snapdir=str(tmp_path))
+    wf.run()
+    from veles.snapshotter import load_snapshot
+    state = load_snapshot(wf.snapshotter.destination)
+    wf2 = make_wf("SnapX2", backend="cpu", max_epochs=3)
+    wf2.restore_state(state)
+    wf2.run()
+    assert wf2.decision.epoch_number == 3
+    err = wf2.decision.history[-1]["validation"]["metric"]
+    assert err <= wf.decision.history[-1]["validation"]["metric"] + 0.05
+
+
+def test_cli_end_to_end(tmp_path):
+    """Drive the real CLI: sample module + overrides + result file."""
+    result = tmp_path / "result.json"
+    graph = tmp_path / "graph.dot"
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    base = [sys.executable, "-m", "veles",
+            os.path.join(REPO, "veles/znicz_tpu/models/mnist.py"),
+            "--seed", "99", "-d", "cpu", "--no-stats",
+            "root.mnist.decision.max_epochs=2",
+            "root.mnist.loader.n_train=300",
+            "root.mnist.loader.n_valid=100",
+            "root.mnist.loader.minibatch_size=50"]
+    out = subprocess.run(
+        base + ["--result-file", str(result)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    data = json.loads(result.read_text())
+    assert len(data["history"]) == 2
+    assert data["best_metric"] < 0.9
+
+    out = subprocess.run(
+        base + ["--workflow-graph", str(graph)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "digraph" in graph.read_text()
+
+
+def test_master_slave_protocol():
+    """In-process master + 2 slaves over localhost TCP: job/update
+    round-trips, weight averaging, slave-drop requeue (§3.3, §4)."""
+    from veles.server import MasterServer
+    from veles.client import SlaveClient
+
+    master_wf = make_wf("MasterWf", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2)
+    server.start_background()
+    addr = "127.0.0.1:%d" % server.bound_address[1]
+
+    w0 = numpy.array(master_wf.forwards[0].weights.map_read().mem)
+
+    slaves = [make_wf("SlaveWf%d" % i) for i in range(2)]
+    for s in slaves:
+        s.is_slave = True
+    counts = []
+
+    def run_slave(wf):
+        client = SlaveClient(wf, addr, name=wf.name)
+        counts.append(client.run_forever())
+
+    threads = [threading.Thread(target=run_slave, args=(s,))
+               for s in slaves]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert server.done.is_set()
+    assert sum(counts) >= 2 * (500 // 50 + 100 // 50)  # 2 epochs of jobs
+    # master weights moved (averaged in from slave updates)
+    w1 = master_wf.forwards[0].weights.map_read().mem
+    assert not numpy.allclose(w0, w1)
+
+
+def test_drop_slave_requeues():
+    from veles.loader.base import CLASS_TRAIN
+    wf = make_wf("DropWf")
+    loader = wf.loader
+    loader.master_start_epoch()
+    total = len(loader._pending_jobs)
+    job = loader.generate_data_for_slave(slave=7)
+    assert job is not None and len(loader._pending_jobs) == total - 1
+    loader.drop_slave(7)
+    assert len(loader._pending_jobs) == total
+    assert loader._pending_jobs[0] == job

@@ -1,0 +1,144 @@
+"""velescli — the command-line entry point.
+
+Re-design of ``velescli.py`` = ``veles/__main__.py`` [U] (SURVEY.md
+§2.7 "CLI", §3.1 call stack). Usage keeps the reference shape:
+
+    python -m veles [options] <workflow.py> [<config.py>] [root.x.y=v ...]
+
+* the workflow module must expose ``run(load, main)``; ``load`` builds
+  the workflow class with kwargs, ``main`` launches it;
+* the config module is plain python mutating the global ``root``;
+* trailing ``a.b=value`` args are dot-path overrides (python literals);
+* ``-d/--device`` picks the backend (xla/tpu/cpu/numpy),
+  ``--seed`` seeds every PRNG, ``--snapshot`` resumes,
+  ``--listen-address``/``--master-address`` select master/slave modes,
+  ``--workflow-graph`` dumps graphviz, ``--result-file`` writes the
+  run's metric history as JSON.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+from veles import prng
+from veles.config import root
+from veles.launcher import Launcher
+
+
+def build_argparser():
+    p = argparse.ArgumentParser(
+        prog="velescli",
+        description="Run a znicz-tpu workflow (TPU-native VELES)")
+    p.add_argument("workflow", help="path to the workflow python module")
+    p.add_argument("config", nargs="?", default=None,
+                   help="python config file mutating root.*")
+    p.add_argument("overrides", nargs="*", default=[],
+                   help="root.x.y=value dot-path overrides")
+    p.add_argument("-d", "--device", default=None,
+                   help="backend: xla | tpu | cpu | numpy")
+    p.add_argument("--seed", type=int, default=None,
+                   help="master seed for every PRNG")
+    p.add_argument("--snapshot", default=None,
+                   help="checkpoint file to resume from")
+    p.add_argument("--listen-address", default=None,
+                   help="host:port -> run as distribution master")
+    p.add_argument("--master-address", default=None,
+                   help="host:port -> run as slave of that master")
+    p.add_argument("--workflow-graph", default=None,
+                   help="write the unit DAG as graphviz dot and exit")
+    p.add_argument("--dump-config", action="store_true",
+                   help="print the effective config before running")
+    p.add_argument("--result-file", default=None,
+                   help="write decision history JSON here")
+    p.add_argument("--no-stats", action="store_true",
+                   help="skip the per-unit timing report")
+    return p
+
+
+def import_file(path, name=None):
+    name = name or os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None:
+        raise ImportError("cannot import %s" % path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+class Main:
+    """The reference's Main object: owns launcher + workflow."""
+
+    def __init__(self, argv=None):
+        self.args = build_argparser().parse_args(argv)
+        self.workflow = None
+        self.launcher = None
+
+    def setup_config(self):
+        # a lone "a.b=c" positional is an override, not a config file
+        if self.args.config and "=" in self.args.config \
+                and not os.path.exists(self.args.config):
+            self.args.overrides.insert(0, self.args.config)
+            self.args.config = None
+        if self.args.config:
+            import_file(self.args.config, "veles_config_module")
+        for override in self.args.overrides:
+            root.apply_override(override)
+        if self.args.seed is not None:
+            prng.seed_all(self.args.seed)
+        if self.args.dump_config:
+            root.print_config(stream=sys.stderr)
+
+    # -- the load/main pair handed to the sample's run() ---------------
+
+    def load(self, WorkflowClass, **kwargs):
+        self.workflow = WorkflowClass(None, **kwargs)
+        return self.workflow
+
+    def main(self, **kwargs):
+        args = self.args
+        if self.workflow is None:
+            raise RuntimeError("workflow.run() never called load()")
+        if args.workflow_graph:
+            with open(args.workflow_graph, "w") as f:
+                f.write(self.workflow.generate_graph())
+            print("workflow graph -> %s" % args.workflow_graph)
+            return self.workflow
+        self.launcher = Launcher(
+            device=args.device, snapshot=args.snapshot,
+            stats=not args.no_stats,
+            listen_address=args.listen_address,
+            master_address=args.master_address)
+        self.launcher.initialize(self.workflow, **kwargs)
+        self.launcher.run()
+        if args.result_file and self.workflow.decision is not None:
+            with open(args.result_file, "w") as f:
+                json.dump({
+                    "workflow": self.workflow.name,
+                    "history": self.workflow.decision.history,
+                    "best_metric": float(
+                        self.workflow.decision.best_metric),
+                }, f, indent=2)
+        return self.workflow
+
+    def run(self):
+        # Import the workflow module FIRST: its module-level defaults
+        # land in root before the config file and the CLI dot-path
+        # overrides are applied on top (reference ordering [U]).
+        module = import_file(self.args.workflow, "veles_workflow_module")
+        self.setup_config()
+        if not hasattr(module, "run"):
+            raise AttributeError(
+                "%s has no run(load, main)" % self.args.workflow)
+        module.run(self.load, self.main)
+        return 0
+
+
+def main(argv=None):
+    return Main(argv).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

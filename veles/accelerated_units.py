@@ -105,6 +105,9 @@ class AcceleratedUnit(Unit):
                 arr.mem = numpy.asarray(value, dtype=arr.dtype
                                         if arr else None)
 
+    #: state restores through the same Array-attr path
+    import_state = import_params
+
 
 class FlowContext:
     """The tracing context handed to each unit's ``xla_run``.

@@ -1,0 +1,83 @@
+"""Slave client — pulls jobs, runs local iterations, pushes updates.
+
+Re-design of ``veles/client.py`` [U] (SURVEY.md §2.2 "Slave client",
+§3.3 call stack): connect + handshake, then loop { request job; apply
+per-unit payloads (loader gets minibatch indices, GD units get fresh
+weights); run one local iteration; push per-unit updates (weights,
+eval counters) }. The compute inside the iteration is whatever the
+local device does best — on TPU the fused per-step program.
+"""
+
+import socket
+import time
+
+from veles.distributable import DistributionRegistry
+from veles.logger import Logger
+from veles.server import send_frame, recv_frame
+
+
+class SlaveClient(Logger):
+    def __init__(self, workflow, address, name=None):
+        self.name = name or "SlaveClient"
+        self.workflow = workflow
+        host, _, port = str(address).rpartition(":")
+        self.address = (host or "127.0.0.1", int(port))
+        self.registry = DistributionRegistry(workflow)
+        self.slave_id = None
+        self.jobs_done = 0
+
+    def connect(self):
+        self.sock = socket.create_connection(self.address, timeout=30)
+        send_frame(self.sock, ("hello", self.name))
+        kind, slave_id = recv_frame(self.sock)
+        assert kind == "welcome"
+        self.slave_id = slave_id
+        return self
+
+    def run_one(self):
+        """Request + run one job; False when the master says stop."""
+        send_frame(self.sock, ("job", self.slave_id))
+        resp = recv_frame(self.sock)
+        if resp is None or resp[0] == "bye":
+            return False
+        if resp[0] == "wait":
+            time.sleep(0.02)
+            return True
+        self.registry.apply_job(resp[1])
+        self._run_iteration()
+        send_frame(self.sock,
+                   ("update", self.slave_id, self.registry.generate_update()))
+        ok = recv_frame(self.sock)
+        self.jobs_done += 1
+        return ok is not None
+
+    def _run_iteration(self):
+        """One forward/backward/update pass over the minibatch the
+        master assigned (already applied into the loader)."""
+        wf = self.workflow
+        if wf.xla_step is not None:
+            # master pushed fresh weights into host Arrays: re-upload,
+            # step, and sync back so generate_update ships the result
+            wf.xla_step.refresh_device()
+            wf.xla_step.run()
+            wf.xla_step.sync_host()
+        else:
+            for u in wf.forwards:
+                u.run()
+            wf.evaluator.run()
+            if wf.loader.minibatch_class == 2:  # CLASS_TRAIN
+                for gd in reversed(wf.gds):
+                    gd.run()
+
+    def run_forever(self):
+        self.connect()
+        try:
+            while self.run_one():
+                pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.info("slave done after %d jobs", self.jobs_done)
+        return self.jobs_done

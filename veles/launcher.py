@@ -1,0 +1,110 @@
+"""Run orchestration.
+
+Re-design of ``veles/launcher.py`` [U] (SURVEY.md §2.7 "Launcher",
+§3.1): builds the Device, initializes the workflow (shape resolution +
+step compilation), optionally restores a snapshot, drives the run,
+reports per-unit timing, and owns the distributed role:
+
+* **standalone** — everything in-process (the default);
+* **master**     — owns the canonical weights + job queue, serves
+  slaves over the wire transport (``veles/server.py``), computes
+  nothing (reference semantics, SURVEY.md §3.3);
+* **slave**      — pulls jobs, runs iterations, pushes updates.
+
+The reference needed a Twisted reactor here; the TPU rebuild's hot path
+is compiled collectives, so the launcher stays synchronous and the wire
+layer (used for the elastic-DP compat path and observability only) is
+plain sockets in ``veles/server.py`` / ``veles/client.py``.
+"""
+
+import signal
+import sys
+
+from veles.logger import Logger
+
+
+class Launcher(Logger):
+    """Drives one workflow run."""
+
+    def __init__(self, device=None, snapshot=None, stats=True,
+                 listen_address=None, master_address=None):
+        self.name = "Launcher"
+        self.device_spec = device
+        self.snapshot = snapshot
+        self.stats = stats
+        self.listen_address = listen_address
+        self.master_address = master_address
+        self.workflow = None
+        self.interrupted = False
+
+    @property
+    def mode(self):
+        if self.listen_address:
+            return "master"
+        if self.master_address:
+            return "slave"
+        return "standalone"
+
+    def initialize(self, workflow, **kwargs):
+        self.workflow = workflow
+        if self.mode == "slave":
+            workflow.is_slave = True
+        # master holds weights but never computes: numpy device is
+        # enough and avoids grabbing a TPU (reference: no Device on
+        # master [U])
+        device = "numpy" if self.mode == "master" else self.device_spec
+        workflow.initialize(device=device, **kwargs)
+        if self.snapshot:
+            from veles.snapshotter import load_snapshot
+            state = load_snapshot(self.snapshot)
+            workflow.restore_state(state)
+            self.info("resumed from %s", self.snapshot)
+        return workflow
+
+    def run(self):
+        wf = self.workflow
+        previous = signal.getsignal(signal.SIGINT)
+
+        def on_sigint(sig, frame):
+            self.interrupted = True
+            self.warning("interrupt: stopping workflow")
+            wf.stop()
+            signal.signal(signal.SIGINT, previous)
+
+        try:
+            signal.signal(signal.SIGINT, on_sigint)
+        except ValueError:          # not on the main thread
+            previous = None
+        try:
+            if self.mode == "master":
+                self._run_master()
+            elif self.mode == "slave":
+                self._run_slave()
+            else:
+                wf.run()
+        finally:
+            if previous is not None:
+                signal.signal(signal.SIGINT, previous)
+        if self.stats:
+            wf.print_stats(sys.stderr)
+        return wf
+
+    # -- distributed modes --------------------------------------------
+
+    def _run_master(self):
+        from veles.server import MasterServer
+        server = MasterServer(self.workflow, self.listen_address)
+        server.serve_forever()
+
+    def _run_slave(self):
+        from veles.client import SlaveClient
+        client = SlaveClient(self.workflow, self.master_address)
+        client.run_forever()
+
+
+def run_workflow(workflow, device=None, snapshot=None, stats=False,
+                 **kwargs):
+    """One-call convenience used by tests and samples."""
+    launcher = Launcher(device=device, snapshot=snapshot, stats=stats)
+    launcher.initialize(workflow, **kwargs)
+    return launcher.run()

@@ -194,6 +194,19 @@ class Loader(Unit, IDistributable):
             if self._cls_pos >= len(self._order):
                 self.epoch_ended << True
 
+    # -- checkpoint support (resume restarts the in-flight epoch) ------
+
+    def get_state(self):
+        return {"epoch_number": self.epoch_number,
+                "prng_state": dict(self.prng._gen.bit_generator.state)}
+
+    def set_state(self, state):
+        self.epoch_number = int(state["epoch_number"])
+        self.prng._gen.bit_generator.state = state["prng_state"]
+        # restart the in-flight epoch (snapshots happen at the valid/
+        # train boundary; replaying the epoch's eval classes is cheap)
+        self._start_epoch(first=True)
+
     # -- IDistributable: ship minibatch index ranges (SURVEY.md §3.3) --
 
     def generate_data_for_slave(self, slave=None):

@@ -1,0 +1,173 @@
+"""Master server — the wire side of the elastic-DP compat path.
+
+Re-design of ``veles/server.py`` [U] (SURVEY.md §2.2 "Master server",
+§3.3). The reference ran ZeroMQ ROUTER + Twisted; the hot path of the
+TPU rebuild is compiled collectives, so this layer only has to carry
+the *elastic* story (slaves joining/dying mid-run, master-owned weight
+averaging) and tests' master↔slave round-trips. Plain TCP with
+length-prefixed pickle frames is sufficient and dependency-free.
+
+Protocol (client-initiated, synchronous per connection):
+
+* ``("hello", name)``            → ``("welcome", slave_id)``
+* ``("job", slave_id)``          → ``("job", payload)`` |
+                                   ``("wait",)`` | ``("bye",)``
+* ``("update", slave_id, data)`` → ``("ok",)``
+
+``payload`` is the per-unit dict from
+:class:`veles.distributable.DistributionRegistry` (loader ships
+minibatch index ranges, GD units ship weights). A dead slave's
+in-flight jobs are re-queued (``drop_slave``, SURVEY.md §5.3).
+"""
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+from veles.distributable import DistributionRegistry
+from veles.logger import Logger
+
+
+def send_frame(sock, obj):
+    blob = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def recv_frame(sock):
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    size, = struct.unpack(">I", header)
+    blob = _recv_exact(sock, size)
+    return None if blob is None else pickle.loads(blob)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class MasterServer(Logger):
+    """Owns canonical weights + the job queue; never computes."""
+
+    def __init__(self, workflow, address, max_epochs=None):
+        self.name = "MasterServer"
+        self.workflow = workflow
+        host, _, port = str(address).rpartition(":")
+        self.address = (host or "0.0.0.0", int(port))
+        self.registry = DistributionRegistry(workflow)
+        self.lock = threading.RLock()
+        self.slaves = {}
+        self._next_slave = 1
+        self.epoch = 0
+        self.max_epochs = max_epochs or getattr(
+            getattr(workflow, "decision", None), "max_epochs", None) or 1
+        self.done = threading.Event()
+        self._server = None
+        loader = workflow.loader
+        loader.master_start_epoch()
+
+    # -- job lifecycle -------------------------------------------------
+
+    def handle(self, request):
+        kind = request[0]
+        with self.lock:
+            if kind == "hello":
+                slave_id = self._next_slave
+                self._next_slave += 1
+                self.slaves[slave_id] = {"name": request[1], "jobs": 0}
+                self.info("slave %d (%s) joined", slave_id, request[1])
+                return ("welcome", slave_id)
+            if kind == "job":
+                if self.done.is_set():
+                    return ("bye",)
+                job = self.registry.generate_job(request[1])
+                loader_job = job.get(self.workflow.loader.name)
+                if loader_job is None:
+                    self._advance_epoch()
+                    if self.done.is_set():
+                        return ("bye",)
+                    return ("wait",)
+                self.slaves[request[1]]["jobs"] += 1
+                return ("job", job)
+            if kind == "update":
+                self.registry.apply_update(request[2], request[1])
+                return ("ok",)
+        return ("error", "unknown request %r" % (kind,))
+
+    def _advance_epoch(self):
+        loader = self.workflow.loader
+        if loader._pending_jobs or any(loader._inflight.values()):
+            return
+        self.epoch += 1
+        if self.epoch >= self.max_epochs:
+            self.done.set()
+            return
+        loader.master_start_epoch()
+
+    def drop_slave(self, slave_id):
+        with self.lock:
+            if slave_id in self.slaves:
+                self.info("slave %d dropped; requeueing", slave_id)
+                self.registry.drop_slave(slave_id)
+                del self.slaves[slave_id]
+
+    # -- socket plumbing ----------------------------------------------
+
+    def serve_forever(self, poll=0.05):
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                slave_id = None
+                try:
+                    while not outer.done.is_set():
+                        req = recv_frame(self.request)
+                        if req is None:
+                            break
+                        if req[0] == "hello":
+                            resp = outer.handle(req)
+                            slave_id = resp[1]
+                        else:
+                            resp = outer.handle(req)
+                        send_frame(self.request, resp)
+                        if resp[0] == "bye":
+                            break
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    if slave_id is not None:
+                        outer.drop_slave(slave_id)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        with Server(self.address, Handler) as server:
+            self._server = server
+            self.bound_address = server.server_address
+            threading.Thread(target=server.serve_forever,
+                             args=(poll,), daemon=True).start()
+            self.done.wait()
+            server.shutdown()
+        return self
+
+    def start_background(self):
+        """Serve on a daemon thread (tests, co-located master)."""
+        import time
+        thread = threading.Thread(target=self.serve_forever, daemon=True)
+        thread.start()
+        for _ in range(500):
+            if hasattr(self, "bound_address"):
+                return thread
+            if not thread.is_alive():
+                break
+            time.sleep(0.01)
+        raise RuntimeError("master server failed to start")

@@ -1,0 +1,148 @@
+"""Checkpoint / resume.
+
+Re-design of ``veles/snapshotter.py`` [U] (SURVEY.md §2.7
+"Snapshotter", §3.4, §5.4). The reference pickles the ENTIRE live
+workflow; the TPU rebuild saves a *structured pytree checkpoint*
+(weights + optimizer state + loader/decision/prng state + the effective
+config) — robust across code changes and consumable by the C++ export
+path — while keeping the reference's UX:
+
+* gated by ``decision.improved`` (only better-than-best validation);
+* error-stamped filenames (``<prefix>_=0.0190.ckpt.npz.gz``);
+* "best" + "current" retention (older snapshots pruned);
+* optional gzip/bz2/lzma compression;
+* ``--snapshot file`` resume: load states into a freshly built
+  workflow and continue.
+"""
+
+import bz2
+import gzip
+import io
+import json
+import lzma
+import os
+
+import numpy
+
+from veles import prng
+from veles.config import root
+from veles.units import Unit
+
+_OPENERS = {"": open, "gz": gzip.open, "bz2": bz2.open, "xz": lzma.open}
+
+
+class SnapshotterBase(Unit):
+    """Gated checkpoint writer."""
+
+    def __init__(self, workflow, prefix="wf", compression="gz",
+                 directory=None, keep=2, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if compression not in _OPENERS:
+            raise ValueError("compression must be one of %s"
+                             % sorted(_OPENERS))
+        self.prefix = prefix
+        self.compression = compression
+        self.directory = directory or root.common.dirs.snapshots
+        self.keep = keep
+        self.decision = None
+        self.destination = None      # last written path
+        self._written = []
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def suffix(self):
+        metric = getattr(self.decision, "best_metric", None)
+        if metric is None or not numpy.isfinite(metric):
+            return "initial"
+        return "=%.6g" % metric
+
+    def run(self):
+        self.export_snapshot()
+
+    def export_snapshot(self):
+        path = os.path.join(
+            self.directory, "%s_%s.ckpt.npz%s" % (
+                self.prefix, self.suffix(),
+                "." + self.compression if self.compression else ""))
+        payload = self.workflow.checkpoint_state()
+        blob = io.BytesIO()
+        numpy.savez(blob, **_flatten_tree(payload))
+        opener = _OPENERS[self.compression]
+        with opener(path, "wb") as f:
+            f.write(blob.getvalue())
+        self.destination = path
+        self._written.append(path)
+        # retention: keep the last `keep` snapshots (newest == best so
+        # far, since the gate only opens on improvement)
+        while len(self._written) > self.keep:
+            stale = self._written.pop(0)
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        self.info("snapshot -> %s", path)
+        return path
+
+
+class Snapshotter(SnapshotterBase):
+    pass
+
+
+def load_snapshot(path):
+    """Read a checkpoint written by Snapshotter back into a state tree."""
+    base = os.path.basename(path)
+    comp = ""
+    for suffix, opener in _OPENERS.items():
+        if suffix and base.endswith("." + suffix):
+            comp = suffix
+    with _OPENERS[comp](path, "rb") as f:
+        data = f.read()
+    npz = numpy.load(io.BytesIO(data), allow_pickle=False)
+    return _unflatten_tree(dict(npz))
+
+
+def _flatten_tree(tree, prefix=""):
+    """Nested dicts of arrays/scalars -> flat {dotted/key: array}.
+    JSON-able metadata rides along under the '__json__' key."""
+    flat = {}
+    meta = {}
+
+    def rec(node, path):
+        for key, value in node.items():
+            sub = "%s/%s" % (path, key) if path else str(key)
+            if isinstance(value, dict):
+                rec(value, sub)
+            elif isinstance(value, (numpy.ndarray, numpy.generic)):
+                flat[sub] = numpy.asarray(value)
+            elif isinstance(value, (int, float, bool, str, type(None),
+                                    list, tuple)):
+                meta[sub] = value
+            else:  # device arrays and friends
+                flat[sub] = numpy.asarray(value)
+
+    rec(tree, prefix)
+    flat["__json__"] = numpy.frombuffer(
+        json.dumps(meta).encode(), dtype=numpy.uint8)
+    return flat
+
+
+def _unflatten_tree(flat):
+    meta = {}
+    if "__json__" in flat:
+        meta = json.loads(bytes(flat.pop("__json__")).decode())
+    tree = {}
+
+    def insert(path, value):
+        parts = path.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+
+    for key, value in flat.items():
+        insert(key, value)
+    for key, value in meta.items():
+        insert(key, value)
+    return tree

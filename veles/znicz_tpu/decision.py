@@ -126,6 +126,26 @@ class DecisionBase(Unit):
         if self._epochs_since_best >= self.fail_iterations:
             self.complete << True
 
+    # checkpoint support (SURVEY.md §3.4) ------------------------------
+
+    def get_state(self):
+        # plain values: the snapshotter's metadata path JSON-encodes
+        # lists/dicts natively
+        return {"epoch_number": self.epoch_number,
+                "minibatch_count": self.minibatch_count,
+                "best_metric": float(self.best_metric),
+                "best_epoch": self.best_epoch,
+                "epochs_since_best": self._epochs_since_best,
+                "history": list(self.history)}
+
+    def set_state(self, state):
+        self.epoch_number = int(state["epoch_number"])
+        self.minibatch_count = int(state["minibatch_count"])
+        self.best_metric = float(state["best_metric"])
+        self.best_epoch = int(state["best_epoch"])
+        self._epochs_since_best = int(state["epochs_since_best"])
+        self.history = list(state["history"])
+
     def on_epoch_summary(self, summary):
         parts = ["epoch %d" % summary["epoch"]]
         for cls in (CLASS_TRAIN, CLASS_VALID, CLASS_TEST):

@@ -388,3 +388,55 @@ class NNWorkflow(AcceleratedWorkflow):
         self.repeater = None
         self.snapshotter = None
         self.xla_step = None
+        #: distributed role (set by the Launcher); slaves receive their
+        #: minibatch index ranges from the master
+        self.is_slave = False
+
+    # -- checkpoint / resume (SURVEY.md §3.4, §5.4) --------------------
+
+    def _stateful_units(self):
+        seen = []
+        for u in self.forwards + self.gds:
+            if u is not None and (u.PARAMS or u.STATE):
+                seen.append(u)
+        return seen
+
+    def checkpoint_state(self):
+        """Structured pytree snapshot of everything needed to resume."""
+        if self.xla_step is not None:
+            self.xla_step.sync_host(at_valid=True)
+        tree = {"params": {}, "state": {}, "meta": {
+            "workflow": self.name, "run_number": self.run_number}}
+        for u in self._stateful_units():
+            p, s = u.export_params(), u.export_state()
+            if p:
+                tree["params"][u.name] = p
+            if s:
+                tree["state"][u.name] = s
+        if self.decision is not None:
+            tree["decision"] = self.decision.get_state()
+        if self.loader is not None:
+            tree["loader"] = self.loader.get_state()
+        if self.xla_step is not None:
+            # step counter consistent with the at_valid params/state
+            tree["meta"]["step_index"] = \
+                self.xla_step.snapshot_view(at_valid=True)[2]
+        return tree
+
+    def restore_state(self, tree):
+        """Load a checkpoint_state() tree back into the (already
+        initialized) workflow and resume device residency."""
+        for u in self._stateful_units():
+            if u.name in tree.get("params", {}):
+                u.import_params(tree["params"][u.name])
+            if u.name in tree.get("state", {}):
+                u.import_state(tree["state"][u.name])
+        if self.decision is not None and "decision" in tree:
+            self.decision.set_state(tree["decision"])
+        if self.loader is not None and "loader" in tree:
+            self.loader.set_state(tree["loader"])
+        if self.xla_step is not None:
+            self.xla_step.step_index = int(
+                tree.get("meta", {}).get("step_index", 0))
+            self.xla_step.refresh_device()
+            self.xla_step._dispatched_epoch = None

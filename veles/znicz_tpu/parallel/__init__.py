@@ -72,6 +72,7 @@ def setup_data_parallel(workflow, mesh=None):
     step = workflow.xla_step
     if step is None:
         raise ValueError("workflow has no xla_step (numpy backend?)")
+    step.sync_host()  # device values are the truth mid-run
     step.batch_sharding = batch_sharding(mesh)
     step.param_sharding = replicated(mesh)
     workflow.device.mesh = mesh

@@ -47,11 +47,14 @@ class StandardWorkflowBase(NNWorkflow):
     """Builds forwards from a layers config; subclasses add the rest."""
 
     def __init__(self, workflow=None, layers=None, loader_factory=None,
-                 decision_config=None, name=None, **kwargs):
+                 decision_config=None, snapshotter_config=None,
+                 name=None, **kwargs):
         super().__init__(workflow, name=name, **kwargs)
         self.layers_config = normalize_layers(layers or [])
         self.loader_factory = loader_factory
         self.decision_config = dict(decision_config or {})
+        #: dict -> Snapshotter kwargs; None disables checkpointing
+        self.snapshotter_config = snapshotter_config
 
     # -- builders (each mirrors a reference link_* method [U]) ---------
 
@@ -130,6 +133,18 @@ class StandardWorkflowBase(NNWorkflow):
         self.repeater.link_from(prev)
         return self.gds
 
+    def link_snapshotter(self, **cfg):
+        """Checkpoint writer gated on improved validation (reference
+        behaviour [U]; SURVEY.md §3.4)."""
+        from veles.snapshotter import Snapshotter
+        cfg.setdefault("prefix", self.name)
+        snap = Snapshotter(self, name="snapshotter", **cfg)
+        snap.decision = self.decision
+        snap.link_from(self.decision)
+        snap.gate_skip = ~self.decision.improved
+        self.snapshotter = snap
+        return snap
+
     def link_end_point(self):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
@@ -142,6 +157,8 @@ class StandardWorkflowBase(NNWorkflow):
         self.link_evaluator()
         self.link_decision()
         self.link_gds()
+        if self.snapshotter_config is not None:
+            self.link_snapshotter(**self.snapshotter_config)
         self.link_end_point()
         return self
 

@@ -85,6 +85,13 @@ class XLAStep(Unit):
         self._dispatched_epoch = None
         self._epoch_outs = {}
         self._epoch_pos = {}
+        self._pre_epoch_params = None
+        self._pre_epoch_state = None
+        self._pre_epoch_step_index = 0
+        # epoch-entry copies cost a params+state duplicate on device;
+        # only keep them when a snapshotter will consume them
+        self._keep_epoch_entry = self.scan_mode and \
+            getattr(self.workflow, "snapshotter", None) is not None
 
     def _build_batch_spec(self):
         spec = {
@@ -177,14 +184,19 @@ class XLAStep(Unit):
             valids[seg_key] = vl
         fn = self.compiler.compile_epoch_scan(self._batch_spec, segments)
         key = jax.random.fold_in(self.base_key, self.step_index)
+        # Stash a CONSISTENT epoch-entry view (params + optimizer state
+        # + step counter — the point the epoch's validation metric
+        # describes, since valid is served before train): improved-
+        # gated snapshots must save THESE, not the post-train values
+        # (per-step-mode / reference semantics, SURVEY.md §3.4). Only
+        # paid for when a snapshotter can consume it.
+        if self._keep_epoch_entry:
+            import jax.numpy as jnp
+            copy = (lambda t: jax.tree_util.tree_map(jnp.copy, t))
+            self._pre_epoch_params = copy(self.params)
+            self._pre_epoch_state = copy(self.state)
+            self._pre_epoch_step_index = self.step_index
         self.step_index += sum(idxs[k].shape[0] for k in idxs)
-        # Stash the epoch-entry params (the ones the epoch's validation
-        # metrics describe — valid is served before train): improved-
-        # gated snapshots must save THESE, not the post-train params
-        # (per-step-mode / reference semantics, SURVEY.md §3.4).
-        import jax.numpy as jnp
-        self._pre_epoch_params = jax.tree_util.tree_map(
-            jnp.copy, self.params)
         self.params, self.state, outs = fn(
             self.params, self.state, full, idxs, valids,
             self._gather_hyper(), key)
@@ -232,21 +244,25 @@ class XLAStep(Unit):
 
     # -- host sync -----------------------------------------------------
 
+    def snapshot_view(self, at_valid=False):
+        """A CONSISTENT (params, state, step_index) triple.
+
+        ``at_valid=True`` returns the state the current epoch's
+        validation metric was measured on (scan mode trains the whole
+        epoch in one dispatch, so the live values are one train segment
+        ahead of the metric that gated the snapshot)."""
+        if at_valid and self._pre_epoch_params is not None:
+            return (self._pre_epoch_params, self._pre_epoch_state,
+                    self._pre_epoch_step_index)
+        return self.params, self.state, self.step_index
+
     def sync_host(self, at_valid=False):
         """Write device-resident params/state back into the unit
-        Arrays (before snapshot / numpy cross-check).
-
-        ``at_valid=True`` syncs the params the current epoch's
-        validation metric was measured on (scan mode trains the whole
-        epoch in one dispatch, so the live params are one train segment
-        ahead of the metric that gated the snapshot)."""
-        params = self.params
-        if at_valid and getattr(self, "_pre_epoch_params", None) \
-                is not None:
-            params = self._pre_epoch_params
+        Arrays (before snapshot / numpy cross-check)."""
+        params, state, _ = self.snapshot_view(at_valid)
         self.compiler.scatter_device_params(params)
         for u in self.compiler.units:
-            tree = self.state.get(u.name)
+            tree = state.get(u.name)
             if not tree:
                 continue
             for attr, value in tree.items():

@@ -1,0 +1,10 @@
+#!/usr/bin/env python3
+"""Reference-parity shim: `python velescli.py ...` == `python -m veles ...`
+(the reference ships velescli.py delegating to veles/__main__.py [U])."""
+
+import sys
+
+from veles.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())
