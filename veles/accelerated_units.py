@@ -86,13 +86,15 @@ class AcceleratedUnit(Unit):
     # -- pytree lift/sink ---------------------------------------------
 
     def export_params(self):
-        return {name: numpy.asarray(getattr(self, name).mem)
+        # copies, not views: callers hold these across in-place numpy
+        # updates of the underlying Arrays
+        return {name: numpy.array(getattr(self, name).map_read().mem)
                 for name in self.PARAMS
                 if isinstance(getattr(self, name, None), Array)
                 and getattr(self, name)}
 
     def export_state(self):
-        return {name: numpy.asarray(getattr(self, name).mem)
+        return {name: numpy.array(getattr(self, name).map_read().mem)
                 for name in self.STATE
                 if isinstance(getattr(self, name, None), Array)
                 and getattr(self, name)}

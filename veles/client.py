@@ -12,6 +12,7 @@ import socket
 import time
 
 from veles.distributable import DistributionRegistry
+from veles.loader.base import CLASS_TRAIN
 from veles.logger import Logger
 from veles.server import send_frame, recv_frame
 
@@ -65,7 +66,7 @@ class SlaveClient(Logger):
             for u in wf.forwards:
                 u.run()
             wf.evaluator.run()
-            if wf.loader.minibatch_class == 2:  # CLASS_TRAIN
+            if wf.loader.minibatch_class == CLASS_TRAIN:
                 for gd in reversed(wf.gds):
                     gd.run()
 

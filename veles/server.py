@@ -67,8 +67,17 @@ class MasterServer(Logger):
         self.slaves = {}
         self._next_slave = 1
         self.epoch = 0
-        self.max_epochs = max_epochs or getattr(
-            getattr(workflow, "decision", None), "max_epochs", None) or 1
+        if max_epochs is None:
+            max_epochs = getattr(
+                getattr(workflow, "decision", None), "max_epochs", None)
+        if max_epochs is None:
+            # the master never runs the decision unit, so patience-only
+            # stopping cannot work here — demand an explicit bound
+            raise ValueError(
+                "MasterServer needs max_epochs (decision.max_epochs is "
+                "None; early-stopping-only configs cannot drive a "
+                "master)")
+        self.max_epochs = int(max_epochs)
         self.done = threading.Event()
         self._server = None
         loader = workflow.loader
@@ -88,12 +97,15 @@ class MasterServer(Logger):
             if kind == "job":
                 if self.done.is_set():
                     return ("bye",)
-                job = self.registry.generate_job(request[1])
-                loader_job = job.get(self.workflow.loader.name)
-                if loader_job is None:
+                # cheap emptiness check BEFORE serializing weight
+                # payloads — idle slaves poll here every 20ms
+                if not self.workflow.loader._pending_jobs:
                     self._advance_epoch()
                     if self.done.is_set():
                         return ("bye",)
+                    return ("wait",)
+                job = self.registry.generate_job(request[1])
+                if job.get(self.workflow.loader.name) is None:
                     return ("wait",)
                 self.slaves[request[1]]["jobs"] += 1
                 return ("job", job)

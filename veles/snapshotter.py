@@ -73,6 +73,9 @@ class SnapshotterBase(Unit):
         with opener(path, "wb") as f:
             f.write(blob.getvalue())
         self.destination = path
+        # same-suffix rewrites refresh their retention slot
+        if path in self._written:
+            self._written.remove(path)
         self._written.append(path)
         # retention: keep the last `keep` snapshots (newest == best so
         # far, since the gate only opens on improvement)

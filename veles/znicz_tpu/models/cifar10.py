@@ -1,0 +1,77 @@
+"""CIFAR-10 sample: small convnet (BASELINE config #2).
+
+Rebuild of reference ``samples/CIFAR10/cifar.py`` + config [U]
+(SURVEY.md §2.8): conv_relu → pooling → conv_relu → pooling → softmax,
+exercising Conv/Pooling/GDConv/GDPooling. NHWC layout; real CIFAR-10
+binary batches if on disk, deterministic synthetic stand-in otherwise.
+"""
+
+import numpy
+
+from veles.config import root
+from veles.loader.fullbatch import FullBatchLoader
+from veles.znicz_tpu.models import datasets
+from veles.znicz_tpu.standard_workflow import StandardWorkflow
+
+root.cifar.update({
+    "loader": {"minibatch_size": 100, "n_train": 5000, "n_valid": 1000},
+    "layers": [
+        {"type": "conv_relu",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.0005,
+                "gradient_moment": 0.7}},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "conv_relu",
+         "->": {"n_kernels": 64, "kx": 5, "ky": 5, "padding": 2},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.0005,
+                "gradient_moment": 0.7}},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "softmax",
+         "->": {"output_sample_shape": 10},
+         "<-": {"learning_rate": 0.01, "weights_decay": 0.0005,
+                "gradient_moment": 0.7}},
+    ],
+    "decision": {"max_epochs": 10, "fail_iterations": 50},
+})
+
+
+class CifarLoader(FullBatchLoader):
+    """NHWC image loader (CHW source converted once at load)."""
+
+    def load_data(self):
+        tx, ty, vx, vy = datasets.load_cifar10()
+        if tx.shape[1] == 3:                # CHW -> HWC
+            tx = tx.transpose(0, 2, 3, 1)
+            vx = vx.transpose(0, 2, 3, 1)
+        n_train = root.cifar.loader.get("n_train", len(tx))
+        n_valid = root.cifar.loader.get("n_valid", len(vx))
+        tx, ty = tx[:n_train], ty[:n_train]
+        vx, vy = vx[:n_valid], vy[:n_valid]
+        mean = tx.mean(axis=0, keepdims=True)
+        std = max(float(tx.std()), 1e-6)
+        self.original_data.mem = (numpy.concatenate(
+            [vx, tx]).astype(numpy.float32) - mean) / std
+        self.original_labels.mem = numpy.concatenate([vy, ty])
+        self.class_lengths = [0, len(vx), len(tx)]
+
+
+def create_workflow(name="CifarWorkflow", **kwargs):
+    cfg = root.cifar
+    return StandardWorkflow(
+        None, name=name,
+        layers=cfg.layers,
+        loader_factory=lambda wf: CifarLoader(
+            wf, name="loader",
+            minibatch_size=cfg.loader.minibatch_size),
+        decision_config=cfg.decision.to_dict(),
+        **kwargs)
+
+
+def run(load, main):
+    load(StandardWorkflow,
+         layers=root.cifar.layers,
+         loader_factory=lambda wf: CifarLoader(
+             wf, name="loader",
+             minibatch_size=root.cifar.loader.minibatch_size),
+         decision_config=root.cifar.decision.to_dict())
+    main()

@@ -16,3 +16,30 @@ from veles.znicz_tpu.ops.gd import (  # noqa: F401
 from veles.znicz_tpu.ops.evaluator import (  # noqa: F401
     EvaluatorBase, EvaluatorSoftmax, EvaluatorMSE,
 )
+from veles.znicz_tpu.ops.conv import (  # noqa: F401
+    Conv, ConvTanh, ConvRELU, ConvStrictRELU, ConvSigmoid,
+)
+from veles.znicz_tpu.ops.gd_conv import (  # noqa: F401
+    GradientDescentConv, GDTanhConv, GDRELUConv, GDStrictRELUConv,
+    GDSigmoidConv,
+)
+from veles.znicz_tpu.ops.pooling import (  # noqa: F401
+    MaxPooling, MaxAbsPooling, AvgPooling, StochasticPooling,
+)
+from veles.znicz_tpu.ops.gd_pooling import (  # noqa: F401
+    GDMaxPooling, GDMaxAbsPooling, GDAvgPooling, GDStochasticPooling,
+)
+from veles.znicz_tpu.ops.normalization import (  # noqa: F401
+    LRNormalizerForward, LRNormalizerBackward,
+)
+from veles.znicz_tpu.ops.dropout import (  # noqa: F401
+    DropoutForward, DropoutBackward,
+)
+from veles.znicz_tpu.ops import activation  # noqa: F401
+from veles.znicz_tpu.ops.cutter import Cutter, GDCutter, ZeroFiller  # noqa: F401
+from veles.znicz_tpu.ops.deconv import (  # noqa: F401
+    Deconv, GDDeconv, Depooling, GDDepooling,
+)
+from veles.znicz_tpu.ops.mean_disp_normalizer import (  # noqa: F401
+    MeanDispNormalizer,
+)

@@ -1,0 +1,131 @@
+"""Standalone activation unit pairs.
+
+Re-design of znicz ``activation.py`` [U] (SURVEY.md §2.4 "Standalone
+activations"): activation-only Forward/Backward pairs (tanh, relu,
+strict relu, sigmoid, log, mul, tanhlog, sincos). The backward
+multiplies the error by the derivative; derivative is by-output where
+possible, by-input otherwise (log/sincos keep the input around).
+"""
+
+import numpy
+
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+from veles.znicz_tpu.ops import activations as A
+
+
+class ActivationForward(Forward):
+    """y = f(x), shape-preserving, no weights."""
+
+    PARAMS = ()
+    #: (forward(xp, x), derivative(xp, x, y))
+    FUNC = (None, None)
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.include_bias = False
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        self.output.map_invalidate()
+        self.output.mem[...] = type(self).FUNC[0](numpy, x)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        ctx.set(self, "output",
+                type(self).FUNC[0](jnp, x).astype(jnp.float32))
+
+
+class ActivationBackward(GradientDescentBase):
+    STATE = ()
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        y = f.output.map_read().mem
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(y.shape)
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = err * type(f).FUNC[1](numpy, x, y)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        y = ctx.get(f, "output")
+        err = ctx.get(self, "err_output").reshape(y.shape)
+        ctx.set(self, "err_input",
+                (err * type(f).FUNC[1](jnp, x, y)).astype(jnp.float32))
+
+
+def _pair(name, fwd, deriv):
+    """Register an activation Forward/Backward unit pair."""
+    fwd_cls = forward_unit(name)(type(
+        "ActivationForward_%s" % name.split("_")[-1],
+        (ActivationForward,), {"FUNC": (fwd, deriv)}))
+    bwd_cls = gradient_for(fwd_cls)(type(
+        "ActivationBackward_%s" % name.split("_")[-1],
+        (ActivationBackward,), {}))
+    return fwd_cls, bwd_cls
+
+
+ForwardTanh, BackwardTanh = _pair(
+    "activation_tanh",
+    lambda xp, x: A.tanh(xp, x),
+    lambda xp, x, y: A.dtanh(xp, y))
+ForwardRELU, BackwardRELU = _pair(
+    "activation_relu",
+    lambda xp, x: A.softrelu(xp, x),
+    lambda xp, x, y: A.dsoftrelu(xp, y))
+ForwardStrictRELU, BackwardStrictRELU = _pair(
+    "activation_str",
+    lambda xp, x: A.strict_relu(xp, x),
+    lambda xp, x, y: A.dstrict_relu(xp, y))
+ForwardSigmoid, BackwardSigmoid = _pair(
+    "activation_sigmoid",
+    lambda xp, x: A.sigmoid(xp, x),
+    lambda xp, x, y: A.dsigmoid(xp, y))
+ForwardLog, BackwardLog = _pair(
+    "activation_log",
+    lambda xp, x: xp.log(x + xp.sqrt(x * x + 1.0)),
+    lambda xp, x, y: 1.0 / xp.sqrt(x * x + 1.0))
+ForwardMul, BackwardMul = _pair(
+    "activation_mul",
+    lambda xp, x: x * 1.0,
+    lambda xp, x, y: 1.0 + 0.0 * x)
+ForwardTanhLog, BackwardTanhLog = _pair(
+    "activation_tanhlog",
+    lambda xp, x: xp.where(
+        xp.abs(x) <= 15.0 / 9.0, A.tanh(xp, x),
+        xp.sign(x) * (xp.log(xp.abs(x) * (9.0 / 15.0)) + 1.7159)),
+    lambda xp, x, y: xp.where(
+        xp.abs(x) <= 15.0 / 9.0, A.dtanh(xp, A.tanh(xp, x)),
+        1.0 / xp.maximum(xp.abs(x), 1e-30)))
+ForwardSinCos, BackwardSinCos = _pair(
+    "activation_sincos",
+    lambda xp, x: _sincos(xp, x),
+    lambda xp, x, y: _dsincos(xp, x))
+
+
+def _even_mask(xp, x):
+    n = x.shape[-1]
+    return (xp.arange(n) % 2 == 0)
+
+
+def _sincos(xp, x):
+    """Even channels sin, odd channels cos (reference SinCos [U?])."""
+    return xp.where(_even_mask(xp, x), xp.sin(x), xp.cos(x))
+
+
+def _dsincos(xp, x):
+    return xp.where(_even_mask(xp, x), xp.cos(x), -xp.sin(x))

@@ -1,0 +1,120 @@
+"""2-D convolution forward units.
+
+Re-design of znicz ``conv.py`` [U] (SURVEY.md §2.4 "Convolution"):
+kx/ky/n_kernels, ``sliding`` stride, explicit ``padding``, fused
+activation variants. The numpy oracle is im2col+GEMM exactly like the
+reference kernels; the traced path is one
+``lax.conv_general_dilated`` in NHWC/HWIO — the native layout for the
+MXU (the conv *is* the tiled GEMM; XLA owns the tiling the reference
+hand-tuned per device via BLOCK_SIZE, SURVEY.md §2.5).
+
+Weights are stored reference-style as ``(n_kernels, ky*kx*C)``.
+"""
+
+import numpy
+
+from veles.memory import Array
+from veles.znicz_tpu.nn_units import Forward, forward_unit
+from veles.znicz_tpu.ops import activations as A
+from veles.znicz_tpu.ops import conv_math as CM
+
+
+class ConvBase(Forward):
+    """Convolution: output = act(conv(input, weights) + bias)."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, n_kernels=None, kx=None, ky=None,
+                 sliding=(1, 1), padding=0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if not all((n_kernels, kx, ky)):
+            raise ValueError("%s needs n_kernels, kx, ky"
+                             % type(self).__name__)
+        self.n_kernels = int(n_kernels)
+        self.kx, self.ky = int(kx), int(ky)
+        if isinstance(sliding, int):
+            sliding = (sliding, sliding)
+        self.sliding = tuple(int(s) for s in sliding)
+        self.padding = CM.normalize_padding(padding)
+
+    # -- shapes ---------------------------------------------------------
+
+    def output_shape_for(self, ishape):
+        b, h, w, c = ishape
+        top, bottom, left, right = self.padding
+        oy = CM.out_size(h, self.ky, self.sliding[0], top, bottom)
+        ox = CM.out_size(w, self.kx, self.sliding[1], left, right)
+        return (b, oy, ox, self.n_kernels)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        b, h, w, c = self.input.shape
+        fan_in = self.ky * self.kx * c
+        self.init_weights((self.n_kernels, fan_in),
+                          fan_in, self.n_kernels)
+        oshape = self.output_shape_for(self.input.shape)
+        if not self.output or self.output.shape != oshape:
+            self.output.reset(numpy.zeros(oshape, numpy.float32))
+
+    # -- oracle: im2col + GEMM (reference kernel structure) -------------
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        w = self.weights.map_read().mem
+        cols = CM.im2col(numpy, x, self.ky, self.kx, self.sliding,
+                         self.padding)
+        v = cols @ w.T
+        if self.include_bias:
+            v = v + self.bias.map_read().mem
+        self.output.map_invalidate()
+        self.output.mem[...] = A.ACTIVATIONS[self.ACTIVATION][0](numpy, v)
+
+    # -- traced: one XLA conv onto the MXU ------------------------------
+
+    def xla_run(self, ctx):
+        import jax
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        p = ctx.unit_params(self)
+        w = p["weights"]
+        c = x.shape[-1]
+        w_hwio = w.reshape(self.n_kernels, self.ky, self.kx, c) \
+            .transpose(1, 2, 3, 0)
+        cd = ctx._compiler.device.compute_dtype
+        top, bottom, left, right = self.padding
+        v = jax.lax.conv_general_dilated(
+            x.astype(cd), w_hwio.astype(cd),
+            window_strides=self.sliding,
+            padding=((top, bottom), (left, right)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if self.include_bias:
+            v = v + p["bias"]
+        ctx.set(self, "output",
+                A.ACTIVATIONS[self.ACTIVATION][0](jnp, v)
+                .astype(jnp.float32))
+
+
+@forward_unit("conv")
+class Conv(ConvBase):
+    ACTIVATION = "linear"
+
+
+@forward_unit("conv_tanh")
+class ConvTanh(ConvBase):
+    ACTIVATION = "tanh"
+
+
+@forward_unit("conv_relu")
+class ConvRELU(ConvBase):
+    ACTIVATION = "relu"
+
+
+@forward_unit("conv_str")
+class ConvStrictRELU(ConvBase):
+    ACTIVATION = "strict_relu"
+
+
+@forward_unit("conv_sigmoid")
+class ConvSigmoid(ConvBase):
+    ACTIVATION = "sigmoid"

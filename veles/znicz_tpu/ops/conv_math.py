@@ -1,0 +1,89 @@
+"""Shared spatial-op math, generic over ``xp`` (numpy oracle / jnp).
+
+The reference implements conv as im2col-unpack + tiled GEMM and its
+backward as col2im scatter (SURVEY.md §2.4 "Convolution"/"Conv
+backward"). Here the *oracle* keeps exactly that structure (these
+helpers), while the traced path uses ``lax.conv_general_dilated`` so
+XLA drives the MXU directly; both are asserted equal in tests.
+
+Layout is NHWC throughout — the TPU-native choice (channels on the
+128-lane minor dimension), unlike the reference's interleaved layouts.
+"""
+
+import numpy
+
+
+def out_size(size, k, stride, pad_lo, pad_hi):
+    return (size + pad_lo + pad_hi - k) // stride + 1
+
+
+def normalize_padding(padding):
+    """-> (top, bottom, left, right). Accepts int, (py, px) or the
+    4-tuple."""
+    if isinstance(padding, int):
+        return (padding,) * 4
+    if len(padding) == 2:
+        py, px = padding
+        return (py, py, px, px)
+    if len(padding) == 4:
+        return tuple(int(p) for p in padding)
+    raise ValueError("bad padding %r" % (padding,))
+
+
+def pad_nhwc(xp, x, pads):
+    top, bottom, left, right = pads
+    if not any(pads):
+        return x
+    return xp.pad(x, ((0, 0), (top, bottom), (left, right), (0, 0)))
+
+
+def im2col(xp, x, ky, kx, stride, pads):
+    """(B,H,W,C) -> (B, oy, ox, ky*kx*C) patch tensor."""
+    x = pad_nhwc(xp, x, pads)
+    b, h, w, c = x.shape
+    sy, sx = stride
+    oy = (h - ky) // sy + 1
+    ox = (w - kx) // sx + 1
+    rows = []
+    for p in range(ky):
+        for q in range(kx):
+            rows.append(x[:, p:p + sy * oy:sy, q:q + sx * ox:sx, :])
+    stacked = xp.stack(rows, axis=3)        # (B, oy, ox, ky*kx, C)
+    return stacked.reshape(b, oy, ox, ky * kx * c)
+
+
+def col2im(xp, cols, input_shape, ky, kx, stride, pads):
+    """Adjoint of im2col: overlap-add patches back to (B,H,W,C)."""
+    b, h, w, c = input_shape
+    top, bottom, left, right = pads
+    hp, wp = h + top + bottom, w + left + right
+    sy, sx = stride
+    oy = (hp - ky) // sy + 1
+    ox = (wp - kx) // sx + 1
+    cols = cols.reshape(b, oy, ox, ky * kx, c)
+    acc = xp.zeros((b, hp, wp, c), cols.dtype)
+    for p in range(ky):
+        for q in range(kx):
+            piece = cols[:, :, :, p * kx + q, :]
+            if xp is numpy:
+                acc[:, p:p + sy * oy:sy, q:q + sx * ox:sx, :] += piece
+            else:
+                acc = acc.at[:, p:p + sy * oy:sy,
+                             q:q + sx * ox:sx, :].add(piece)
+    return acc[:, top:top + h, left:left + w, :]
+
+
+def sliding_channel_sum(xp, x, window, reverse=False):
+    """Sum over a centered window along the channel (last) axis, same
+    length out (AlexNet LRN's cross-map window). ``reverse`` flips the
+    window asymmetry — the adjoint for even windows."""
+    half_lo = (window - 1) // 2
+    half_hi = window - 1 - half_lo
+    if reverse:
+        half_lo, half_hi = half_hi, half_lo
+    padded = xp.pad(x, [(0, 0)] * (x.ndim - 1) + [(half_lo, half_hi)])
+    csum = xp.cumsum(padded, axis=-1)
+    zero = xp.zeros_like(csum[..., :1])
+    csum = xp.concatenate([zero, csum], axis=-1)
+    n = x.shape[-1]
+    return csum[..., window:window + n] - csum[..., :n]

@@ -1,0 +1,253 @@
+"""Deconvolution (transposed conv) + depooling — the autoencoder path.
+
+Re-design of znicz ``deconv.py`` / ``gd_deconv.py`` / ``depooling.py``
+[U] (SURVEY.md §2.4 "Deconv / autoencoder path"):
+
+* ``Deconv`` forward IS the conv backward's err_input computation
+  (col2im / input-dilated conv), sharing weights layout with ``Conv``
+  so autoencoders can tie them;
+* ``GDDeconv`` backward is the plain conv (the adjoint pair swaps);
+* ``Depooling`` upsamples by spreading each value uniformly over its
+  pooling window (the adjoint of average pooling).
+"""
+
+import numpy
+
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+from veles.znicz_tpu.ops import conv_math as CM
+
+
+@forward_unit("deconv")
+class Deconv(Forward):
+    """Transposed convolution: input (B,oy,ox,K) -> output (B,H,W,C).
+
+    ``output_shape_source`` (a unit or shape tuple) pins the exact
+    output size, as the reference does by linking the paired Conv's
+    input shape [U]."""
+
+    def __init__(self, workflow, n_kernels=None, kx=None, ky=None,
+                 sliding=(1, 1), padding=0, n_channels=None,
+                 output_shape_source=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if not all((n_kernels, kx, ky)):
+            raise ValueError("Deconv needs n_kernels, kx, ky")
+        self.n_kernels = int(n_kernels)
+        self.kx, self.ky = int(kx), int(ky)
+        if isinstance(sliding, int):
+            sliding = (sliding, sliding)
+        self.sliding = tuple(int(s) for s in sliding)
+        self.padding = CM.normalize_padding(padding)
+        self.n_channels = n_channels
+        self.output_shape_source = output_shape_source
+        self.include_bias = kwargs.get("include_bias", False)
+
+    def _resolve_output_shape(self):
+        b = self.input.shape[0]
+        src = self.output_shape_source
+        if src is not None:
+            shape = getattr(getattr(src, "input", None), "shape", src)
+            return (b,) + tuple(shape[1:])
+        top, bottom, left, right = self.padding
+        sy, sx = self.sliding
+        _, oy, ox, _ = self.input.shape
+        h = sy * (oy - 1) + self.ky - top - bottom
+        w = sx * (ox - 1) + self.kx - left - right
+        return (b, h, w, self.n_channels or self.n_kernels)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        oshape = self._resolve_output_shape()
+        self._oshape = oshape
+        c = oshape[-1]
+        fan_in = self.ky * self.kx * c
+        self.init_weights((self.n_kernels, fan_in),
+                          self.n_kernels, fan_in)
+        if not self.output or self.output.shape != oshape:
+            self.output.reset(numpy.zeros(oshape, numpy.float32))
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        w = self.weights.map_read().mem       # (K, ky*kx*C)
+        b_, oy, ox, k = x.shape
+        cols = x.reshape(-1, k) @ w           # (B*oy*ox, ky*kx*C)
+        y = CM.col2im(numpy, cols.reshape(b_, oy, ox, -1),
+                      self._oshape, self.ky, self.kx, self.sliding,
+                      self.padding)
+        self.output.map_invalidate()
+        self.output.mem[...] = y
+
+    def xla_run(self, ctx):
+        import jax
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        w = ctx.unit_params(self)["weights"]
+        oshape = self._oshape
+        c = oshape[-1]
+        cd = ctx._compiler.device.compute_dtype
+        top, bottom, left, right = self.padding
+        sy, sx = self.sliding
+        ry = (oshape[1] + top + bottom - self.ky) % sy
+        rx = (oshape[2] + left + right - self.kx) % sx
+        w_hwio = w.reshape(self.n_kernels, self.ky, self.kx, c) \
+            .transpose(1, 2, 3, 0)
+        w_flip = w_hwio[::-1, ::-1, :, :].transpose(0, 1, 3, 2)
+        y = jax.lax.conv_general_dilated(
+            x.astype(cd), w_flip.astype(cd), window_strides=(1, 1),
+            padding=((self.ky - 1 - top, self.ky - 1 - bottom + ry),
+                     (self.kx - 1 - left, self.kx - 1 - right + rx)),
+            lhs_dilation=(sy, sx),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        ctx.set(self, "output", y.astype(jnp.float32))
+
+
+@gradient_for(Deconv)
+class GDDeconv(GradientDescentBase):
+    """Backward of deconv: err_input by the forward conv; ΔW as the
+    same patch GEMM with roles swapped."""
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(f.output.shape)
+        w = f.weights.map_read().mem
+        cols = CM.im2col(numpy, err, f.ky, f.kx, f.sliding, f.padding)
+        if self.need_err_input:
+            ei = cols.reshape(-1, cols.shape[-1]) @ w.T
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = ei.reshape(x.shape)
+        grad_w = x.reshape(-1, x.shape[-1]).T @ \
+            cols.reshape(-1, cols.shape[-1])
+        self.update_weights_numpy(grad_w, None)
+
+    def xla_run(self, ctx):
+        import jax
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        err = ctx.get(self, "err_output").reshape(f._oshape)
+        w = ctx.unit_params(f)["weights"]
+        c = f._oshape[-1]
+        cd = ctx._compiler.device.compute_dtype
+        top, bottom, left, right = f.padding
+        w_hwio = w.reshape(f.n_kernels, f.ky, f.kx, c) \
+            .transpose(1, 2, 3, 0)
+        if self.need_err_input:
+            ei = jax.lax.conv_general_dilated(
+                err.astype(cd), w_hwio.astype(cd),
+                window_strides=f.sliding,
+                padding=((top, bottom), (left, right)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32)
+            ctx.set(self, "err_input", ei)
+        sy, sx = f.sliding
+        ry = (err.shape[1] + top + bottom - f.ky) % sy
+        rx = (err.shape[2] + left + right - f.kx) % sx
+        gw = jax.lax.conv_general_dilated(
+            err.transpose(3, 1, 2, 0).astype(cd),
+            x.transpose(1, 2, 0, 3).astype(cd),
+            window_strides=(1, 1),
+            padding=((top, bottom - ry), (left, right - rx)),
+            rhs_dilation=(sy, sx),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)  # (C, ky, kx, K)
+        grad_w = gw.transpose(3, 1, 2, 0) \
+            .reshape(f.n_kernels, f.ky * f.kx * c)
+        self.update_weights_xla(ctx, grad_w, None)
+
+
+@forward_unit("depooling")
+class Depooling(Forward):
+    """Upsample by spreading each value over its ky×kx window (adjoint
+    of average pooling; reference ``Depooling`` [U])."""
+
+    PARAMS = ()
+
+    def __init__(self, workflow, kx=2, ky=2, sliding=None,
+                 output_shape_source=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx, self.ky = int(kx), int(ky)
+        if sliding is None:
+            sliding = (self.ky, self.kx)
+        self.sliding = tuple(sliding) if not isinstance(sliding, int) \
+            else (sliding, sliding)
+        self.output_shape_source = output_shape_source
+        self.include_bias = False
+
+    def _resolve_output_shape(self):
+        b, oy, ox, c = self.input.shape
+        src = self.output_shape_source
+        if src is not None:
+            shape = getattr(getattr(src, "input", None), "shape", src)
+            return (b,) + tuple(shape[1:])
+        sy, sx = self.sliding
+        return (b, sy * (oy - 1) + self.ky, sx * (ox - 1) + self.kx, c)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self._oshape = self._resolve_output_shape()
+        if not self.output or self.output.shape != self._oshape:
+            self.output.reset(numpy.zeros(self._oshape, numpy.float32))
+
+    def _spread(self, xp, x):
+        b, oy, ox, c = x.shape
+        kk = self.ky * self.kx
+        patches = xp.broadcast_to(
+            x[:, :, :, None, :] / float(kk), (b, oy, ox, kk, c))
+        oshape = self._oshape
+        sy, sx = self.sliding
+        need_h = sy * (oy - 1) + self.ky
+        need_w = sx * (ox - 1) + self.kx
+        full = CM.col2im(
+            xp, patches.reshape(b, oy, ox, kk * c),
+            (b, need_h, need_w, c), self.ky, self.kx, self.sliding,
+            (0, 0, 0, 0))
+        return full[:, :oshape[1], :oshape[2], :]
+
+    def numpy_run(self):
+        self.output.map_invalidate()
+        self.output.mem[...] = self._spread(
+            numpy, self.input.map_read().mem.astype(numpy.float32))
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        ctx.set(self, "output",
+                self._spread(jnp, ctx.get(self, "input"))
+                .astype(jnp.float32))
+
+
+@gradient_for(Depooling)
+class GDDepooling(GradientDescentBase):
+    """Adjoint of the spread: window-average the error back down."""
+
+    STATE = ()
+
+    def _gather(self, xp, err):
+        f = self.forward
+        b, oy, ox, c = f.input.shape
+        sy, sx = f.sliding
+        need_h = sy * (oy - 1) + f.ky
+        need_w = sx * (ox - 1) + f.kx
+        pad_h = need_h - err.shape[1]
+        pad_w = need_w - err.shape[2]
+        if pad_h or pad_w:
+            err = xp.pad(err, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        cols = CM.im2col(xp, err, f.ky, f.kx, f.sliding, (0, 0, 0, 0))
+        kk = f.ky * f.kx
+        return cols.reshape(b, oy, ox, kk, c).sum(axis=3) / float(kk)
+
+    def numpy_run(self):
+        f = self.forward
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(f.output.shape)
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = self._gather(numpy, err)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        err = ctx.get(self, "err_output").reshape(f.output.shape)
+        ctx.set(self, "err_input",
+                self._gather(jnp, err).astype(jnp.float32))

@@ -1,0 +1,92 @@
+"""Dropout unit pair.
+
+Re-design of znicz ``dropout.py`` [U] (SURVEY.md §2.4 "Dropout"): the
+forward multiplies by a Bernoulli mask drawn from the on-device PRNG
+(the reference's ``Uniform`` unit + mask-multiply kernel); the backward
+masks the error with the SAME mask. Inverted-dropout scaling (kept
+units scaled by 1/(1-p)) so eval is the identity.
+
+RNG contract (SURVEY.md §7 "Exact-parity RNG"): the numpy oracle draws
+from the seeded host generator; the traced path derives a fresh
+``jax.random`` key per unit per step. The two match statistically, not
+bitwise — goldens for dropout nets compare convergence.
+"""
+
+import numpy
+
+from veles import prng
+from veles.memory import Array
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+
+
+@forward_unit("dropout")
+class DropoutForward(Forward):
+    PARAMS = ()
+
+    def __init__(self, workflow, dropout_ratio=0.5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.dropout_ratio = float(dropout_ratio)
+        self.include_bias = False
+        self.mask = Array()
+        self.rand = prng.get(kwargs.get("prng_key", "dropout"))
+        #: eval mode runs the identity (flipped by Decision/gates on
+        #: the oracle path; ctx.train on the compiled path)
+        self.forward_mode = True
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        shape = self.input.shape
+        if not self.output or self.output.shape != shape:
+            self.output.reset(numpy.zeros(shape, numpy.float32))
+        if not self.mask or self.mask.shape != shape:
+            self.mask.reset(numpy.ones(shape, numpy.float32))
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        self.output.map_invalidate()
+        train = self.forward_mode and bool(
+            getattr(self.workflow, "loader", None) is None
+            or self.workflow.loader.train_phase)
+        if not train:
+            self.output.mem[...] = x
+            return
+        keep = 1.0 - self.dropout_ratio
+        u = self.rand.random_sample(x.shape)
+        self.mask.map_invalidate()
+        self.mask.mem[...] = (u < keep).astype(numpy.float32) / keep
+        self.output.mem[...] = x * self.mask.mem
+
+    def xla_run(self, ctx):
+        import jax
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        if not ctx.train:
+            ctx.set(self, "output", x)
+            return
+        keep = 1.0 - self.dropout_ratio
+        u = jax.random.uniform(ctx.fold_key(self), x.shape)
+        mask = (u < keep).astype(jnp.float32) / keep
+        ctx.set(self, "mask", mask)
+        ctx.set(self, "output", (x * mask).astype(jnp.float32))
+
+
+@gradient_for(DropoutForward)
+class DropoutBackward(GradientDescentBase):
+    STATE = ()
+
+    def numpy_run(self):
+        f = self.forward
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(f.input.shape)
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = err * f.mask.map_read().mem
+
+    def xla_run(self, ctx):
+        f = self.forward
+        err = ctx.get(self, "err_output")
+        mask = ctx.get(f, "mask")
+        ctx.set(self, "err_input", (err.reshape(mask.shape) * mask))

@@ -1,0 +1,113 @@
+"""Pooling backward units.
+
+Re-design of znicz ``gd_pooling.py`` [U] (SURVEY.md §2.4 "Pooling
+backward"): max variants route each window's error through the winner
+offset the forward recorded; avg spreads it uniformly over the true
+window size. The scatter is the shared ``col2im`` overlap-add in both
+backends. Pooling has no weights — these units only transform error.
+"""
+
+import numpy
+
+from veles.znicz_tpu.nn_units import GradientDescentBase, gradient_for
+from veles.znicz_tpu.ops import conv_math as CM
+from veles.znicz_tpu.ops.pooling import (
+    MaxPooling, MaxAbsPooling, AvgPooling, StochasticPooling)
+
+
+class GDPoolingBase(GradientDescentBase):
+    """No parameters: backward is pure error routing."""
+
+    STATE = ()
+
+    def _window_geometry(self):
+        f = self.forward
+        oshape = f.output.shape
+        sy, sx = f.sliding
+        need_h = (oshape[1] - 1) * sy + f.ky
+        need_w = (oshape[2] - 1) * sx + f.kx
+        return oshape, need_h, need_w
+
+    def _scatter(self, xp, err_patches):
+        """(B,oy,ox,kk,C) window errors -> input-shaped tensor."""
+        f = self.forward
+        ishape = f.input.shape
+        oshape, need_h, need_w = self._window_geometry()
+        padded_shape = (ishape[0], need_h, need_w, ishape[3])
+        b, oy, ox, kk, c = err_patches.shape
+        full = CM.col2im(xp, err_patches.reshape(b, oy, ox, kk * c),
+                         padded_shape, f.ky, f.kx, f.sliding,
+                         (0, 0, 0, 0))
+        return full[:, :ishape[1], :ishape[2], :]
+
+    def numpy_run(self):
+        f = self.forward
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(f.output.shape)
+        ei = self._route(numpy, err, None)
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = ei
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        err = ctx.get(self, "err_output").reshape(f.output.shape)
+        ctx.set(self, "err_input",
+                self._route(jnp, err, ctx).astype(jnp.float32))
+
+    def _route(self, xp, err, ctx):
+        raise NotImplementedError
+
+
+class GDMaxPoolingBase(GDPoolingBase):
+    def _offsets(self, xp, ctx):
+        f = self.forward
+        if ctx is None:
+            return f.input_offset.map_read().mem
+        return ctx.get(f, "input_offset")
+
+    def _route(self, xp, err, ctx):
+        f = self.forward
+        sel = self._offsets(xp, ctx)                 # (B,oy,ox,C)
+        kk = f.ky * f.kx
+        onehot = (xp.arange(kk)[None, None, None, :, None]
+                  == sel[:, :, :, None, :])
+        err_patches = xp.where(onehot, err[:, :, :, None, :], 0.0)
+        return self._scatter(xp, err_patches)
+
+
+@gradient_for(MaxPooling)
+class GDMaxPooling(GDMaxPoolingBase):
+    pass
+
+
+@gradient_for(MaxAbsPooling)
+class GDMaxAbsPooling(GDMaxPoolingBase):
+    pass
+
+
+@gradient_for(StochasticPooling)
+class GDStochasticPooling(GDMaxPoolingBase):
+    pass
+
+
+@gradient_for(AvgPooling)
+class GDAvgPooling(GDPoolingBase):
+    def _route(self, xp, err, ctx):
+        f = self.forward
+        ishape = f.input.shape
+        kk = f.ky * f.kx
+        # per-window true size (edge windows are partial)
+        if ctx is None:
+            ones = numpy.ones(ishape, numpy.float32)
+        else:
+            import jax.numpy as jnp
+            ones = jnp.ones(ishape, jnp.float32)
+        counts = f._padded_patches(xp, ones, 0.0).sum(axis=3)
+        spread = err / xp.maximum(counts, 1.0)
+        err_patches = xp.broadcast_to(
+            spread[:, :, :, None, :],
+            spread.shape[:3] + (kk,) + spread.shape[3:])
+        # mask out the padded (nonexistent) window cells
+        mask = f._padded_patches(xp, ones, 0.0)
+        return self._scatter(xp, err_patches * mask)

@@ -1,0 +1,48 @@
+"""On-device minibatch input normalization.
+
+Re-design of znicz ``mean_disp_normalizer.py`` [U] (SURVEY.md §2.4
+"Input normalizer unit"): y = (x − mean) · rdisp with precomputed
+per-feature mean / reciprocal-dispersion arrays (the ImageNet pipeline
+computes them during dataset preparation).
+"""
+
+import numpy
+
+from veles.memory import Array
+from veles.znicz_tpu.nn_units import Forward, forward_unit
+
+
+@forward_unit("mean_disp_normalizer")
+class MeanDispNormalizer(Forward):
+    PARAMS = ()
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.mean = Array()
+        self.rdisp = Array()
+        self.include_bias = False
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if not self.mean or not self.rdisp:
+            raise ValueError("%s needs mean and rdisp set" % self.name)
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        self.output.map_invalidate()
+        self.output.mem[...] = \
+            (x - self.mean.map_read().mem) * self.rdisp.map_read().mem
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        mean = ctx.get(self, "mean")
+        rdisp = ctx.get(self, "rdisp")
+        ctx.set(self, "output",
+                ((x - mean) * rdisp).astype(jnp.float32))

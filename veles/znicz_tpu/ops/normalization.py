@@ -1,0 +1,90 @@
+"""Local response normalization (AlexNet cross-map LRN).
+
+Re-design of znicz ``normalization.py`` [U] (SURVEY.md §2.4 "Local
+response norm"): explicit forward/backward unit pair.
+
+    d(i)   = k + alpha * Σ_{j∈win(i)} x(j)²        (window over channels)
+    y(i)   = x(i) · d(i)^{-beta}
+    dx(i)  = dy(i)·d(i)^{-beta}
+             − 2αβ·x(i)·Σ_{j: i∈win(j)} dy(j)·x(j)·d(j)^{-beta-1}
+
+The channel-window sums are cumsum-based (``sliding_channel_sum``) in
+both backends; XLA fuses the whole thing into a few elementwise passes.
+"""
+
+import numpy
+
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+from veles.znicz_tpu.ops import conv_math as CM
+
+
+@forward_unit("norm")
+class LRNormalizerForward(Forward):
+    """Cross-map LRN (no weights)."""
+
+    PARAMS = ()
+
+    def __init__(self, workflow, alpha=0.0001, beta=0.75, n=5, k=2.0,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.n = int(n)
+        self.k = float(k)
+        self.include_bias = False
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    def _forward(self, xp, x):
+        d = self.k + self.alpha * CM.sliding_channel_sum(
+            xp, x * x, self.n)
+        return x * d ** (-self.beta), d
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        y, _ = self._forward(numpy, x)
+        self.output.map_invalidate()
+        self.output.mem[...] = y
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        y, _ = self._forward(jnp, x)
+        ctx.set(self, "output", y.astype(jnp.float32))
+
+
+@gradient_for(LRNormalizerForward)
+class LRNormalizerBackward(GradientDescentBase):
+    STATE = ()
+
+    def _backward(self, xp, x, err):
+        f = self.forward
+        d = f.k + f.alpha * CM.sliding_channel_sum(xp, x * x, f.n)
+        dpow = d ** (-f.beta)
+        inner = err * x * dpow / d
+        spread = CM.sliding_channel_sum(xp, inner, f.n, reverse=True)
+        return err * dpow - 2.0 * f.alpha * f.beta * x * spread
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(x.shape)
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = self._backward(numpy, x, err)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        err = ctx.get(self, "err_output").reshape(x.shape)
+        ctx.set(self, "err_input",
+                self._backward(jnp, x, err).astype(jnp.float32))

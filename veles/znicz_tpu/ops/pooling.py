@@ -1,0 +1,175 @@
+"""Pooling forward units.
+
+Re-design of znicz ``pooling.py`` [U] (SURVEY.md §2.4 "Pooling"): max /
+max-abs / avg / stochastic over ky×kx windows with stride ``sliding``.
+Max variants record the winning in-window offset (reference
+``input_offset``) so the backward can scatter exactly — first-max wins
+on ties in BOTH backends (argmax semantics), keeping numpy↔XLA parity
+bitwise on the routing.
+
+Both backends share one patch-based implementation (``im2col`` view +
+reduce over the window axis); XLA fuses the gather/reduce into a
+windowed reduction on device.
+"""
+
+import numpy
+
+from veles.memory import Array
+from veles.znicz_tpu.nn_units import Forward, forward_unit
+from veles.znicz_tpu.ops import conv_math as CM
+
+
+class PoolingBase(Forward):
+    """Window-reduce over NHWC input. No weights."""
+
+    PARAMS = ()
+
+    def __init__(self, workflow, kx=2, ky=2, sliding=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx, self.ky = int(kx), int(ky)
+        if sliding is None:
+            sliding = (self.ky, self.kx)
+        if isinstance(sliding, int):
+            sliding = (sliding, sliding)
+        self.sliding = tuple(int(s) for s in sliding)
+        self.include_bias = False
+
+    def output_shape_for(self, ishape):
+        b, h, w, c = ishape
+        # ceil semantics: partial windows at the bottom/right edge are
+        # pooled too (reference behaviour [U])
+        sy, sx = self.sliding
+        oy = -(-max(h - self.ky, 0) // sy) + 1
+        ox = -(-max(w - self.kx, 0) // sx) + 1
+        return (b, oy, ox, c)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        oshape = self.output_shape_for(self.input.shape)
+        if not self.output or self.output.shape != oshape:
+            self.output.reset(numpy.zeros(oshape, numpy.float32))
+
+    # pad so every window is full; the pad value never wins/matters
+    def _padded_patches(self, xp, x, pad_value):
+        b, h, w, c = x.shape
+        oshape = self.output_shape_for(x.shape)
+        sy, sx = self.sliding
+        need_h = (oshape[1] - 1) * sy + self.ky
+        need_w = (oshape[2] - 1) * sx + self.kx
+        if need_h > h or need_w > w:
+            x = xp.pad(x, ((0, 0), (0, need_h - h), (0, need_w - w),
+                           (0, 0)), constant_values=pad_value)
+        cols = CM.im2col(xp, x, self.ky, self.kx, self.sliding,
+                         (0, 0, 0, 0))
+        return cols.reshape(b, oshape[1], oshape[2],
+                            self.ky * self.kx, c)
+
+    def _pool(self, xp, patches, ctx=None):
+        raise NotImplementedError
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        self.output.map_invalidate()
+        self.output.mem[...] = self._run_generic(numpy, x, None)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        y = self._run_generic(jnp, x, ctx)
+        ctx.set(self, "output", y.astype(jnp.float32))
+
+    def _run_generic(self, xp, x, ctx):
+        raise NotImplementedError
+
+
+@forward_unit("max_pooling")
+class MaxPooling(PoolingBase):
+    """Max pooling; records winner offsets for the backward."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input_offset = Array()
+
+    def _select(self, xp, patches):
+        """Window index to propagate (argmax; first wins on ties)."""
+        return xp.argmax(patches, axis=3)
+
+    def _run_generic(self, xp, x, ctx):
+        patches = self._padded_patches(xp, x, -numpy.inf)
+        sel = self._select(xp, patches)               # (B,oy,ox,C)
+        onehot = (xp.arange(self.ky * self.kx)[None, None, None, :, None]
+                  == sel[:, :, :, None, :])
+        y = xp.sum(xp.where(onehot, patches, 0.0), axis=3)
+        if ctx is None:
+            self.input_offset.reset(sel.astype(numpy.int32))
+        else:
+            ctx.set(self, "input_offset", sel.astype(xp.int32))
+        return y
+
+
+@forward_unit("maxabs_pooling")
+class MaxAbsPooling(MaxPooling):
+    """Propagates the element with the largest |value| (sign kept)."""
+
+    def _padded_patches(self, xp, x, pad_value):
+        return super()._padded_patches(xp, x, 0.0)
+
+    def _select(self, xp, patches):
+        return xp.argmax(xp.abs(patches), axis=3)
+
+
+@forward_unit("avg_pooling")
+class AvgPooling(PoolingBase):
+    def _run_generic(self, xp, x, ctx):
+        patches = self._padded_patches(xp, x, 0.0)
+        # divide by the TRUE (unpadded) window size per position
+        counts = self._padded_patches(
+            xp, xp.ones_like(x), 0.0).sum(axis=3)
+        return patches.sum(axis=3) / xp.maximum(counts, 1.0)
+
+
+@forward_unit("stochastic_pooling")
+class StochasticPooling(PoolingBase):
+    """Training: sample the window element with probability ∝ value
+    (relu'd); eval: probability-weighted average (reference
+    StochasticPooling [U])."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input_offset = Array()
+        from veles import prng
+        self.rand = prng.get(kwargs.get("prng_key", "stochastic_pool"))
+
+    def _probs(self, xp, patches):
+        p = xp.maximum(patches, 0.0)
+        total = p.sum(axis=3, keepdims=True)
+        kk = patches.shape[3]
+        return xp.where(total > 0, p / xp.maximum(total, 1e-30),
+                        1.0 / kk)
+
+    def _run_generic(self, xp, x, ctx):
+        patches = self._padded_patches(xp, x, 0.0)
+        probs = self._probs(xp, patches)
+        train = ctx.train if ctx is not None else True
+        if train:
+            cum = xp.cumsum(probs, axis=3)
+            if ctx is None:
+                u = self.rand.random_sample(
+                    patches.shape[:3] + patches.shape[4:]) \
+                    .astype(numpy.float32)
+            else:
+                import jax
+                u = jax.random.uniform(
+                    ctx.fold_key(self),
+                    patches.shape[:3] + patches.shape[4:])
+            sel = (cum < u[:, :, :, None, :]).sum(axis=3)
+            sel = xp.clip(sel, 0, patches.shape[3] - 1)
+            onehot = (xp.arange(patches.shape[3])
+                      [None, None, None, :, None] == sel[:, :, :, None, :])
+            y = xp.sum(xp.where(onehot, patches, 0.0), axis=3)
+            if ctx is None:
+                self.input_offset.reset(sel.astype(numpy.int32))
+            else:
+                ctx.set(self, "input_offset", sel.astype(xp.int32))
+            return y
+        return (patches * probs).sum(axis=3)

@@ -88,10 +88,7 @@ class XLAStep(Unit):
         self._pre_epoch_params = None
         self._pre_epoch_state = None
         self._pre_epoch_step_index = 0
-        # epoch-entry copies cost a params+state duplicate on device;
-        # only keep them when a snapshotter will consume them
-        self._keep_epoch_entry = self.scan_mode and \
-            getattr(self.workflow, "snapshotter", None) is not None
+        self._keep_entry_requested = False
 
     def _build_batch_spec(self):
         spec = {
@@ -244,6 +241,16 @@ class XLAStep(Unit):
 
     # -- host sync -----------------------------------------------------
 
+    @property
+    def _keep_epoch_entry(self):
+        """Epoch-entry copies cost a params+state duplicate on device;
+        keep them when a snapshotter exists OR someone has asked for a
+        snapshot view before (evaluated per dispatch, so a snapshotter
+        linked after initialize still works)."""
+        return self.scan_mode and (
+            self._keep_entry_requested
+            or getattr(self.workflow, "snapshotter", None) is not None)
+
     def snapshot_view(self, at_valid=False):
         """A CONSISTENT (params, state, step_index) triple.
 
@@ -251,9 +258,19 @@ class XLAStep(Unit):
         validation metric was measured on (scan mode trains the whole
         epoch in one dispatch, so the live values are one train segment
         ahead of the metric that gated the snapshot)."""
-        if at_valid and self._pre_epoch_params is not None:
-            return (self._pre_epoch_params, self._pre_epoch_state,
-                    self._pre_epoch_step_index)
+        if at_valid:
+            if self._pre_epoch_params is not None:
+                return (self._pre_epoch_params, self._pre_epoch_state,
+                        self._pre_epoch_step_index)
+            if self.scan_mode and not self._keep_entry_requested:
+                # start keeping entries for future epochs and be loud:
+                # this checkpoint's params are post-train of the epoch
+                self._keep_entry_requested = True
+                if self._dispatched_epoch is not None:
+                    self.warning(
+                        "snapshot_view(at_valid) before any epoch-entry "
+                        "copy exists: saving post-train params for this "
+                        "epoch; subsequent epochs will keep entry copies")
         return self.params, self.state, self.step_index
 
     def sync_host(self, at_valid=False):
