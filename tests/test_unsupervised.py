@@ -1,0 +1,76 @@
+"""Kohonen SOM + RBM functional tests (BASELINE config #4; SURVEY.md §7
+stage 7 — the custom-update, non-backprop unit path)."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+def run_kohonen(backend):
+    prng.seed_all(77)
+    from veles.znicz_tpu.models import kohonen
+    root.kohonen.decision.max_epochs = 10
+    root.kohonen.loader.n_samples = 600
+    wf = kohonen.create_workflow(name="Koh_%s" % backend)
+    wf.initialize(device=backend)
+    wf.run()
+    return wf
+
+
+def quantization_error(wf):
+    loader = wf.loader
+    x = loader.original_data.mem
+    w = wf.forwards[0].weights.map_read().mem
+    d = ((x[:, None, :] - w[None, :, :]) ** 2).sum(axis=-1)
+    return float(numpy.sqrt(d.min(axis=1)).mean())
+
+
+def test_kohonen_numpy_converges():
+    wf = run_kohonen("numpy")
+    qe = quantization_error(wf)
+    # untrained map: weights are tiny uniform noise around 0 while the
+    # data lives in [-1, 1]² — mean distance ~0.9
+    assert qe < 0.3, qe
+    deltas = [h["train"]["metric"] for h in wf.decision.history]
+    assert deltas[-1] < deltas[0]
+
+
+def test_kohonen_xla_matches():
+    wf = run_kohonen("cpu")
+    assert wf.xla_step is not None and wf.xla_step.scan_mode
+    qe = quantization_error(wf)
+    assert qe < 0.3, qe
+    wf2 = run_kohonen("numpy")
+    assert abs(qe - quantization_error(wf2)) < 0.1
+
+
+def run_rbm(backend):
+    prng.seed_all(88)
+    from veles.znicz_tpu.models import mnist_rbm
+    root.mnist_rbm.loader.n_train = 800
+    root.mnist_rbm.loader.n_valid = 200
+    root.mnist_rbm.decision.max_epochs = 6
+    wf = mnist_rbm.create_workflow(name="RBM_%s" % backend)
+    wf.initialize(device=backend)
+    wf.run()
+    return wf
+
+
+def test_rbm_numpy_reconstruction_improves():
+    wf = run_rbm("numpy")
+    hist = [h["validation"]["metric"] for h in wf.decision.history]
+    assert hist[-1] < hist[0] * 0.87, hist
+
+
+def test_rbm_xla_matches():
+    wf = run_rbm("cpu")
+    hist = [h["validation"]["metric"] for h in wf.decision.history]
+    assert hist[-1] < hist[0] * 0.87, hist
+    wf2 = run_rbm("numpy")
+    hist2 = [h["validation"]["metric"] for h in wf2.decision.history]
+    # stochastic binarization differs per backend; trajectories should
+    # still land in the same neighbourhood
+    assert abs(hist[-1] - hist2[-1]) / max(hist2[-1], 1e-9) < 0.35, \
+        (hist, hist2)

@@ -1,0 +1,106 @@
+"""Kohonen SOM sample (BASELINE config #4, unsupervised half).
+
+Rebuild of reference ``samples/Kohonen`` [U] (SURVEY.md §2.8): a
+self-organizing map trained on 2-D point clouds — the custom-update
+(non-GD) unit path through the same graph runtime and compiled step.
+"""
+
+import numpy
+
+from veles import prng
+from veles.config import root
+from veles.loader.base import CLASS_TRAIN
+from veles.loader.fullbatch import FullBatchLoader
+from veles.znicz_tpu.decision import DecisionBase
+from veles.znicz_tpu.nn_units import NNWorkflow
+from veles.znicz_tpu.ops.kohonen import KohonenForward, KohonenTrainer
+from veles.units import Repeater
+
+root.kohonen.update({
+    "loader": {"minibatch_size": 50, "n_samples": 1000},
+    "forward": {"shape": (8, 8)},
+    "trainer": {"alpha": 0.5, "alpha_min": 0.01, "radius_min": 1.0,
+                "decay_steps": 200.0},
+    "decision": {"max_epochs": 20},
+})
+
+
+class KohonenLoader(FullBatchLoader):
+    """Mixture-of-gaussians point cloud (train class only — SOM is
+    unsupervised)."""
+
+    def load_data(self):
+        gen = prng.get("kohonen_data")
+        n = root.kohonen.loader.get("n_samples", 1000)
+        centers = gen.uniform(-1.0, 1.0, (6, 2))
+        idx = gen.randint(0, 6, n)
+        pts = centers[idx] + gen.normal(0.0, 0.08, (n, 2))
+        self.original_data.mem = pts.astype(numpy.float32)
+        self.class_lengths = [0, 0, n]
+
+
+class KohonenDecision(DecisionBase):
+    """Stops on max_epochs or when the map stops moving."""
+
+    def __init__(self, workflow, weight_delta_eps=1e-5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.trainer = None
+        self.weight_delta_eps = weight_delta_eps
+
+    def minibatch_metric(self):
+        d = float(self.trainer.weight_delta)
+        return d * int(self.loader.minibatch_size), {}
+
+    def _on_epoch_ended(self):
+        super()._on_epoch_ended()
+        last = self.last_epoch_metrics[CLASS_TRAIN]
+        if last and last["samples"]:
+            if self.normalized_metric(last) < self.weight_delta_eps:
+                self.complete << True
+
+
+class KohonenWorkflow(NNWorkflow):
+    """repeater → loader → trainer → decision cycle; the forward unit
+    rides along for classification/plotting."""
+
+    def __init__(self, workflow=None, name="KohonenWorkflow", **kwargs):
+        super().__init__(workflow, name=name)
+        cfg = root.kohonen
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+        self.loader = KohonenLoader(
+            self, name="loader",
+            minibatch_size=cfg.loader.minibatch_size)
+        self.loader.link_from(self.repeater)
+        fwd = KohonenForward(self, name="kohonen_forward",
+                             **cfg.forward.to_dict())
+        fwd.link_attrs(self.loader, ("input", "minibatch_data"))
+        trainer = KohonenTrainer(self, name="kohonen_trainer",
+                                 **cfg.trainer.to_dict())
+        trainer.setup_forward(fwd)
+        trainer.link_attrs(self.loader, ("batch_size",
+                                         "minibatch_size"))
+        trainer.link_from(self.loader)
+        self.forwards = [fwd]
+        self.gds = [trainer]
+        self.trainer = trainer
+        self.decision = KohonenDecision(self, name="decision",
+                                        **cfg.decision.to_dict())
+        self.decision.link_loader_evaluator(self.loader, trainer)
+        self.decision.trainer = trainer
+        self.decision.link_from(trainer)
+        self.repeater.link_from(self.decision)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def _stateful_units(self):
+        return [self.forwards[0], self.trainer]
+
+
+def create_workflow(name="KohonenWorkflow"):
+    return KohonenWorkflow(None, name=name)
+
+
+def run(load, main):
+    load(KohonenWorkflow)
+    main()

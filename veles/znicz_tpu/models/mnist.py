@@ -33,12 +33,20 @@ root.mnist.update({
 
 class MnistLoader(FullBatchLoader):
     """Flattened-image full-batch loader (real MNIST if on disk, else
-    the deterministic synthetic stand-in — see models/datasets.py)."""
+    the deterministic synthetic stand-in — see models/datasets.py).
+    Sizes come from kwargs, falling back to ``root.mnist.loader``."""
+
+    def __init__(self, workflow, n_train=None, n_valid=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._n_train = n_train
+        self._n_valid = n_valid
 
     def load_data(self):
         tx, ty, vx, vy = datasets.load_mnist(
-            n_train=root.mnist.loader.get("n_train", 6000),
-            n_valid=root.mnist.loader.get("n_valid", 1000))
+            n_train=self._n_train
+            or root.mnist.loader.get("n_train", 6000),
+            n_valid=self._n_valid
+            or root.mnist.loader.get("n_valid", 1000))
         tx = tx.reshape(len(tx), -1)
         vx = vx.reshape(len(vx), -1)
         # sample order: [test | valid | train] per loader class layout

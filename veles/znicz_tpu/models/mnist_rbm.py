@@ -1,0 +1,126 @@
+"""MnistRBM sample: CD-1 RBM pretraining (BASELINE config #4).
+
+Rebuild of reference ``samples/MnistRBM`` [U] (SURVEY.md §2.8): the
+contrastive-divergence chain assembled from the rbm building-block
+units — the second custom-update (non-GD) path.
+"""
+
+import numpy
+
+from veles.config import root
+from veles.units import Repeater
+from veles.znicz_tpu.decision import DecisionMSE
+from veles.znicz_tpu.models.mnist import MnistLoader
+from veles.znicz_tpu.nn_units import NNWorkflow
+from veles.znicz_tpu.ops.all2all import All2AllSigmoid
+from veles.znicz_tpu.ops.rbm import (
+    Binarization, TiedAll2AllSigmoid, BatchWeights, GradientRBM,
+    EvaluatorRBM)
+
+root.mnist_rbm.update({
+    "loader": {"minibatch_size": 100, "n_train": 2000, "n_valid": 500},
+    "rbm": {"n_hidden": 64, "learning_rate": 0.05},
+    "decision": {"max_epochs": 5, "fail_iterations": 100},
+})
+
+
+class RBMWorkflow(NNWorkflow):
+    """loader → h_pos → binarize → v_neg → h_neg → stats → evaluator
+    → decision → GradientRBM → repeater."""
+
+    def __init__(self, workflow=None, name="RBMWorkflow", **kwargs):
+        super().__init__(workflow, name=name)
+        cfg = root.mnist_rbm
+        n_hidden = cfg.rbm.n_hidden
+
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+        # reuse the MNIST loader; pixel values in [0,1] act as
+        # visible-unit probabilities
+        self.loader = MnistLoader(
+            self, name="loader",
+            minibatch_size=cfg.loader.minibatch_size,
+            n_train=cfg.loader.get("n_train", 2000),
+            n_valid=cfg.loader.get("n_valid", 500))
+        self.loader.link_from(self.repeater)
+
+        h_pos = All2AllSigmoid(self, name="h_pos",
+                               output_sample_shape=n_hidden,
+                               weights_stddev=0.05)
+        h_pos.link_attrs(self.loader, ("input", "minibatch_data"))
+        h_pos.link_from(self.loader)
+
+        binarize = Binarization(self, name="binarize")
+        binarize.link_attrs(h_pos, ("input", "output"))
+        binarize.link_from(h_pos)
+
+        v_neg = TiedAll2AllSigmoid(
+            self, name="v_neg", weights_source=h_pos, transposed=True,
+            output_sample_shape=1)   # fixed at initialize
+        v_neg.link_attrs(binarize, ("input", "output"))
+        v_neg.link_from(binarize)
+        self._v_neg = v_neg
+
+        h_neg = TiedAll2AllSigmoid(
+            self, name="h_neg", weights_source=h_pos, transposed=False,
+            bias_source=h_pos, output_sample_shape=n_hidden)
+        h_neg.link_attrs(v_neg, ("input", "output"))
+        h_neg.link_from(v_neg)
+
+        pos_stats = BatchWeights(self, name="pos_stats")
+        pos_stats.link_attrs(self.loader, ("v", "minibatch_data"),
+                             ("batch_size", "minibatch_size"))
+        pos_stats.link_attrs(h_pos, ("h", "output"))
+        pos_stats.link_from(h_neg)
+
+        neg_stats = BatchWeights(self, name="neg_stats")
+        neg_stats.link_attrs(v_neg, ("v", "output"))
+        neg_stats.link_attrs(h_neg, ("h", "output"))
+        neg_stats.link_attrs(self.loader, ("batch_size",
+                                           "minibatch_size"))
+        neg_stats.link_from(pos_stats)
+
+        evaluator = EvaluatorRBM(self, name="evaluator")
+        evaluator.link_attrs(self.loader, ("v", "minibatch_data"),
+                             ("batch_size", "minibatch_size"))
+        evaluator.link_attrs(v_neg, ("v_neg", "output"))
+        evaluator.link_from(neg_stats)
+        self.evaluator = evaluator
+
+        self.decision = DecisionMSE(self, name="decision",
+                                    **cfg.decision.to_dict())
+        self.decision.link_loader_evaluator(self.loader, evaluator)
+        self.decision.link_from(evaluator)
+
+        grad = GradientRBM(self, name="gradient_rbm",
+                           learning_rate=cfg.rbm.learning_rate)
+        grad.hidden_layer = h_pos
+        grad.visible_layer = v_neg
+        grad.pos_stats = pos_stats
+        grad.neg_stats = neg_stats
+        grad.link_from(self.decision)
+        grad.gate_skip = ~self.loader.train_phase | \
+            self.decision.complete
+
+        self.forwards = [h_pos, binarize, v_neg, h_neg, pos_stats,
+                         neg_stats]
+        self.gds = [grad]
+        self.repeater.link_from(grad)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def initialize(self, device=None, **kwargs):
+        # the visible size is only known once the loader has shapes
+        self.loader.initialize(device=None)
+        self._v_neg.neurons = int(numpy.prod(
+            self.loader.minibatch_data.shape[1:]))
+        return super().initialize(device=device, **kwargs)
+
+
+def create_workflow(name="RBMWorkflow"):
+    return RBMWorkflow(None, name=name)
+
+
+def run(load, main):
+    load(RBMWorkflow)
+    main()

@@ -392,6 +392,54 @@ class NNWorkflow(AcceleratedWorkflow):
         #: minibatch index ranges from the master
         self.is_slave = False
 
+    # -- XLA rewiring + slot-ordered initialization --------------------
+
+    def _rewire_xla(self):
+        """Replace per-unit execution of the accelerated body with the
+        fused XLAStep (SURVEY.md §7 design stance)."""
+        from veles.znicz_tpu.xla_step import XLAStep
+        step = XLAStep(self, loader=self.loader, forwards=self.forwards,
+                       evaluator=self.evaluator, gds=self.gds,
+                       name="xla_step")
+        for u in self.forwards + [self.evaluator] + self.gds:
+            if u is not None:
+                u.unlink_all()
+        step.link_from(self.loader)
+        self.decision.link_from(step)
+        self.repeater.link_from(self.decision)
+        self.xla_step = step
+        return step
+
+    def initialize(self, device=None, snapshot=False, **kwargs):
+        """Slot-ordered init (loader first so shapes resolve), then the
+        XLA rewire + step compiler when on an XLA device."""
+        from veles.backends import get_device
+        self.device = get_device(device)
+        if self.on_xla and self.xla_step is None \
+                and (self.forwards or self.gds):
+            self._rewire_xla()
+        ordered = [self.repeater, self.loader] + self.forwards
+        if self.evaluator is not None:
+            ordered.append(self.evaluator)
+        ordered += [g for g in self.gds if g is not None]
+        if self.decision is not None:
+            ordered.append(self.decision)
+        if self.xla_step is not None:
+            ordered.append(self.xla_step)
+        ordered = [u for u in ordered if u is not None]
+        seen = set(id(u) for u in ordered)
+        rest = [u for u in self._units
+                if id(u) not in seen and u is not self]
+        self._initialized = True
+        for unit in ordered + rest:
+            unit.initialize(device=self.device, **kwargs)
+        return ordered + rest
+
+    def run(self):
+        super().run()
+        if self.xla_step is not None:
+            self.xla_step.sync_host()
+
     # -- checkpoint / resume (SURVEY.md §3.4, §5.4) --------------------
 
     def _stateful_units(self):

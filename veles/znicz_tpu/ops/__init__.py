@@ -43,3 +43,10 @@ from veles.znicz_tpu.ops.deconv import (  # noqa: F401
 from veles.znicz_tpu.ops.mean_disp_normalizer import (  # noqa: F401
     MeanDispNormalizer,
 )
+from veles.znicz_tpu.ops.kohonen import (  # noqa: F401
+    KohonenForward, KohonenTrainer,
+)
+from veles.znicz_tpu.ops.rbm import (  # noqa: F401
+    Binarization, TiedAll2AllSigmoid, BatchWeights, GradientRBM,
+    EvaluatorRBM,
+)

@@ -38,6 +38,10 @@ class EvaluatorBase(AcceleratedUnit):
         if not self.err_output or self.err_output.shape != ishape:
             self.err_output.reset(numpy.zeros(ishape, numpy.float32))
 
+    def metric_sinks(self):
+        """Where XLAStep publishes step outputs on the host unit."""
+        return [("n_err", "n_err"), ("loss", "loss")]
+
 
 class EvaluatorSoftmax(EvaluatorBase):
     """Fused softmax + cross-entropy loss."""
@@ -115,6 +119,9 @@ class EvaluatorMSE(EvaluatorBase):
         self.target = None          # linked: loader.minibatch_targets
         self.root_metric = root_metric
         self.mse = 0.0
+
+    def metric_sinks(self):
+        return super().metric_sinks() + [("loss", "mse")]
 
     def _compute(self, xp, y, t, valid):
         b = y.shape[0]

@@ -1,0 +1,177 @@
+"""Kohonen self-organizing map units.
+
+Re-design of znicz ``kohonen.py`` [U] (SURVEY.md §2.4 "Kohonen SOM"):
+the unsupervised path — no GD chain, the trainer owns its own update
+rule (distance → argmin BMU → neighborhood-weighted pull), proving the
+graph runtime is not backprop-shaped only (SURVEY.md §7 stage 7).
+
+Batch rule (both backends identically):
+
+    bmu_b     = argmin_i ||x_b − w_i||²
+    h(i, b)   = exp(−grid_dist²(i, bmu_b) / (2σ_t²))
+    Δw_i      = α_t · Σ_b h(i,b)(x_b − w_i) / Σ_b h(i,b)
+
+with learning rate α_t and radius σ_t decayed over ``decay_steps``
+minibatch steps, on a (sy, sx) rectangular grid.
+"""
+
+import numpy
+
+from veles.memory import Array
+from veles.accelerated_units import AcceleratedUnit
+from veles.znicz_tpu.nn_units import Forward, forward_unit
+
+
+def grid_coords(sy, sx):
+    yy, xx = numpy.mgrid[0:sy, 0:sx]
+    return numpy.stack([yy.ravel(), xx.ravel()], axis=1) \
+        .astype(numpy.float32)
+
+
+@forward_unit("kohonen_forward")
+class KohonenForward(Forward):
+    """Classifier: output = BMU flat index per sample (reference
+    ``KohonenForward`` emits winners [U])."""
+
+    PARAMS = ("weights",)
+
+    def __init__(self, workflow, shape=(8, 8), **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.grid_shape = tuple(shape)
+        self.include_bias = False
+        #: winner index per sample
+        self.output = Array()
+        #: distances to every neuron (diagnostics / plotters)
+        self.distances = Array()
+
+    @property
+    def neurons(self):
+        return int(numpy.prod(self.grid_shape))
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        fan_in = int(numpy.prod(self.input.shape[1:]))
+        self.init_weights((self.neurons, fan_in), fan_in, self.neurons)
+        b = self.input.shape[0]
+        if not self.output or self.output.shape != (b,):
+            self.output.reset(numpy.zeros(b, numpy.int32))
+        if not self.distances or self.distances.shape != (b, self.neurons):
+            self.distances.reset(
+                numpy.zeros((b, self.neurons), numpy.float32))
+
+    @staticmethod
+    def _dist2(xp, x2, w):
+        # ||x-w||² = |x|² - 2xw + |w|², |x|² constant per-row → dropped
+        return (w * w).sum(axis=1)[None, :] - 2.0 * (x2 @ w.T)
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        x2 = x.reshape(x.shape[0], -1)
+        w = self.weights.map_read().mem
+        d = self._dist2(numpy, x2, w)
+        self.distances.map_invalidate()
+        self.distances.mem[...] = d
+        self.output.map_invalidate()
+        self.output.mem[...] = numpy.argmin(d, axis=1)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        x2 = x.reshape(x.shape[0], -1)
+        w = ctx.unit_params(self)["weights"]
+        d = self._dist2(jnp, x2, w)
+        ctx.set(self, "distances", d)
+        ctx.set(self, "output", jnp.argmin(d, axis=1).astype(jnp.int32))
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """The SOM update rule; pairs a KohonenForward via
+    ``setup_forward`` (weights live on the forward unit)."""
+
+    STATE = ("time_step",)
+
+    def __init__(self, workflow, alpha=0.5, alpha_min=0.01,
+                 radius=None, radius_min=1.0, decay_steps=200.0,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward = None
+        self.alpha = float(alpha)
+        self.alpha_min = float(alpha_min)
+        self.radius = radius
+        self.radius_min = float(radius_min)
+        self.decay_steps = float(decay_steps)
+        self.time_step = Array()
+        self.batch_size = None   # linked: loader.minibatch_size
+        #: host metric: mean weight displacement of the last step
+        self.weight_delta = 0.0
+
+    def metric_sinks(self):
+        return [("weight_delta", "weight_delta")]
+
+    def setup_forward(self, forward):
+        self.forward = forward
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        f = self.forward
+        if self.radius is None:
+            self.radius = float(max(f.grid_shape) / 2.0)
+        if not self.time_step:
+            self.time_step.reset(numpy.zeros((), numpy.float32))
+        self._coords = grid_coords(*f.grid_shape)
+
+    # shared math ------------------------------------------------------
+
+    def _schedules(self, xp, t):
+        frac = xp.minimum(t / self.decay_steps, 1.0)
+        alpha = self.alpha + (self.alpha_min - self.alpha) * frac
+        sigma = self.radius + (self.radius_min - self.radius) * frac
+        return alpha, sigma
+
+    def _update(self, xp, x2, w, t, coords, valid):
+        d = KohonenForward._dist2(xp, x2, w)
+        bmu = xp.argmin(d, axis=1)                       # (B,)
+        alpha, sigma = self._schedules(xp, t)
+        bmu_pos = coords[bmu]                            # (B, 2)
+        diff = coords[None, :, :] - bmu_pos[:, None, :]  # (B, N, 2)
+        g2 = (diff * diff).sum(axis=-1)
+        h = xp.exp(-g2 / (2.0 * sigma * sigma))          # (B, N)
+        mask = (xp.arange(x2.shape[0]) < valid)
+        h = h * mask[:, None].astype(h.dtype)
+        num = h.T @ x2                                   # (N, F)
+        den = h.sum(axis=0)[:, None]                     # (N, 1)
+        target = num / xp.maximum(den, 1e-12)
+        pull = xp.where(den > 1e-12, target - w, xp.zeros_like(w))
+        new_w = w + alpha * pull
+        delta = xp.sqrt(((new_w - w) ** 2).mean())
+        return new_w, delta
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        x2 = x.reshape(x.shape[0], -1)
+        w = f.weights.map_write().mem
+        self.time_step.map_write()
+        t = float(self.time_step.mem)
+        valid = numpy.int32(int(self.batch_size))
+        new_w, delta = self._update(numpy, x2, w, t, self._coords,
+                                    valid)
+        f.weights.mem[...] = new_w
+        self.time_step.mem[...] = t + 1.0
+        self.weight_delta = float(delta)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        x2 = x.reshape(x.shape[0], -1)
+        w = ctx.unit_params(f)["weights"]
+        t = ctx.unit_state(self)["time_step"]
+        valid = ctx.get(self, "batch_size")
+        coords = jnp.asarray(self._coords)
+        new_w, delta = self._update(jnp, x2, w, t, coords, valid)
+        ctx.update_params(f, weights=new_w)
+        ctx.update_state(self, time_step=t + 1.0)
+        ctx.export("weight_delta", delta)
+        ctx.export("loss", delta)

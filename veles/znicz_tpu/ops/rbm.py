@@ -1,0 +1,246 @@
+"""RBM (restricted Boltzmann machine) building blocks — CD-1.
+
+Re-design of znicz ``rbm_units.py`` [U] (SURVEY.md §2.4 "RBM"): the
+contrastive-divergence path is assembled from units, like the
+reference's ``Binarization`` / ``BatchWeights`` / ``GradientRBM`` /
+``EvaluatorRBM``, rather than a monolithic layer:
+
+    v --[All2AllSigmoid W,hbias]--> h_pos --[Binarization]--> h_smp
+      --[TiedAll2AllSigmoid Wᵀ,vbias]--> v_neg
+      --[TiedAll2AllSigmoid W,hbias]--> h_neg
+    GradientRBM: ΔW ∝ (vᵀh_pos − v_negᵀh_neg)/B  (+ bias terms)
+    EvaluatorRBM: reconstruction error ‖v − v_neg‖²/B
+
+Weight tying: the reverse/second-pass layers read the FIRST layer's
+parameter tree instead of owning copies, so the compiled step updates
+one canonical W (reference ties via linked attrs [U]).
+"""
+
+import numpy
+
+from veles import prng
+from veles.memory import Array
+from veles.accelerated_units import AcceleratedUnit
+from veles.znicz_tpu.nn_units import Forward
+from veles.znicz_tpu.ops.all2all import All2AllSigmoid
+from veles.znicz_tpu.ops import activations as A
+
+
+class Binarization(AcceleratedUnit):
+    """Sample {0,1} from probabilities (training stochasticity of the
+    hidden layer; reference ``Binarization`` [U])."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = None
+        self.output = Array()
+        self.rand = prng.get(kwargs.get("prng_key", "rbm_binarize"))
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    def numpy_run(self):
+        p = self.input.map_read().mem
+        u = self.rand.random_sample(p.shape)
+        self.output.map_invalidate()
+        self.output.mem[...] = (u < p).astype(numpy.float32)
+
+    def xla_run(self, ctx):
+        import jax
+        import jax.numpy as jnp
+        p = ctx.get(self, "input")
+        u = jax.random.uniform(ctx.fold_key(self), p.shape)
+        ctx.set(self, "output", (u < p).astype(jnp.float32))
+
+
+class TiedAll2AllSigmoid(Forward):
+    """Dense sigmoid layer whose weight matrix BELONGS to another
+    layer (read transposed when ``transposed``); only the bias is its
+    own parameter."""
+
+    PARAMS = ("bias",)
+
+    def __init__(self, workflow, weights_source=None, transposed=False,
+                 bias_source=None, output_sample_shape=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.weights_source = weights_source
+        self.transposed = transposed
+        #: when set, the bias belongs to that unit too (h_neg shares
+        #: h_pos's hidden bias) and this unit owns NO parameters
+        self.bias_source = bias_source
+        if bias_source is not None:
+            self.PARAMS = ()
+        self.neurons = int(output_sample_shape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        b = self.input.shape[0]
+        if self.bias_source is None and (
+                not self.bias or self.bias.shape != (self.neurons,)):
+            self.bias.reset(numpy.zeros(self.neurons, numpy.float32))
+        if not self.output or self.output.shape != (b, self.neurons):
+            self.output.reset(
+                numpy.zeros((b, self.neurons), numpy.float32))
+
+    def _weights(self, w):
+        return w.T if self.transposed else w
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        w = self._weights(
+            self.weights_source.weights.map_read().mem)
+        bias_owner = self.bias_source or self
+        v = x.reshape(x.shape[0], -1) @ w \
+            + bias_owner.bias.map_read().mem
+        self.output.map_invalidate()
+        self.output.mem[...] = A.sigmoid(numpy, v)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        w = self._weights(
+            ctx.unit_params(self.weights_source)["weights"])
+        bias_owner = self.bias_source or self
+        v = ctx.dot(x.reshape(x.shape[0], -1), w) \
+            + ctx.unit_params(bias_owner)["bias"]
+        ctx.set(self, "output", A.sigmoid(jnp, v).astype(jnp.float32))
+
+
+class BatchWeights(AcceleratedUnit):
+    """vᵀh correlation statistics of a (visible, hidden) pair —
+    the positive/negative phase sufficient statistics (reference
+    ``BatchWeights`` [U])."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.v = None
+        self.h = None
+        self.batch_size = None
+        self.vh = Array()
+        self.v_sum = Array()
+        self.h_sum = Array()
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        nv = int(numpy.prod(self.v.shape[1:]))
+        nh = int(numpy.prod(self.h.shape[1:]))
+        if not self.vh or self.vh.shape != (nv, nh):
+            self.vh.reset(numpy.zeros((nv, nh), numpy.float32))
+            self.v_sum.reset(numpy.zeros(nv, numpy.float32))
+            self.h_sum.reset(numpy.zeros(nh, numpy.float32))
+
+    def _compute(self, xp, v, h, valid):
+        b = v.shape[0]
+        mask = (xp.arange(b) < valid).astype(v.dtype)
+        v = v.reshape(b, -1) * mask[:, None]
+        h = h.reshape(b, -1) * mask[:, None]
+        n = xp.maximum(valid.astype(v.dtype), 1.0)
+        return v.T @ h / n, v.sum(axis=0) / n, h.sum(axis=0) / n
+
+    def numpy_run(self):
+        v = self.v.map_read().mem.astype(numpy.float32)
+        h = self.h.map_read().mem.astype(numpy.float32)
+        valid = numpy.int32(int(self.batch_size))
+        vh, vs, hs = self._compute(numpy, v, h, valid)
+        self.vh.map_invalidate()
+        self.vh.mem[...] = vh
+        self.v_sum.map_invalidate()
+        self.v_sum.mem[...] = vs
+        self.h_sum.map_invalidate()
+        self.h_sum.mem[...] = hs
+
+    def xla_run(self, ctx):
+        v = ctx.get(self, "v")
+        h = ctx.get(self, "h")
+        valid = ctx.get(self, "batch_size")
+        import jax.numpy as jnp
+        vh, vs, hs = self._compute(jnp, v, h, valid)
+        ctx.set(self, "vh", vh)
+        ctx.set(self, "v_sum", vs)
+        ctx.set(self, "h_sum", hs)
+
+
+class GradientRBM(AcceleratedUnit):
+    """CD-1 update from positive/negative BatchWeights stats."""
+
+    STATE = ()
+
+    def __init__(self, workflow, learning_rate=0.1, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.learning_rate = float(learning_rate)
+        self.hidden_layer = None   # All2AllSigmoid owning W + hbias
+        self.visible_layer = None  # TiedAll2AllSigmoid owning vbias
+        self.pos_stats = None      # BatchWeights (v, h_pos)
+        self.neg_stats = None      # BatchWeights (v_neg, h_neg)
+
+    def numpy_run(self):
+        lr = numpy.float32(self.learning_rate)
+        hl, vl = self.hidden_layer, self.visible_layer
+        pos, neg = self.pos_stats, self.neg_stats
+        hl.weights.map_write()
+        hl.weights.mem[...] += lr * (pos.vh.map_read().mem
+                                     - neg.vh.map_read().mem)
+        hl.bias.map_write()
+        hl.bias.mem[...] += lr * (pos.h_sum.map_read().mem
+                                  - neg.h_sum.map_read().mem)
+        vl.bias.map_write()
+        vl.bias.mem[...] += lr * (pos.v_sum.map_read().mem
+                                  - neg.v_sum.map_read().mem)
+
+    def xla_run(self, ctx):
+        lr = self.learning_rate
+        hl, vl = self.hidden_layer, self.visible_layer
+        pos, neg = self.pos_stats, self.neg_stats
+        w = ctx.unit_params(hl)["weights"]
+        hb = ctx.unit_params(hl)["bias"]
+        vb = ctx.unit_params(vl)["bias"]
+        ctx.update_params(
+            hl,
+            weights=w + lr * (ctx.get(pos, "vh") - ctx.get(neg, "vh")),
+            bias=hb + lr * (ctx.get(pos, "h_sum")
+                            - ctx.get(neg, "h_sum")))
+        ctx.update_params(
+            vl, bias=vb + lr * (ctx.get(pos, "v_sum")
+                                - ctx.get(neg, "v_sum")))
+
+
+class EvaluatorRBM(AcceleratedUnit):
+    """Reconstruction MSE between the data and the CD reconstruction."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.v = None
+        self.v_neg = None
+        self.batch_size = None
+        self.mse = 0.0
+        self.loss = 0.0
+        self.n_err = 0
+
+    def metric_sinks(self):
+        return [("loss", "mse"), ("loss", "loss"), ("n_err", "n_err")]
+
+    def _compute(self, xp, v, r, valid):
+        b = v.shape[0]
+        mask = (xp.arange(b) < valid).astype(v.dtype)
+        diff = (v.reshape(b, -1) - r.reshape(b, -1)) * mask[:, None]
+        return (diff * diff).sum() / xp.maximum(
+            valid.astype(v.dtype), 1.0)
+
+    def numpy_run(self):
+        v = self.v.map_read().mem.astype(numpy.float32)
+        r = self.v_neg.map_read().mem.astype(numpy.float32)
+        valid = numpy.int32(int(self.batch_size))
+        self.mse = float(self._compute(numpy, v, r, valid))
+        self.loss = self.mse
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        v = ctx.get(self, "v")
+        r = ctx.get(self, "v_neg")
+        valid = ctx.get(self, "batch_size")
+        mse = self._compute(jnp, v, r, valid)
+        ctx.export("loss", mse)
+        ctx.export("n_err", jnp.int32(0))

@@ -22,14 +22,12 @@ accelerated body runs as ONE compiled step (see
     start → repeater → loader → xla_step → decision → repeater
 """
 
-from veles.backends import get_device
 from veles.units import Repeater
 from veles.znicz_tpu.decision import DecisionGD, DecisionMSE
 from veles.znicz_tpu.nn_units import (
     NNWorkflow, forward_by_name, gradient_unit_for)
 from veles.znicz_tpu.ops.all2all import All2AllSoftmax
 from veles.znicz_tpu.ops.evaluator import EvaluatorSoftmax, EvaluatorMSE
-from veles.znicz_tpu.xla_step import XLAStep
 
 
 def normalize_layers(layers):
@@ -161,51 +159,6 @@ class StandardWorkflowBase(NNWorkflow):
             self.link_snapshotter(**self.snapshotter_config)
         self.link_end_point()
         return self
-
-    # -- XLA rewiring ---------------------------------------------------
-
-    def _rewire_xla(self):
-        """Replace per-unit execution of the accelerated body with the
-        fused XLAStep (SURVEY.md §7 design stance)."""
-        step = XLAStep(self, loader=self.loader, forwards=self.forwards,
-                       evaluator=self.evaluator, gds=self.gds,
-                       name="xla_step")
-        for u in self.forwards + [self.evaluator] + self.gds:
-            u.unlink_all()
-        step.link_from(self.loader)
-        self.decision.link_from(step)
-        self.repeater.link_from(self.decision)
-        self.xla_step = step
-        return step
-
-    # -- initialization -------------------------------------------------
-
-    def initialize(self, device=None, snapshot=False, **kwargs):
-        """Slot-ordered init (loader first so shapes resolve), then the
-        XLA rewire + step compiler when on an XLA device."""
-        self.device = get_device(device)
-        if self.on_xla and self.xla_step is None and self.forwards:
-            self._rewire_xla()
-        ordered = [self.repeater, self.loader] + self.forwards
-        if self.evaluator is not None:
-            ordered.append(self.evaluator)
-        ordered += [g for g in self.gds if g is not None]
-        if self.decision is not None:
-            ordered.append(self.decision)
-        if self.xla_step is not None:
-            ordered.append(self.xla_step)
-        seen = set(id(u) for u in ordered)
-        rest = [u for u in self._units
-                if id(u) not in seen and u is not self]
-        self._initialized = True
-        for unit in ordered + rest:
-            unit.initialize(device=self.device, **kwargs)
-        return ordered + rest
-
-    def run(self):
-        super().run()
-        if self.xla_step is not None:
-            self.xla_step.sync_host()
 
 
 class StandardWorkflow(StandardWorkflowBase):

@@ -40,6 +40,8 @@ class XLAStep(Unit):
         self.state = None
         self.base_key = None
         self.step_index = 0
+        #: last step/epoch outputs fetched to host (key -> value)
+        self.metrics = {}
         #: jax.sharding.NamedSharding for batch tensors (set by the
         #: parallel layer; None = single device)
         self.batch_sharding = None
@@ -50,12 +52,14 @@ class XLAStep(Unit):
 
     @property
     def train_units(self):
-        return self.forwards + [self.evaluator] + \
+        units = self.forwards + [self.evaluator] + \
             list(reversed(self.gds))
+        return [u for u in units if u is not None]
 
     @property
     def eval_units(self):
-        return self.forwards + [self.evaluator]
+        return [u for u in self.forwards + [self.evaluator]
+                if u is not None]
 
     def initialize(self, device=None, **kwargs):
         super().initialize(**kwargs)
@@ -120,7 +124,10 @@ class XLAStep(Unit):
         return batch
 
     def _gather_hyper(self):
-        return {gd.name: gd.hyperparams() for gd in self.gds}
+        # custom trainers (Kohonen/RBM) bake their schedules into the
+        # trace/state and expose no hyperparams()
+        return {gd.name: gd.hyperparams() for gd in self.gds
+                if hasattr(gd, "hyperparams")}
 
     def run(self):
         if self.scan_mode:
@@ -228,16 +235,21 @@ class XLAStep(Unit):
         self._publish_metrics(outputs)
 
     def _publish_metrics(self, outputs):
-        """Hand the evaluator's step metrics to the host-side Decision."""
-        if self.evaluator is None:
-            return
-        if "n_err" in outputs:
-            self.evaluator.n_err = int(outputs["n_err"])
-        if "loss" in outputs:
-            loss = float(outputs["loss"])
-            self.evaluator.loss = loss
-            if hasattr(self.evaluator, "mse"):
-                self.evaluator.mse = loss
+        """Hand step metrics to the host side. Every unit may declare
+        ``metric_sinks() -> [(output_key, attr_name), ...]`` — the
+        evaluator base declares n_err/loss; custom trainers (Kohonen,
+        RBM) publish their own."""
+        for unit in self.train_units:
+            sinks = getattr(unit, "metric_sinks", None)
+            if sinks is None:
+                continue
+            for key, attr in sinks():
+                if key not in outputs:
+                    continue
+                value = outputs[key]
+                value = float(value) if hasattr(value, "dtype") \
+                    and value.dtype.kind == "f" else int(value)
+                setattr(unit, attr, value)
 
     # -- host sync -----------------------------------------------------
 
