@@ -1,0 +1,140 @@
+"""Distribution layer tests on the 8-device virtual CPU mesh
+(SURVEY.md §4 "TPU build translation"): DP training, ring attention
+numerics + gradients, grad-sync metric."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+def test_data_parallel_training_matches_single():
+    import jax
+    from veles.znicz_tpu import parallel
+
+    def train(dp):
+        prng.seed_all(99)
+        from veles.znicz_tpu.models import mnist
+        root.mnist.loader.update({"minibatch_size": 64,
+                                  "n_train": 512, "n_valid": 128})
+        root.mnist.decision.max_epochs = 3
+        wf = mnist.create_workflow(name="DP%d" % dp)
+        wf.initialize(device="cpu")
+        if dp:
+            parallel.setup_data_parallel(
+                wf, parallel.make_mesh({"data": 8}))
+        wf.run()
+        return wf.decision.history[-1]["validation"]["metric"]
+
+    err_dp = train(True)
+    err_single = train(False)
+    assert abs(err_dp - err_single) < 0.03, (err_dp, err_single)
+
+
+def test_grad_sync_bytes():
+    from veles.znicz_tpu import parallel
+    params = {"layer": {
+        "w": numpy.zeros((784, 100), numpy.float32),
+        "b": numpy.zeros(100, numpy.float32)}}
+    assert parallel.grad_sync_bytes(params) == (784 * 100 + 100) * 4
+
+
+def dense_attention(q, k, v, causal):
+    import jax.numpy as jnp
+    dh = q.shape[-1]
+    s = (q @ jnp.swapaxes(k, -1, -2)) / numpy.sqrt(dh)
+    if causal:
+        n = q.shape[2]
+        mask = numpy.triu(numpy.full((n, n), -1e9, numpy.float32), 1)
+        s = s + mask
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return p @ v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    import jax
+    import jax.numpy as jnp
+    from veles.znicz_tpu import parallel
+    from veles.znicz_tpu.parallel import ring
+
+    mesh = parallel.make_mesh({"seq": 8})
+    gen = prng.get("ring")
+    b, h, s, dh = 2, 2, 32, 8
+    q = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
+    k = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
+    v = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
+    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal)
+    ref = dense_attention(q, k, v, causal)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
+                          atol=2e-5), \
+        numpy.abs(numpy.asarray(out) - numpy.asarray(ref)).max()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_backward_matches_jax_grad(causal):
+    import jax
+    import jax.numpy as jnp
+    from veles.znicz_tpu import parallel
+    from veles.znicz_tpu.parallel import ring
+
+    mesh = parallel.make_mesh({"seq": 8})
+    gen = prng.get("ringb")
+    b, h, s, dh = 1, 2, 16, 4
+    q = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
+    k = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
+    v = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
+    dout = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
+
+    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal)
+    dq, dk, dv = ring.ring_self_attention_bwd(
+        q, k, v, out, lse, dout, mesh, causal=causal)
+
+    def loss(q, k, v):
+        return jnp.sum(jnp.asarray(dout)
+                       * dense_attention(q, k, v, causal))
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in ((dq, gq, "dq"), (dk, gk, "dk"),
+                            (dv, gv, "dv")):
+        assert numpy.allclose(numpy.asarray(got), numpy.asarray(want),
+                              atol=3e-4), \
+            (name, numpy.abs(numpy.asarray(got)
+                             - numpy.asarray(want)).max())
+
+
+def test_mha_unit_ring_path_matches_dense():
+    """The attention UNIT with seq_mesh set (forward + backward) equals
+    its own dense path."""
+    import jax
+    from veles.znicz_tpu import parallel
+    from veles.znicz_tpu.ops.attention import MultiHeadAttention
+    from tests.test_conv_stack import build, xla_forward, xla_backward
+
+    mesh = parallel.make_mesh({"seq": 8})
+    wf, feed, fwd, gd, x, err, comp = build(
+        MultiHeadAttention, input_shape=(2, 16, 8), gd_kwargs={},
+        heads=2)
+    params0 = comp.gather_params()
+    state0 = comp.gather_state()
+    y_dense = numpy.asarray(
+        xla_forward(comp, feed, fwd, params0, x))
+    ei_dense, params_dense = xla_backward(
+        comp, feed, fwd, gd, params0, state0, x, err)
+
+    fwd.seq_mesh = mesh
+    y_ring = numpy.asarray(xla_forward(comp, feed, fwd, params0, x))
+    ei_ring, params_ring = xla_backward(
+        comp, feed, fwd, gd, params0, state0, x, err)
+    fwd.seq_mesh = None
+
+    assert numpy.allclose(y_ring, y_dense, atol=3e-5)
+    assert numpy.allclose(numpy.asarray(ei_ring),
+                          numpy.asarray(ei_dense), atol=3e-4)
+    for pname in params_dense[fwd.name]:
+        assert numpy.allclose(
+            numpy.asarray(params_ring[fwd.name][pname]),
+            numpy.asarray(params_dense[fwd.name][pname]),
+            atol=3e-4), pname

@@ -50,7 +50,8 @@ class FullBatchLoader(Loader):
             (self.max_minibatch_size,) + sample_shape, self.serve_dtype))
         if self.original_labels:
             self.minibatch_labels.reset(numpy.zeros(
-                self.max_minibatch_size,
+                (self.max_minibatch_size,)
+                + self.original_labels.mem.shape[1:],
                 self.original_labels.mem.dtype))
         if self.original_targets:
             self.minibatch_targets.reset(numpy.zeros(

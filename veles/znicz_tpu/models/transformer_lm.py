@@ -1,0 +1,117 @@
+"""Transformer-base LM sample (BASELINE config #5 — NEW).
+
+Decoder-only LM: Embedding(+positions) → N × [MHA(residual) →
+LayerNorm → FFN(residual) → LayerNorm] → TokenDense(vocab logits),
+trained next-token on a deterministic synthetic periodic-sequence
+corpus (the pattern-copy task needs real attention to solve, and
+converges quickly at small scale).
+
+Config under ``root.lm``; sequence parallelism / ring attention for
+long contexts lives in ``veles.znicz_tpu.parallel.ring`` and is
+exercised by the parallel tests.
+"""
+
+import numpy
+
+from veles import prng
+from veles.config import root
+from veles.loader.fullbatch import FullBatchLoader
+from veles.znicz_tpu.ops.evaluator import EvaluatorLM
+from veles.znicz_tpu.standard_workflow import StandardWorkflow
+
+root.lm.update({
+    "loader": {"minibatch_size": 64, "n_train": 2048, "n_valid": 256,
+               "seq_len": 32, "vocab": 16, "max_period": 6},
+    "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128},
+    "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
+              "weights_decay": 0.0},
+    "decision": {"max_epochs": 8, "fail_iterations": 50},
+})
+
+
+class PeriodicLMLoader(FullBatchLoader):
+    """Sequences repeating a random pattern of random period ≤
+    max_period; labels are the next-token shift. Prediction beyond one
+    period requires attending back — a true attention task."""
+
+    def load_data(self):
+        cfg = root.lm.loader
+        gen = prng.get("lm_data")
+        n = cfg.get("n_train", 2048) + cfg.get("n_valid", 256)
+        s = cfg.get("seq_len", 32)
+        vocab = cfg.get("vocab", 16)
+        max_p = cfg.get("max_period", 6)
+        seqs = numpy.zeros((n, s + 1), numpy.int32)
+        for i in range(n):
+            p = int(gen.randint(2, max_p + 1))
+            pattern = gen.randint(0, vocab, p)
+            reps = (s + 1 + p - 1) // p
+            seqs[i] = numpy.tile(pattern, reps)[:s + 1]
+        self.original_data.mem = seqs[:, :-1]
+        self.original_labels.mem = seqs[:, 1:]
+        n_valid = cfg.get("n_valid", 256)
+        self.class_lengths = [0, n_valid, n - n_valid]
+        # serve token ids as ints, not floats
+        self.serve_dtype = numpy.int32
+        # [valid | train] layout expected by the loader
+        order = numpy.concatenate([
+            numpy.arange(n - n_valid, n), numpy.arange(0, n - n_valid)])
+        self.original_data.mem = self.original_data.mem[order]
+        self.original_labels.mem = self.original_labels.mem[order]
+
+
+def build_layers():
+    m = root.lm.model
+    t = root.lm.train.to_dict()
+    layers = [{"type": "embedding",
+               "->": {"vocab_size": root.lm.loader.vocab,
+                      "dim": m.dim},
+               "<-": dict(t)}]
+    for _ in range(m.layers):
+        layers += [
+            {"type": "attention",
+             "->": {"heads": m.heads, "causal": True,
+                    "residual": True},
+             "<-": dict(t)},
+            {"type": "layernorm", "<-": dict(t)},
+            {"type": "transformer_ffn",
+             "->": {"hidden": m.ffn_hidden, "residual": True},
+             "<-": dict(t)},
+            {"type": "layernorm", "<-": dict(t)},
+        ]
+    layers.append({"type": "token_dense",
+                   "->": {"output_features": root.lm.loader.vocab},
+                   "<-": dict(t)})
+    return layers
+
+
+def lm_evaluator_factory(wf, last):
+    ev = EvaluatorLM(wf, name="evaluator")
+    ev.link_attrs(last, ("input", "output"))
+    ev.link_attrs(wf.loader, ("labels", "minibatch_labels"),
+                  ("batch_size", "minibatch_size"))
+    return ev
+
+
+def create_workflow(name="TransformerLM", **kwargs):
+    cfg = root.lm
+    return StandardWorkflow(
+        None, name=name,
+        layers=build_layers(),
+        loader_factory=lambda wf: PeriodicLMLoader(
+            wf, name="loader",
+            minibatch_size=cfg.loader.minibatch_size),
+        evaluator_factory=lm_evaluator_factory,
+        decision_config=cfg.decision.to_dict(),
+        **kwargs)
+
+
+def run(load, main):
+    load(StandardWorkflow,
+         layers=build_layers(),
+         loader_factory=lambda wf: PeriodicLMLoader(
+             wf, name="loader",
+             minibatch_size=root.lm.loader.minibatch_size),
+         evaluator_factory=lm_evaluator_factory,
+         decision_config=root.lm.decision.to_dict())
+    main()

@@ -14,7 +14,7 @@ from veles.znicz_tpu.ops.gd import (  # noqa: F401
     GradientDescent, GDTanh, GDRELU, GDStrictRELU, GDSigmoid, GDSoftmax,
 )
 from veles.znicz_tpu.ops.evaluator import (  # noqa: F401
-    EvaluatorBase, EvaluatorSoftmax, EvaluatorMSE,
+    EvaluatorBase, EvaluatorSoftmax, EvaluatorMSE, EvaluatorLM,
 )
 from veles.znicz_tpu.ops.conv import (  # noqa: F401
     Conv, ConvTanh, ConvRELU, ConvStrictRELU, ConvSigmoid,
@@ -42,6 +42,17 @@ from veles.znicz_tpu.ops.deconv import (  # noqa: F401
 )
 from veles.znicz_tpu.ops.mean_disp_normalizer import (  # noqa: F401
     MeanDispNormalizer,
+)
+from veles.znicz_tpu.ops.layernorm import (  # noqa: F401
+    LayerNormForward, GDLayerNorm,
+)
+from veles.znicz_tpu.ops.embedding import (  # noqa: F401
+    EmbeddingForward, GDEmbedding,
+)
+from veles.znicz_tpu.ops.attention import (  # noqa: F401
+    TokenDense, TokenDenseRELU, GDTokenDense, GDTokenDenseRELU,
+    TransformerFFN, GDTransformerFFN,
+    MultiHeadAttention, GDMultiHeadAttention,
 )
 from veles.znicz_tpu.ops.kohonen import (  # noqa: F401
     KohonenForward, KohonenTrainer,

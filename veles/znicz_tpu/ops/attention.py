@@ -1,0 +1,557 @@
+"""Multi-head attention + transformer FFN + per-token dense (NEW).
+
+The Transformer units the north star adds (BASELINE config #5;
+SURVEY.md §5.7): explicit forward/backward as graph nodes, in the znicz
+style — ``jax.grad`` is only a test oracle. All math is generic over
+``xp`` so the numpy oracle and the traced path share one formula set.
+
+Residual connections are INTERNAL to the attention/FFN units
+(``residual=True`` ⇒ y = x + f(x)), so the backward stays a linear
+chain like the rest of the zoo; stacking
+
+    MHA(residual) → LayerNorm → FFN(residual) → LayerNorm
+
+yields the classic post-LN transformer block.
+
+Long-context: the single-chip path materialises the (B,H,S,S) score
+matrix; the sequence-parallel ring path
+(``veles.znicz_tpu.parallel.ring``) streams K/V blocks around the
+'seq' mesh axis with ``ppermute`` instead.
+"""
+
+import numpy
+
+from veles.memory import Array
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+from veles.znicz_tpu.ops import activations as A
+
+
+# ---------------------------------------------------------------------------
+# per-token dense (operates on the trailing dim of (B, S, D))
+
+
+class TokenDenseBase(Forward):
+    """y = act(x · W + b) over the last axis, any leading shape."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, output_features=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if not output_features:
+            raise ValueError("token_dense needs output_features")
+        self.output_features = int(output_features)
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape[:-1]) + (self.output_features,)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        d = self.input.shape[-1]
+        self.init_weights((d, self.output_features), d,
+                          self.output_features)
+        oshape = self.output_shape_for(self.input.shape)
+        if not self.output or self.output.shape != oshape:
+            self.output.reset(numpy.zeros(oshape, numpy.float32))
+
+    def _forward(self, xp, x, w, b):
+        v = x @ w
+        if self.include_bias:
+            v = v + b
+        return A.ACTIVATIONS[self.ACTIVATION][0](xp, v)
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        b = self.bias.map_read().mem if self.include_bias else None
+        self.output.map_invalidate()
+        self.output.mem[...] = self._forward(
+            numpy, x, self.weights.map_read().mem, b)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        p = ctx.unit_params(self)
+        ctx.set(self, "output",
+                self._forward(jnp, x, p["weights"], p.get("bias"))
+                .astype(jnp.float32))
+
+
+@forward_unit("token_dense")
+class TokenDense(TokenDenseBase):
+    ACTIVATION = "linear"
+
+
+@forward_unit("token_dense_relu")
+class TokenDenseRELU(TokenDenseBase):
+    ACTIVATION = "strict_relu"
+
+
+class GDTokenDenseBase(GradientDescentBase):
+    ACTIVATION = "linear"
+
+    def _backward(self, xp, x, y, w, err):
+        d = A.ACTIVATIONS[self.ACTIVATION][1](xp, y)
+        dz = err if isinstance(d, float) else err * d
+        x2 = x.reshape(-1, x.shape[-1])
+        dz2 = dz.reshape(-1, dz.shape[-1])
+        grad_w = x2.T @ dz2
+        grad_b = dz2.sum(axis=0) if self.include_bias else None
+        dx = (dz @ w.T) if self.need_err_input else None
+        return dx, grad_w, grad_b
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        y = f.output.map_read().mem
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(y.shape)
+        dx, gw, gb = self._backward(numpy, x, y,
+                                    f.weights.map_read().mem, err)
+        if dx is not None:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = dx
+        self.update_weights_numpy(gw, gb)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        y = ctx.get(f, "output")
+        err = ctx.get(self, "err_output").reshape(y.shape)
+        dx, gw, gb = self._backward(
+            jnp, x, y, ctx.unit_params(f)["weights"], err)
+        if dx is not None:
+            ctx.set(self, "err_input", dx.astype(jnp.float32))
+        self.update_weights_xla(ctx, gw, gb)
+
+
+@gradient_for(TokenDense)
+class GDTokenDense(GDTokenDenseBase):
+    ACTIVATION = "linear"
+
+
+@gradient_for(TokenDenseRELU)
+class GDTokenDenseRELU(GDTokenDenseBase):
+    ACTIVATION = "strict_relu"
+
+
+# ---------------------------------------------------------------------------
+# transformer FFN block: y = [x +] act(x·W1+b1)·W2+b2
+
+
+@forward_unit("transformer_ffn")
+class TransformerFFN(Forward):
+    PARAMS = ("weights", "bias", "weights2", "bias2")
+    ACTIVATION = "strict_relu"
+
+    def __init__(self, workflow, hidden=None, residual=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.hidden = hidden
+        self.residual = residual
+        self.weights2 = Array()
+        self.bias2 = Array()
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        d = self.input.shape[-1]
+        hidden = self.hidden or 4 * d
+        self.hidden = hidden
+        self.init_weights((d, hidden), d, hidden)
+        if not self.weights2 or self.weights2.shape != (hidden, d):
+            self.weights2.reset(
+                numpy.zeros((hidden, d), numpy.float32))
+            self.fill_array(self.weights2, self.weights_filling,
+                            self.weights_stddev
+                            or self.default_weights_stddev(hidden, d))
+            self.bias2.reset(numpy.zeros(d, numpy.float32))
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    def _forward(self, xp, x, w1, b1, w2, b2):
+        hcur = A.ACTIVATIONS[self.ACTIVATION][0](xp, x @ w1 + b1)
+        y = hcur @ w2 + b2
+        if self.residual:
+            y = y + x
+        return y, hcur
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        y, hcur = self._forward(
+            numpy, x, self.weights.map_read().mem,
+            self.bias.map_read().mem,
+            self.weights2.map_read().mem, self.bias2.map_read().mem)
+        self.output.map_invalidate()
+        self.output.mem[...] = y
+        self._cache_h = hcur
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        p = ctx.unit_params(self)
+        y, hcur = self._forward(jnp, x, p["weights"], p["bias"],
+                                p["weights2"], p["bias2"])
+        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "cache_h", hcur)
+
+
+@gradient_for(TransformerFFN)
+class GDTransformerFFN(GradientDescentBase):
+    STATE = GradientDescentBase.STATE + ("vel_weights2", "vel_bias2")
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.vel_weights2 = Array()
+        self.vel_bias2 = Array()
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        f = self.forward
+        if f.weights2 and (not self.vel_weights2
+                           or self.vel_weights2.shape
+                           != f.weights2.shape):
+            self.vel_weights2.reset(numpy.zeros_like(f.weights2.mem))
+            self.vel_bias2.reset(numpy.zeros_like(f.bias2.mem))
+
+    def _backward(self, xp, x, w1, w2, hcur, err):
+        f = self.forward
+        d = x.shape[-1]
+        dh = err @ w2.T
+        dh = dh * A.ACTIVATIONS[f.ACTIVATION][1](xp, hcur)
+        gw2 = hcur.reshape(-1, f.hidden).T @ err.reshape(-1, d)
+        gb2 = err.reshape(-1, d).sum(axis=0)
+        gw1 = x.reshape(-1, d).T @ dh.reshape(-1, f.hidden)
+        gb1 = dh.reshape(-1, f.hidden).sum(axis=0)
+        dx = dh @ w1.T
+        if f.residual:
+            dx = dx + err
+        return dx, gw1, gb1, gw2, gb2
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(x.shape)
+        dx, gw1, gb1, gw2, gb2 = self._backward(
+            numpy, x, f.weights.map_read().mem,
+            f.weights2.map_read().mem, f._cache_h, err)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = dx
+        self.update_weights_numpy(gw1, gb1)
+        self._np_update(f.weights2, self.vel_weights2, gw2,
+                        self.learning_rate, self.gradient_moment,
+                        self.weights_decay, self.l1_vs_l2)
+        self._np_update(f.bias2, self.vel_bias2, gb2,
+                        self.learning_rate_bias,
+                        self.gradient_moment_bias,
+                        self.weights_decay_bias, self.l1_vs_l2_bias)
+
+    def _np_update(self, arr, vel, grad, lr, moment, l2, l1r):
+        arr.map_write()
+        vel.map_write()
+        arr.mem[...], vel.mem[...] = self.apply_update(
+            numpy, arr.mem, vel.mem, grad, lr, moment, l2, l1r)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        err = ctx.get(self, "err_output").reshape(x.shape)
+        p = ctx.unit_params(f)
+        hcur = ctx.get(f, "cache_h")
+        dx, gw1, gb1, gw2, gb2 = self._backward(
+            jnp, x, p["weights"], p["weights2"], hcur, err)
+        if self.need_err_input:
+            ctx.set(self, "err_input", dx.astype(jnp.float32))
+        self.update_weights_xla(ctx, gw1, gb1)
+        h = ctx.hyper[self.name]
+        st = ctx.unit_state(self)
+        w2, vel2 = p["weights2"], st["vel_weights2"]
+        w2, vel2 = self.apply_update(
+            jnp, w2, vel2, ctx.pmean(gw2).astype(w2.dtype), h["lr"],
+            h["moment"], h["l2"], h["l1_vs_l2"])
+        b2, velb2 = p["bias2"], st["vel_bias2"]
+        b2, velb2 = self.apply_update(
+            jnp, b2, velb2, ctx.pmean(gb2).astype(b2.dtype),
+            h["lr_bias"], h["moment_bias"], h["l2_bias"],
+            h["l1_vs_l2_bias"])
+        ctx.update_params(f, weights2=w2, bias2=b2)
+        ctx.update_state(self, vel_weights2=vel2, vel_bias2=velb2)
+
+
+# ---------------------------------------------------------------------------
+# multi-head attention
+
+
+@forward_unit("attention")
+class MultiHeadAttention(Forward):
+    """Causal (or full) multi-head self-attention over (B, S, D), with
+    optional internal residual (y = x + attn(x)).
+
+    Parameters: fused qkv projection ``weights`` (D, 3D) and output
+    projection ``weights_out`` (D, D); biases optional.
+    """
+
+    PARAMS = ("weights", "bias", "weights_out", "bias_out")
+
+    def __init__(self, workflow, heads=4, causal=True, residual=True,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.heads = int(heads)
+        self.causal = causal
+        self.residual = residual
+        self.weights_out = Array()
+        self.bias_out = Array()
+        #: jax Mesh with a sequence axis -> the traced path streams
+        #: K/V around the ring (sequence parallelism) instead of
+        #: materialising the (B,H,S,S) score matrix
+        self.seq_mesh = None
+        self.seq_axis = "seq"
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        b, s, d = self.input.shape
+        if d % self.heads:
+            raise ValueError("dim %d not divisible by %d heads"
+                             % (d, self.heads))
+        self.init_weights((d, 3 * d), d, 3 * d)
+        if not self.weights_out or self.weights_out.shape != (d, d):
+            self.weights_out.reset(numpy.zeros((d, d), numpy.float32))
+            self.fill_array(self.weights_out, self.weights_filling,
+                            self.weights_stddev
+                            or self.default_weights_stddev(d, d))
+        if self.include_bias:
+            if not self.bias or self.bias.shape != (3 * d,):
+                self.bias.reset(numpy.zeros(3 * d, numpy.float32))
+            if not self.bias_out or self.bias_out.shape != (d,):
+                self.bias_out.reset(numpy.zeros(d, numpy.float32))
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    # shared math ------------------------------------------------------
+
+    def _split(self, t):
+        b, s, d = t.shape
+        h = self.heads
+        return t.reshape(b, s, h, d // h).transpose(0, 2, 1, 3)
+
+    def _merge(self, t):
+        b, h, s, dh = t.shape
+        return t.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
+
+    def _fwd_core(self, xp, x, w, bqkv, wo, bo):
+        b, s, d = x.shape
+        dh = d // self.heads
+        qkv = x @ w
+        if self.include_bias:
+            qkv = qkv + bqkv
+        q = self._split(qkv[..., :d])
+        k = self._split(qkv[..., d:2 * d])
+        v = self._split(qkv[..., 2 * d:])
+        scale = numpy.float32(1.0 / numpy.sqrt(dh))
+        scores = (q @ k.transpose(0, 1, 3, 2)) * scale
+        if self.causal:
+            mask = xp.asarray(
+                numpy.triu(numpy.full((s, s), -1e9, numpy.float32), 1))
+            scores = scores + mask
+        probs = A.softmax(xp, scores)
+        merged = self._merge(probs @ v)
+        y = merged @ wo
+        if self.include_bias:
+            y = y + bo
+        if self.residual:
+            y = y + x
+        return y, (q, k, v, probs, merged)
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        y, cache = self._fwd_core(
+            numpy, x, self.weights.map_read().mem,
+            self.bias.map_read().mem if self.include_bias else None,
+            self.weights_out.map_read().mem,
+            self.bias_out.map_read().mem if self.include_bias else None)
+        self.output.map_invalidate()
+        self.output.mem[...] = y
+        self._cache = cache
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        p = ctx.unit_params(self)
+        if self.seq_mesh is not None:
+            y, cache = self._fwd_ring(jnp, x, p)
+            names = ("q", "k", "v", "out_heads", "lse", "merged")
+        else:
+            y, cache = self._fwd_core(
+                jnp, x, p["weights"], p.get("bias"), p["weights_out"],
+                p.get("bias_out"))
+            names = ("q", "k", "v", "probs", "merged")
+        ctx.set(self, "output", y.astype(jnp.float32))
+        for name, t in zip(names, cache):
+            ctx.set(self, "cache_" + name, t)
+
+    def _fwd_ring(self, xp, x, p):
+        """Sequence-parallel forward: qkv projection under
+        auto-sharding, attention proper via the ppermute ring."""
+        from veles.znicz_tpu.parallel import ring
+        b, s, d = x.shape
+        qkv = x @ p["weights"]
+        if self.include_bias:
+            qkv = qkv + p["bias"]
+        q = self._split(qkv[..., :d])
+        k = self._split(qkv[..., d:2 * d])
+        v = self._split(qkv[..., 2 * d:])
+        out_heads, lse = ring.ring_self_attention(
+            q, k, v, self.seq_mesh, axis=self.seq_axis,
+            causal=self.causal)
+        merged = self._merge(out_heads)
+        y = merged @ p["weights_out"]
+        if self.include_bias:
+            y = y + p["bias_out"]
+        if self.residual:
+            y = y + x
+        return y, (q, k, v, out_heads, lse, merged)
+
+
+@gradient_for(MultiHeadAttention)
+class GDMultiHeadAttention(GradientDescentBase):
+    """Hand-written attention backward (verified vs jax.grad)."""
+
+    STATE = GradientDescentBase.STATE + (
+        "vel_weights_out", "vel_bias_out")
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.vel_weights_out = Array()
+        self.vel_bias_out = Array()
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+        f = self.forward
+        if f.weights_out and (
+                not self.vel_weights_out
+                or self.vel_weights_out.shape != f.weights_out.shape):
+            self.vel_weights_out.reset(
+                numpy.zeros_like(f.weights_out.mem))
+        if f.include_bias and f.bias_out and not self.vel_bias_out:
+            self.vel_bias_out.reset(numpy.zeros_like(f.bias_out.mem))
+
+    def _bwd_core(self, xp, x, w, wo, cache, err):
+        f = self.forward
+        b, s, d = x.shape
+        dh = d // f.heads
+        q, k, v, probs, merged = cache
+        scale = numpy.float32(1.0 / numpy.sqrt(dh))
+
+        gwo = merged.reshape(-1, d).T @ err.reshape(-1, d)
+        gbo = err.reshape(-1, d).sum(axis=0)
+        dmerged = err @ wo.T
+        dctx = f._split(dmerged)                       # (B,H,S,dh)
+        dprobs = dctx @ v.transpose(0, 1, 3, 2)        # (B,H,S,S)
+        dv = probs.transpose(0, 1, 3, 2) @ dctx
+        dscores = probs * (dprobs - (dprobs * probs)
+                           .sum(axis=-1, keepdims=True))
+        dscores = dscores * scale
+        dq = dscores @ k
+        dk = dscores.transpose(0, 1, 3, 2) @ q
+        dqkv = xp.concatenate(
+            [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
+        gw = x.reshape(-1, d).T @ dqkv.reshape(-1, 3 * d)
+        gb = dqkv.reshape(-1, 3 * d).sum(axis=0)
+        dx = dqkv @ w.T
+        if f.residual:
+            dx = dx + err
+        return dx, gw, gb, gwo, gbo
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(x.shape)
+        dx, gw, gb, gwo, gbo = self._bwd_core(
+            numpy, x, f.weights.map_read().mem,
+            f.weights_out.map_read().mem, f._cache, err)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = dx
+        self.update_weights_numpy(gw, gb if f.include_bias else None)
+        self._np_update(f.weights_out, self.vel_weights_out, gwo,
+                        self.learning_rate, self.gradient_moment,
+                        self.weights_decay, self.l1_vs_l2)
+        if f.include_bias:
+            self._np_update(f.bias_out, self.vel_bias_out, gbo,
+                            self.learning_rate_bias,
+                            self.gradient_moment_bias,
+                            self.weights_decay_bias, self.l1_vs_l2_bias)
+
+    def _np_update(self, arr, vel, grad, lr, moment, l2, l1r):
+        arr.map_write()
+        vel.map_write()
+        arr.mem[...], vel.mem[...] = self.apply_update(
+            numpy, arr.mem, vel.mem, grad, lr, moment, l2, l1r)
+
+    def _bwd_ring(self, xp, x, p, ctx, err):
+        """Sequence-parallel backward via the ring (dk/dv circulate a
+        full circle back to their home shards)."""
+        from veles.znicz_tpu.parallel import ring
+        f = self.forward
+        b, s, d = x.shape
+        q, k, v, out_heads, lse, merged = (
+            ctx.get(f, "cache_" + n)
+            for n in ("q", "k", "v", "out_heads", "lse", "merged"))
+        gwo = merged.reshape(-1, d).T @ err.reshape(-1, d)
+        gbo = err.reshape(-1, d).sum(axis=0)
+        dmerged = err @ p["weights_out"].T
+        dctx = f._split(dmerged)
+        dq, dk, dv = ring.ring_self_attention_bwd(
+            q, k, v, out_heads, lse, dctx, f.seq_mesh,
+            axis=f.seq_axis, causal=f.causal)
+        dqkv = xp.concatenate(
+            [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
+        gw = x.reshape(-1, d).T @ dqkv.reshape(-1, 3 * d)
+        gb = dqkv.reshape(-1, 3 * d).sum(axis=0)
+        dx = dqkv @ p["weights"].T
+        if f.residual:
+            dx = dx + err
+        return dx, gw, gb, gwo, gbo
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        err = ctx.get(self, "err_output").reshape(x.shape)
+        p = ctx.unit_params(f)
+        if f.seq_mesh is not None:
+            dx, gw, gb, gwo, gbo = self._bwd_ring(jnp, x, p, ctx, err)
+        else:
+            cache = tuple(ctx.get(f, "cache_" + n)
+                          for n in ("q", "k", "v", "probs", "merged"))
+            dx, gw, gb, gwo, gbo = self._bwd_core(
+                jnp, x, p["weights"], p["weights_out"], cache, err)
+        if self.need_err_input:
+            ctx.set(self, "err_input", dx.astype(jnp.float32))
+        self.update_weights_xla(ctx, gw, gb if f.include_bias else None)
+        h = ctx.hyper[self.name]
+        st = ctx.unit_state(self)
+        w_o, vel = p["weights_out"], st["vel_weights_out"]
+        w_o, vel = self.apply_update(
+            jnp, w_o, vel, ctx.pmean(gwo).astype(w_o.dtype), h["lr"],
+            h["moment"], h["l2"], h["l1_vs_l2"])
+        ctx.update_params(f, weights_out=w_o)
+        ctx.update_state(self, vel_weights_out=vel)
+        if f.include_bias:
+            b_o, velb = p["bias_out"], st["vel_bias_out"]
+            b_o, velb = self.apply_update(
+                jnp, b_o, velb, ctx.pmean(gbo).astype(b_o.dtype),
+                h["lr_bias"], h["moment_bias"], h["l2_bias"],
+                h["l1_vs_l2_bias"])
+            ctx.update_params(f, bias_out=b_o)
+            ctx.update_state(self, vel_bias_out=velb)

@@ -154,3 +154,51 @@ class EvaluatorMSE(EvaluatorBase):
         ctx.set(self, "err_output", err.reshape(y.shape))
         ctx.export("loss", mse)
         ctx.export("n_err", jnp.int32(0))
+
+
+class EvaluatorLM(EvaluatorBase):
+    """Next-token softmax cross-entropy over (B, S, V) logits with
+    integer labels (B, S); fused backward like EvaluatorSoftmax, but
+    per TOKEN: err = (softmax − onehot)/(valid·S) on valid rows.
+    ``n_err`` counts wrong token predictions (NEW — Transformer LM)."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.labels = None          # linked: loader.minibatch_labels
+
+    def _compute(self, xp, logits, labels, valid):
+        b, s, vocab = logits.shape
+        z = logits - logits.max(axis=-1, keepdims=True)
+        logp = z - xp.log(xp.exp(z).sum(axis=-1, keepdims=True))
+        probs = xp.exp(logp)
+        onehot = (labels[..., None] ==
+                  xp.arange(vocab)[None, None, :]).astype(logits.dtype)
+        rowmask = (xp.arange(b) < valid).astype(logits.dtype)
+        denom = valid.astype(logits.dtype) * float(s)
+        err = (probs - onehot) * rowmask[:, None, None] / denom
+        loss = -(logp * onehot).sum(axis=-1)
+        loss = (loss * rowmask[:, None]).sum() / denom
+        pred = xp.argmax(logits, axis=-1)
+        wrong = ((pred != labels) & (rowmask[:, None] > 0)).sum()
+        return err, loss, wrong
+
+    def numpy_run(self):
+        logits = self.input.map_read().mem.astype(numpy.float32)
+        labels = numpy.asarray(self.labels.map_read().mem,
+                               numpy.int64)
+        valid = numpy.int32(int(self.batch_size))
+        err, loss, wrong = self._compute(numpy, logits, labels, valid)
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = err
+        self.loss = float(loss)
+        self.n_err = int(wrong)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        logits = ctx.get(self, "input")
+        labels = ctx.get(self, "labels").astype(jnp.int32)
+        valid = ctx.get(self, "batch_size")
+        err, loss, wrong = self._compute(jnp, logits, labels, valid)
+        ctx.set(self, "err_output", err)
+        ctx.export("loss", loss)
+        ctx.export("n_err", wrong.astype(jnp.int32))

@@ -1,0 +1,98 @@
+"""LayerNorm unit pair (NEW — no reference counterpart).
+
+SURVEY.md §2.8/"§5.7": the north star adds a Transformer-base LM
+config, which needs LayerNorm/Attention unit pairs built in the same
+explicit forward/backward style as the znicz zoo. Normalizes over the
+trailing (feature) dimension with learned gain/bias.
+"""
+
+import numpy
+
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+
+
+@forward_unit("layernorm")
+class LayerNormForward(Forward):
+    PARAMS = ("weights", "bias")   # gamma, beta
+
+    def __init__(self, workflow, eps=1e-5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.eps = float(eps)
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        d = self.input.shape[-1]
+        if not self.weights or self.weights.shape != (d,):
+            self.weights.reset(numpy.ones(d, numpy.float32))
+        if not self.bias or self.bias.shape != (d,):
+            self.bias.reset(numpy.zeros(d, numpy.float32))
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    def _forward(self, xp, x, g, b):
+        mu = x.mean(axis=-1, keepdims=True)
+        xc = x - mu
+        var = (xc * xc).mean(axis=-1, keepdims=True)
+        rstd = 1.0 / xp.sqrt(var + self.eps)
+        return (xc * rstd) * g + b
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        self.output.map_invalidate()
+        self.output.mem[...] = self._forward(
+            numpy, x, self.weights.map_read().mem,
+            self.bias.map_read().mem)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        p = ctx.unit_params(self)
+        ctx.set(self, "output",
+                self._forward(jnp, x, p["weights"], p["bias"])
+                .astype(jnp.float32))
+
+
+@gradient_for(LayerNormForward)
+class GDLayerNorm(GradientDescentBase):
+    def _backward(self, xp, x, g, err):
+        eps = self.forward.eps
+        mu = x.mean(axis=-1, keepdims=True)
+        xc = x - mu
+        var = (xc * xc).mean(axis=-1, keepdims=True)
+        rstd = 1.0 / xp.sqrt(var + eps)
+        xhat = xc * rstd
+        dg = (err * xhat).reshape(-1, x.shape[-1]).sum(axis=0)
+        db = err.reshape(-1, x.shape[-1]).sum(axis=0)
+        dxhat = err * g
+        m1 = dxhat.mean(axis=-1, keepdims=True)
+        m2 = (dxhat * xhat).mean(axis=-1, keepdims=True)
+        dx = (dxhat - m1 - xhat * m2) * rstd
+        return dx, dg, db
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(x.shape)
+        dx, dg, db = self._backward(numpy, x,
+                                    f.weights.map_read().mem, err)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = dx
+        self.update_weights_numpy(dg, db)
+
+    def xla_run(self, ctx):
+        f = self.forward
+        x = ctx.get(f, "input")
+        err = ctx.get(self, "err_output").reshape(x.shape)
+        import jax.numpy as jnp
+        dx, dg, db = self._backward(
+            jnp, x, ctx.unit_params(f)["weights"], err)
+        if self.need_err_input:
+            ctx.set(self, "err_input", dx.astype(jnp.float32))
+        self.update_weights_xla(ctx, dg, db)

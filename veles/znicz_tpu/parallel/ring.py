@@ -1,0 +1,169 @@
+"""Ring attention — sequence/context parallelism over the 'seq' axis.
+
+The long-context story (task brief: "ring attention or all-to-all
+sequence/context parallelism for long sequences"). The single-chip
+attention materialises the (B, H, S, S) score matrix; here the
+sequence is SHARDED over a mesh axis and K/V blocks rotate around the
+ring via ``lax.ppermute`` while each device keeps a running
+flash-style online softmax for its local Q block — peak memory per
+chip drops from O(S²) to O(S·S/n) and the K/V transfers ride ICI
+neighbour links.
+
+Forward keeps (out, logsumexp); backward re-computes block scores and
+rotates (k, v, dk, dv) a full circle so gradients land back on their
+home shard. Both are hand-written collectives (no autodiff), verified
+against the dense oracle in tests.
+
+Usage: wrap in ``shard_map`` with q/k/v sharded on the sequence dim —
+:func:`ring_self_attention` does the plumbing given a mesh.
+"""
+
+import functools
+
+import numpy
+
+
+def _shard_map(**kw):
+    """Version-portable shard_map partial (the replication-check kwarg
+    was renamed check_rep -> check_vma across jax versions)."""
+    import functools as ft
+    import jax
+    if hasattr(jax, "shard_map"):
+        return ft.partial(jax.shard_map, check_vma=False, **kw)
+    from jax.experimental.shard_map import shard_map
+    return ft.partial(shard_map, check_rep=False, **kw)
+
+
+def _local_attention_steps(q, k0, v0, axis_name, causal, n_dev):
+    """Shared forward loop: returns (acc, m, l) after a full ring
+    rotation. All arrays are per-device blocks (B, H, Sb, dh)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b, h, sb, dh = q.shape
+    scale = numpy.float32(1.0 / numpy.sqrt(dh))
+    my = lax.axis_index(axis_name)
+    qpos = my * sb + jnp.arange(sb)                 # global q rows
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src = (my - step) % n_dev
+        kpos = src * sb + jnp.arange(sb)
+        s = (q @ k_cur.transpose(0, 1, 3, 2)) * scale
+        if causal:
+            mask = (kpos[None, :] > qpos[:, None]) * \
+                jnp.float32(-1e9)
+            s = s + mask[None, None, :, :]
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        coef = jnp.exp(m - m_new)
+        l_new = l * coef + p.sum(axis=-1)
+        acc_new = acc * coef[..., None] + p @ v_cur
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l_new, acc_new
+
+    m0 = jnp.full((b, h, sb), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sb), jnp.float32)
+    acc0 = jnp.zeros_like(q)
+    carry = (k0, v0, m0, l0, acc0)
+    for step in range(n_dev):   # static unroll: n_dev is mesh-sized
+        carry = body(step, carry)
+    _, _, m, l, acc = carry
+    return acc, m, l
+
+
+def ring_attention_fwd(q, k, v, axis_name, causal, n_dev):
+    """Per-shard forward body (call under shard_map).
+
+    Returns (out, lse) with out = softmax(qkᵀ)v over the GLOBAL
+    sequence, lse = logsumexp of each row's scores."""
+    import jax.numpy as jnp
+    acc, m, l = _local_attention_steps(q, k, v, axis_name, causal,
+                                       n_dev)
+    out = acc / l[..., None]
+    lse = m + jnp.log(l)
+    return out, lse
+
+
+def ring_attention_bwd(q, k, v, out, lse, dout, axis_name, causal,
+                       n_dev):
+    """Per-shard backward body: (dq, dk, dv), dk/dv returned on their
+    home shards after a full ring circle."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    b, h, sb, dh = q.shape
+    scale = numpy.float32(1.0 / numpy.sqrt(dh))
+    my = lax.axis_index(axis_name)
+    qpos = my * sb + jnp.arange(sb)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    delta = (dout * out).sum(axis=-1)               # (B,H,Sb)
+
+    def body(step, carry):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        src = (my - step) % n_dev
+        kpos = src * sb + jnp.arange(sb)
+        s = (q @ k_cur.transpose(0, 1, 3, 2)) * scale
+        if causal:
+            mask = (kpos[None, :] > qpos[:, None]) * \
+                jnp.float32(-1e9)
+            s = s + mask[None, None, :, :]
+        p = jnp.exp(s - lse[..., None])             # exact probs
+        dp = dout @ v_cur.transpose(0, 1, 3, 2)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + ds @ k_cur
+        dk_cur = dk_cur + ds.transpose(0, 1, 3, 2) @ q
+        dv_cur = dv_cur + p.transpose(0, 1, 3, 2) @ dout
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = lax.ppermute(dv_cur, axis_name, perm)
+        return k_nxt, v_nxt, dk_nxt, dv_nxt, dq
+
+    carry = (k, v, jnp.zeros_like(k), jnp.zeros_like(v),
+             jnp.zeros_like(q))
+    for step in range(n_dev):
+        carry = body(step, carry)
+    _, _, dk, dv, dq = carry
+    return dq, dk, dv
+
+
+def ring_self_attention(q, k, v, mesh, axis="seq", causal=True):
+    """Dense-equivalent attention with the sequence sharded over
+    ``axis``. q/k/v: (B, H, S, dh) global arrays. Returns (out, lse)
+    global arrays (out sharded like q)."""
+    from jax.sharding import PartitionSpec as P
+    shard_map = _shard_map()
+
+    n_dev = mesh.shape[axis]
+    spec = P(None, None, axis, None)
+    lspec = P(None, None, axis)
+
+    fn = shard_map(
+        functools.partial(ring_attention_fwd, axis_name=axis,
+                          causal=causal, n_dev=n_dev),
+        mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, lspec))
+    return fn(q, k, v)
+
+
+def ring_self_attention_bwd(q, k, v, out, lse, dout, mesh, axis="seq",
+                            causal=True):
+    import functools as ft
+    from jax.sharding import PartitionSpec as P
+    shard_map = _shard_map()
+
+    n_dev = mesh.shape[axis]
+    spec = P(None, None, axis, None)
+    lspec = P(None, None, axis)
+    fn = shard_map(
+        ft.partial(ring_attention_bwd, axis_name=axis, causal=causal,
+                   n_dev=n_dev),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, lspec, spec),
+        out_specs=(spec, spec, spec))
+    return fn(q, k, v, out, lse, dout)

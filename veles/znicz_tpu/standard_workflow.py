@@ -46,13 +46,16 @@ class StandardWorkflowBase(NNWorkflow):
 
     def __init__(self, workflow=None, layers=None, loader_factory=None,
                  decision_config=None, snapshotter_config=None,
-                 name=None, **kwargs):
+                 evaluator_factory=None, name=None, **kwargs):
         super().__init__(workflow, name=name, **kwargs)
         self.layers_config = normalize_layers(layers or [])
         self.loader_factory = loader_factory
         self.decision_config = dict(decision_config or {})
         #: dict -> Snapshotter kwargs; None disables checkpointing
         self.snapshotter_config = snapshotter_config
+        #: callable(workflow, last_forward) -> fully-linked evaluator
+        #: (overrides the softmax/MSE auto-selection)
+        self.evaluator_factory = evaluator_factory
 
     # -- builders (each mirrors a reference link_* method [U]) ---------
 
@@ -82,7 +85,9 @@ class StandardWorkflowBase(NNWorkflow):
 
     def link_evaluator(self):
         last = self.forwards[-1]
-        if isinstance(last, All2AllSoftmax):
+        if self.evaluator_factory is not None:
+            ev = self.evaluator_factory(self, last)
+        elif isinstance(last, All2AllSoftmax):
             ev = EvaluatorSoftmax(self, name="evaluator")
             ev.link_attrs(last, ("input", "output"), "max_idx")
             ev.link_attrs(self.loader,
