@@ -48,6 +48,39 @@ def test_xla_matches_numpy(numpy_wf):
     assert numpy.isfinite(w).all()
 
 
+@pytest.mark.parametrize("backend", ["numpy", "cpu"])
+def test_zerofiller_pins_weights(backend):
+    """ZeroFiller keeps masked weight entries at zero on BOTH backends
+    (ADVICE r1: the XLA path used to ignore the host-side mask)."""
+    from veles.znicz_tpu.ops.cutter import ZeroFiller
+
+    prng.seed_all(11)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: getattr(root.mnist.loader, k, None)
+             for k in ("minibatch_size", "n_train", "n_valid")}
+    root.mnist.loader.update({"minibatch_size": 20,
+                              "n_train": 100, "n_valid": 40})
+    root.mnist.decision.max_epochs = 2
+    try:
+        wf = mnist.create_workflow(name="ZeroFill_%s" % backend)
+        target = wf.forwards[0]
+        zf = ZeroFiller(wf, target=target, name="zerofiller")
+        # run right after the last GD unit, before looping back
+        zf.link_from(wf.gds[0])
+        wf.initialize(device=backend)
+        mask = numpy.ones_like(target.weights.mem)
+        mask[::2, :] = 0.0
+        zf.mask.map_write()
+        zf.mask.mem[...] = mask
+        wf.run()
+        w = target.weights.map_read().mem
+    finally:
+        root.mnist.loader.update(
+            {k: v for k, v in saved.items() if v is not None})
+    assert numpy.all(w[::2, :] == 0.0), "masked entries drifted"
+    assert numpy.any(w[1::2, :] != 0.0), "unmasked entries all zero?"
+
+
 def test_deterministic_rerun(numpy_wf):
     """Fixed-seed functional determinism (reference contract, §4)."""
     wf2 = build_and_run("numpy")

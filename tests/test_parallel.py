@@ -32,6 +32,25 @@ def test_data_parallel_training_matches_single():
     assert abs(err_dp - err_single) < 0.03, (err_dp, err_single)
 
 
+def test_data_parallel_conv_non_divisible_minibatch():
+    """Scan-mode DP pads the minibatch dim to a multiple of the mesh
+    data axis; conv/pool/GD units must reshape by TRACED batch dims,
+    not the host-initialized Array shapes (ADVICE r1: minibatch 12 on
+    an 8-device mesh pads to 16 and used to fail at trace time)."""
+    from veles.znicz_tpu import parallel
+
+    prng.seed_all(7)
+    from veles.znicz_tpu.models import cifar10
+    root.cifar.loader.update({"minibatch_size": 12,
+                              "n_train": 48, "n_valid": 24})
+    root.cifar.decision.max_epochs = 1
+    wf = cifar10.create_workflow(name="DPConvPad")
+    wf.initialize(device="cpu")
+    parallel.setup_data_parallel(wf, parallel.make_mesh({"data": 8}))
+    wf.run()
+    assert wf.decision.history, "no epochs completed"
+
+
 def test_grad_sync_bytes():
     from veles.znicz_tpu import parallel
     params = {"layer": {

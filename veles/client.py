@@ -14,7 +14,7 @@ import time
 from veles.distributable import DistributionRegistry
 from veles.loader.base import CLASS_TRAIN
 from veles.logger import Logger
-from veles.server import send_frame, recv_frame
+from veles.server import send_frame, recv_frame, require_secret_for
 
 
 class SlaveClient(Logger):
@@ -23,6 +23,7 @@ class SlaveClient(Logger):
         self.workflow = workflow
         host, _, port = str(address).rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
+        require_secret_for(self.address[0], "slave master")
         self.registry = DistributionRegistry(workflow)
         self.slave_id = None
         self.jobs_done = 0

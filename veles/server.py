@@ -20,6 +20,9 @@ minibatch index ranges, GD units ship weights). A dead slave's
 in-flight jobs are re-queued (``drop_slave``, SURVEY.md §5.3).
 """
 
+import hashlib
+import hmac
+import os
 import pickle
 import socket
 import socketserver
@@ -29,10 +32,43 @@ import threading
 from veles.distributable import DistributionRegistry
 from veles.logger import Logger
 
+#: SECURITY: frames are pickled Python objects — deserializing one is
+#: arbitrary code execution, so every frame carries an HMAC-SHA256 tag
+#: keyed on a cluster-shared secret and recv_frame REFUSES to unpickle
+#: anything unauthenticated. The secret comes from
+#: ``$VELES_CLUSTER_SECRET``; without it set, only loopback operation
+#: is allowed (see require_secret_for) — the dev fallback key is
+#: public knowledge and protects against accidents, not attackers.
+_SECRET = None
+
+_LOOPBACK = ("127.0.0.1", "localhost", "::1")
+
+
+def _secret():
+    global _SECRET
+    if _SECRET is None:
+        _SECRET = os.environ.get(
+            "VELES_CLUSTER_SECRET", "veles-znicz-tpu-dev").encode()
+    return _SECRET
+
+
+def require_secret_for(host, role):
+    """Fail closed: refuse non-loopback master/slave endpoints unless
+    an explicit cluster secret is configured."""
+    if host in _LOOPBACK:
+        return
+    if "VELES_CLUSTER_SECRET" not in os.environ:
+        raise RuntimeError(
+            "%s endpoint %r is not loopback and VELES_CLUSTER_SECRET "
+            "is unset: the wire protocol deserializes pickle and the "
+            "default HMAC key is public. Set VELES_CLUSTER_SECRET to "
+            "the same random value on every node." % (role, host))
+
 
 def send_frame(sock, obj):
     blob = pickle.dumps(obj, protocol=4)
-    sock.sendall(struct.pack(">I", len(blob)) + blob)
+    tag = hmac.new(_secret(), blob, hashlib.sha256).digest()
+    sock.sendall(struct.pack(">I", len(blob)) + tag + blob)
 
 
 def recv_frame(sock):
@@ -40,8 +76,18 @@ def recv_frame(sock):
     if header is None:
         return None
     size, = struct.unpack(">I", header)
+    tag = _recv_exact(sock, 32)
+    if tag is None:
+        return None
     blob = _recv_exact(sock, size)
-    return None if blob is None else pickle.loads(blob)
+    if blob is None:
+        return None
+    if not hmac.compare_digest(
+            tag, hmac.new(_secret(), blob, hashlib.sha256).digest()):
+        raise ConnectionError(
+            "frame failed HMAC authentication (cluster secret mismatch "
+            "or untrusted peer) — refusing to deserialize")
+    return pickle.loads(blob)
 
 
 def _recv_exact(sock, n):
@@ -62,6 +108,7 @@ class MasterServer(Logger):
         self.workflow = workflow
         host, _, port = str(address).rpartition(":")
         self.address = (host or "0.0.0.0", int(port))
+        require_secret_for(self.address[0], "master listen")
         self.registry = DistributionRegistry(workflow)
         self.lock = threading.RLock()
         self.slaves = {}

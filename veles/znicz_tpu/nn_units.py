@@ -135,6 +135,14 @@ class Forward(AcceleratedUnit):
     def batch_size(self):
         return self.input.shape[0]
 
+    def host_train_phase(self):
+        """Whether the CURRENT minibatch is a training one, for the
+        numpy oracle path (the compiled path reads ``ctx.train``).
+        Units with train/eval behaviour splits (dropout, stochastic
+        pooling) share this so phase detection has one definition."""
+        loader = getattr(self.workflow, "loader", None)
+        return bool(loader is None or loader.train_phase)
+
     def output_shape_for(self, input_shape):
         """Static shape inference; subclasses override."""
         raise NotImplementedError
@@ -222,7 +230,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
     # hyper-parameters (traced scalars; changing them never retraces) --
 
     def hyperparams(self):
-        return {
+        out = {
             "lr": numpy.float32(self.learning_rate),
             "lr_bias": numpy.float32(self.learning_rate_bias),
             "l2": numpy.float32(self.weights_decay),
@@ -232,6 +240,13 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             "moment": numpy.float32(self.gradient_moment),
             "moment_bias": numpy.float32(self.gradient_moment_bias),
         }
+        # ZeroFiller mask rides along as a traced input (not a baked
+        # constant) so host-side mask edits reach the compiled step
+        mask = getattr(self.forward, "zero_mask", None)
+        if mask is not None and mask:
+            out["zero_mask"] = numpy.asarray(
+                mask.map_read().mem, numpy.float32)
+        return out
 
     # shared update math (xp = numpy or jax.numpy) ---------------------
 
@@ -321,6 +336,11 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         w, vel, acc = self._step_param(
             jnp, w, vel, acc_w, grad_w.astype(w.dtype), apply_now,
             h["lr"], h["moment"], h["l2"], h["l1_vs_l2"])
+        # ZeroFiller mask (traced via hyperparams): pin masked entries
+        # at zero INSIDE the trace — host-side mutation never reaches
+        # device-resident params
+        if "zero_mask" in h:
+            w = w * h["zero_mask"].astype(w.dtype)
         ctx.update_params(f, weights=w)
         ctx.update_state(self, vel_weights=vel)
         if acc is not None:

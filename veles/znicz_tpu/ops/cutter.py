@@ -80,8 +80,10 @@ class GDCutter(GradientDescentBase):
         import jax.numpy as jnp
         f = self.forward
         err = ctx.get(self, "err_output")
-        ishape = f.input.shape
-        err = err.reshape(f.output.shape)
+        # batch dim from the traced error (scan-mode DP pads it past
+        # the host-initialized Array shape)
+        err = err.reshape((-1,) + f.output.shape[1:])
+        ishape = (err.shape[0],) + f.input.shape[1:]
         ei = jnp.zeros(ishape, jnp.float32)
         ei = ei.at[:, f.y:f.y + err.shape[1],
                    f.x:f.x + err.shape[2], :].set(err)
@@ -90,7 +92,15 @@ class GDCutter(GradientDescentBase):
 
 class ZeroFiller(Unit):
     """Pins masked weight entries at zero after every update (reference
-    ``weights_zerofilling.ZeroFiller`` [U]). Wire it after a GD unit."""
+    ``weights_zerofilling.ZeroFiller`` [U]). Wire it after a GD unit.
+
+    On the XLA backend the compiled step keeps parameters
+    device-resident and never re-reads host Arrays, so the mask is
+    registered on the target Forward unit (``zero_mask``), shipped as a
+    traced hyperparameter each dispatch (host-side mask edits stay
+    live), and applied by ``GradientDescentBase.update_weights_xla``
+    inside the trace; ``run`` then only acts on the numpy backend, so
+    each backend applies the mask exactly once per step."""
 
     def __init__(self, workflow, target=None, mask=None, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -103,8 +113,24 @@ class ZeroFiller(Unit):
                 not self.mask:
             self.mask.reset(
                 numpy.ones_like(self.target.weights.mem))
+        if self.target is not None:
+            # traced path: the GD update multiplies by this mask
+            self.target.zero_mask = self.mask
+            # apply once up-front so the initial params respect the mask
+            w = self.target.weights
+            if w:
+                w.map_write()
+                w.mem *= self.mask.map_read().mem
+                # XLAStep may have gathered params to device already
+                # (it initializes before units linked after it) — push
+                # the masked initial weights across
+                step = getattr(self.workflow, "xla_step", None)
+                if step is not None and step.params is not None:
+                    step.refresh_device()
 
     def run(self):
+        if getattr(self.workflow, "xla_step", None) is not None:
+            return  # mask lives inside the compiled update
         w = self.target.weights
         w.map_write()
         w.mem *= self.mask.map_read().mem

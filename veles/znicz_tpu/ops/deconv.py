@@ -127,7 +127,8 @@ class GDDeconv(GradientDescentBase):
         import jax.numpy as jnp
         f = self.forward
         x = ctx.get(f, "input")
-        err = ctx.get(self, "err_output").reshape(f._oshape)
+        err = ctx.get(self, "err_output").reshape(
+            (-1,) + f._oshape[1:])
         w = ctx.unit_params(f)["weights"]
         c = f._oshape[-1]
         cd = ctx._compiler.device.compute_dtype
@@ -226,7 +227,8 @@ class GDDepooling(GradientDescentBase):
 
     def _gather(self, xp, err):
         f = self.forward
-        b, oy, ox, c = f.input.shape
+        _, oy, ox, c = f.input.shape
+        b = err.shape[0]
         sy, sx = f.sliding
         need_h = sy * (oy - 1) + f.ky
         need_w = sx * (ox - 1) + f.kx
@@ -248,6 +250,7 @@ class GDDepooling(GradientDescentBase):
     def xla_run(self, ctx):
         import jax.numpy as jnp
         f = self.forward
-        err = ctx.get(self, "err_output").reshape(f.output.shape)
+        err = ctx.get(self, "err_output").reshape(
+            (-1,) + f.output.shape[1:])
         ctx.set(self, "err_input",
                 self._gather(jnp, err).astype(jnp.float32))

@@ -48,9 +48,7 @@ class DropoutForward(Forward):
     def numpy_run(self):
         x = self.input.map_read().mem.astype(numpy.float32)
         self.output.map_invalidate()
-        train = self.forward_mode and bool(
-            getattr(self.workflow, "loader", None) is None
-            or self.workflow.loader.train_phase)
+        train = self.forward_mode and self.host_train_phase()
         if not train:
             self.output.mem[...] = x
             return

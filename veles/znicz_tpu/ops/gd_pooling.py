@@ -29,12 +29,17 @@ class GDPoolingBase(GradientDescentBase):
         return oshape, need_h, need_w
 
     def _scatter(self, xp, err_patches):
-        """(B,oy,ox,kk,C) window errors -> input-shaped tensor."""
+        """(B,oy,ox,kk,C) window errors -> input-shaped tensor.
+
+        Batch dim comes from the traced tensor, not the host-initialized
+        Array shape: under scan-mode DP the minibatch is padded to a
+        multiple of the mesh data axis, so ``f.input.shape[0]`` may lie.
+        """
         f = self.forward
         ishape = f.input.shape
         oshape, need_h, need_w = self._window_geometry()
-        padded_shape = (ishape[0], need_h, need_w, ishape[3])
         b, oy, ox, kk, c = err_patches.shape
+        padded_shape = (b, need_h, need_w, ishape[3])
         full = CM.col2im(xp, err_patches.reshape(b, oy, ox, kk * c),
                          padded_shape, f.ky, f.kx, f.sliding,
                          (0, 0, 0, 0))
@@ -51,7 +56,8 @@ class GDPoolingBase(GradientDescentBase):
     def xla_run(self, ctx):
         import jax.numpy as jnp
         f = self.forward
-        err = ctx.get(self, "err_output").reshape(f.output.shape)
+        err = ctx.get(self, "err_output").reshape(
+            (-1,) + f.output.shape[1:])
         ctx.set(self, "err_input",
                 self._route(jnp, err, ctx).astype(jnp.float32))
 
@@ -95,7 +101,7 @@ class GDStochasticPooling(GDMaxPoolingBase):
 class GDAvgPooling(GDPoolingBase):
     def _route(self, xp, err, ctx):
         f = self.forward
-        ishape = f.input.shape
+        ishape = (err.shape[0],) + f.input.shape[1:]
         kk = f.ky * f.kx
         # per-window true size (edge windows are partial)
         if ctx is None:

@@ -150,7 +150,9 @@ class StochasticPooling(PoolingBase):
     def _run_generic(self, xp, x, ctx):
         patches = self._padded_patches(xp, x, 0.0)
         probs = self._probs(xp, patches)
-        train = ctx.train if ctx is not None else True
+        # eval minibatches use the probability-weighted average, not a
+        # stochastic sample
+        train = ctx.train if ctx is not None else self.host_train_phase()
         if train:
             cum = xp.cumsum(probs, axis=3)
             if ctx is None:
