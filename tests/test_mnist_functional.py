@@ -81,6 +81,61 @@ def test_zerofiller_pins_weights(backend):
     assert numpy.any(w[1::2, :] != 0.0), "unmasked entries all zero?"
 
 
+def test_chunked_dispatch_matches_per_epoch():
+    """Multi-epoch dispatch (several epochs fused into one XLA program,
+    one metric fetch per chunk) must be semantically identical to
+    per-epoch dispatch: same shuffles, same PRNG keys, same history."""
+    def run(chunk):
+        prng.seed_all(321)
+        from veles.znicz_tpu.models import mnist
+        saved = {k: getattr(root.mnist.loader, k, None)
+                 for k in ("minibatch_size", "n_train", "n_valid")}
+        root.mnist.loader.update({"minibatch_size": 25,
+                                  "n_train": 200, "n_valid": 50})
+        root.mnist.decision.max_epochs = 4
+        try:
+            wf = mnist.create_workflow(name="Chunk%s" % chunk)
+            wf.initialize(device="cpu")
+            wf.xla_step.epochs_per_dispatch = chunk
+            wf.run()
+        finally:
+            root.mnist.loader.update(
+                {k: v for k, v in saved.items() if v is not None})
+        return wf.decision.history
+
+    h1 = run(1)
+    h4 = run(4)
+    assert len(h1) == len(h4) == 4
+    for a, b in zip(h1, h4):
+        assert a["validation"]["metric"] == b["validation"]["metric"], \
+            (a, b)
+        assert abs(a["train"]["loss"] - b["train"]["loss"]) < 1e-5
+
+
+def test_forced_chunk_clipped_by_stop_criteria():
+    """A forced epochs_per_dispatch must still respect max_epochs:
+    params may never advance past the decision's stop point."""
+    prng.seed_all(77)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: getattr(root.mnist.loader, k, None)
+             for k in ("minibatch_size", "n_train", "n_valid")}
+    root.mnist.loader.update({"minibatch_size": 25,
+                              "n_train": 100, "n_valid": 25})
+    root.mnist.decision.max_epochs = 3
+    try:
+        wf = mnist.create_workflow(name="ChunkClip")
+        wf.initialize(device="cpu")
+        wf.xla_step.epochs_per_dispatch = 8   # > max_epochs
+        wf.run()
+    finally:
+        root.mnist.loader.update(
+            {k: v for k, v in saved.items() if v is not None})
+    assert len(wf.decision.history) == 3
+    # the loader never started an epoch past the stop point, so no
+    # trained-past-the-end params exist
+    assert wf.loader.epoch_number <= 3
+
+
 def test_deterministic_rerun(numpy_wf):
     """Fixed-seed functional determinism (reference contract, §4)."""
     wf2 = build_and_run("numpy")

@@ -318,17 +318,25 @@ class StepCompiler:
     # are gathered by index on device) -------------------------------
 
     def build_epoch_scan(self, batch_spec, segments):
-        """Return ``epoch(params, state, full, idxs, valids, hyper,
-        key0) -> (params, state, {seg: stacked_outputs})``.
+        """Return ``chunk(params, state, full, idxs, valids, hyper,
+        key0, offsets) -> (params, state, {seg: stacked_outputs})``.
 
         ``segments``: list of ``(seg_key, train_flag, units)`` — one
-        per loader class served this epoch, in serving order. ``full``:
+        per loader class served each epoch, in serving order. ``full``:
         dict name -> whole-dataset device array; ``idxs[seg_key]``:
-        (n_mb, mb) int32 row indices; ``valids[seg_key]``: (n_mb,) true
-        row counts. Each segment is a ``lax.scan`` whose iterations
-        gather their minibatch from ``full`` on device and run the
-        fused step body — an entire epoch becomes one XLA program with
-        a single host round-trip for its metrics.
+        (E, n_mb, mb) int32 row indices for E consecutive epochs;
+        ``valids[seg_key]``: (n_mb,) true row counts (identical across
+        epochs — class sizes don't change); ``offsets``: (E,) int32
+        step index at each epoch's start (seeds the per-step PRNG keys
+        exactly as E separate dispatches would).
+
+        Structure: an outer ``lax.scan`` over epochs, an inner
+        ``lax.scan`` per class segment whose iterations gather their
+        minibatch from ``full`` on device and run the fused step body.
+        E epochs become ONE XLA program with a single host round-trip
+        for their metrics — the round-trip (~100ms on a remote-tunnel
+        TPU) is the dominant per-dispatch cost, so chunking it across
+        epochs is the main throughput lever after fusion itself.
         """
         import jax
         import jax.numpy as jnp
@@ -336,38 +344,47 @@ class StepCompiler:
         segments = [(k, t, list(us)) for k, t, us in segments]
         spec = dict(batch_spec)
 
-        def epoch_fn(params, state, full, idxs, valids, hyper, key0):
-            outs_all = {}
-            for seg_i, (seg_key, train, units) in enumerate(segments):
-                seg_base_key = jax.random.fold_in(key0, seg_i)
+        def chunk_fn(params, state, full, idxs, valids, hyper, key0,
+                     offsets):
+            def epoch_body(carry, xs):
+                params, state = carry
+                offset, idx_epoch = xs
+                epoch_key = jax.random.fold_in(key0, offset)
+                outs_all = {}
+                for seg_i, (seg_key, train, units) in enumerate(segments):
+                    seg_base_key = jax.random.fold_in(epoch_key, seg_i)
 
-                def body(carry, xs, _units=units, _train=train,
-                         _key=seg_base_key):
-                    params, state = carry
-                    i, idx, valid = xs
+                    def body(carry, xs, _units=units, _train=train,
+                             _key=seg_base_key):
+                        params, state = carry
+                        i, idx, valid = xs
 
-                    def bind(ctx):
-                        for name, (unit, attr) in spec.items():
-                            if name == "batch_size":
-                                ctx.set(unit, attr, valid)
-                            else:
-                                ctx.set(unit, attr, full[name][idx])
-                    ctx = self.trace_step(
-                        params, state, hyper,
-                        jax.random.fold_in(_key, i), _train, _units,
-                        bind)
-                    return (ctx.params, ctx.state), ctx.outputs
+                        def bind(ctx):
+                            for name, (unit, attr) in spec.items():
+                                if name == "batch_size":
+                                    ctx.set(unit, attr, valid)
+                                else:
+                                    ctx.set(unit, attr, full[name][idx])
+                        ctx = self.trace_step(
+                            params, state, hyper,
+                            jax.random.fold_in(_key, i), _train, _units,
+                            bind)
+                        return (ctx.params, ctx.state), ctx.outputs
 
-                idx_mat = idxs[seg_key]
-                n_mb = idx_mat.shape[0]
-                (params, state), outs = jax.lax.scan(
-                    body, (params, state),
-                    (jnp.arange(n_mb), idx_mat, valids[seg_key]))
-                outs_all[seg_key] = outs
+                    idx_mat = idx_epoch[seg_key]
+                    n_mb = idx_mat.shape[0]
+                    (params, state), outs = jax.lax.scan(
+                        body, (params, state),
+                        (jnp.arange(n_mb), idx_mat, valids[seg_key]))
+                    outs_all[seg_key] = outs
+                return (params, state), outs_all
+
+            (params, state), outs_all = jax.lax.scan(
+                epoch_body, (params, state), (offsets, idxs))
             return params, state, outs_all
 
         donate = (0, 1) if self.donate else ()
-        return jax.jit(epoch_fn, donate_argnums=donate)
+        return jax.jit(chunk_fn, donate_argnums=donate)
 
     def compile_epoch_scan(self, batch_spec, segments):
         key = ("epoch",

@@ -124,14 +124,38 @@ class Loader(Unit, IDistributable):
             idx = idx[self.prng.permutation(len(idx))]
         return idx
 
+    def _generate_order(self):
+        return [(cls, self._class_indices(cls))
+                for cls in (CLASS_TEST, CLASS_VALID, CLASS_TRAIN)
+                if self.class_lengths[cls] > 0]
+
     def _start_epoch(self, first=False):
-        if not first:
+        if first:
+            # fresh run / resume: any pre-generated orders are stale
+            self._future_orders = []
+        else:
             self.epoch_number += 1
-        self._order = [(cls, self._class_indices(cls))
-                       for cls in (CLASS_TEST, CLASS_VALID, CLASS_TRAIN)
-                       if self.class_lengths[cls] > 0]
+        future = getattr(self, "_future_orders", None)
+        if not first and future:
+            # consume the order peek_epoch_orders pre-generated (the
+            # multi-epoch dispatch path); the PRNG already advanced
+            self._order = future.pop(0)
+        else:
+            self._order = self._generate_order()
         self._cls_pos = 0
         self._idx_pos = 0
+
+    def peek_epoch_orders(self, n):
+        """Orders for the current epoch and the next ``n-1``, cached so
+        subsequent ``_start_epoch`` calls serve EXACTLY these (shuffles
+        come from the same PRNG stream in the same sequence — a chunked
+        run is bit-identical to an unchunked one). Enables XLAStep to
+        compile several epochs into one device program."""
+        if not hasattr(self, "_future_orders"):
+            self._future_orders = []
+        while len(self._future_orders) < n - 1:
+            self._future_orders.append(self._generate_order())
+        return [self._order] + self._future_orders[:n - 1]
 
     # -- serving -------------------------------------------------------
 
@@ -157,11 +181,12 @@ class Loader(Unit, IDistributable):
         if not self.device_gather:
             self.fill_minibatch()
 
-    def class_schedule(self, cls):
+    def class_schedule(self, cls, order=None):
         """(idx_mat (n_mb, mb) int32, valids (n_mb,) int32) — the full
-        minibatch schedule of ``cls`` for the CURRENT epoch order (the
-        class-scan fast path consumes a whole class in one dispatch)."""
-        for c, indices in self._order:
+        minibatch schedule of ``cls`` for the given epoch order (default:
+        the CURRENT epoch; the class-scan fast path consumes a whole
+        class in one dispatch)."""
+        for c, indices in (self._order if order is None else order):
             if c != cls:
                 continue
             mb = self.max_minibatch_size

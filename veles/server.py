@@ -71,11 +71,21 @@ def send_frame(sock, obj):
     sock.sendall(struct.pack(">I", len(blob)) + tag + blob)
 
 
+#: The length header arrives BEFORE authentication, so it must not be
+#: able to command huge allocations: cap it well above any real payload
+#: (largest frames ship full model weights) but far below OOM territory.
+MAX_FRAME_BYTES = 1 << 30
+
+
 def recv_frame(sock):
     header = _recv_exact(sock, 4)
     if header is None:
         return None
     size, = struct.unpack(">I", header)
+    if size > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            "frame header claims %d bytes (cap %d) — dropping peer"
+            % (size, MAX_FRAME_BYTES))
     tag = _recv_exact(sock, 32)
     if tag is None:
         return None
@@ -91,13 +101,13 @@ def recv_frame(sock):
 
 
 def _recv_exact(sock, n):
-    buf = b""
+    buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
             return None
         buf += chunk
-    return buf
+    return bytes(buf)
 
 
 class MasterServer(Logger):

@@ -17,6 +17,8 @@ sharding, so data parallelism falls out of XLA auto-partitioning with
 collectives over ICI.
 """
 
+import time
+
 import numpy
 
 from veles.accelerated_units import StepCompiler
@@ -89,6 +91,25 @@ class XLAStep(Unit):
         self._dispatched_epoch = None
         self._epoch_outs = {}
         self._epoch_pos = {}
+        self._chunk_epoch0 = 0
+        self._chunk_len = 0
+        self._serving_epoch = None
+        #: epochs fused into one dispatch: None = auto (adaptive: as
+        #: many as fit in ``target_dispatch_seconds`` of device time,
+        #: and never more than the decision's stop criteria provably
+        #: allow); an int forces that chunk size
+        self.epochs_per_dispatch = None
+        #: auto-mode upper bound — bounds the stacked metrics buffer
+        #: and the recompile count (each distinct chunk length is a
+        #: separate XLA program)
+        self.max_epochs_per_dispatch = 64
+        #: auto mode sizes chunks to roughly this much wall time per
+        #: dispatch: long enough to amortize the per-dispatch host
+        #: round-trip (~100ms on a remote-tunnel TPU), short enough to
+        #: keep metrics/plots reasonably live
+        self.target_dispatch_seconds = 2.0
+        self._last_epoch_seconds = None
+        self._seen_chunk_lengths = set()
         self._pre_epoch_params = None
         self._pre_epoch_state = None
         self._pre_epoch_step_index = 0
@@ -137,32 +158,87 @@ class XLAStep(Unit):
 
     def _run_scan_mode(self):
         loader = self.loader
-        if self._dispatched_epoch != loader.epoch_number:
+        if self._dispatched_epoch is None or \
+                loader.epoch_number >= self._chunk_epoch0 + self._chunk_len:
             self._dispatch_epoch()
+        if loader.epoch_number != self._serving_epoch:
+            self._serving_epoch = loader.epoch_number
+            self._epoch_pos = {cls: 0 for cls in self._epoch_outs}
+        e = loader.epoch_number - self._chunk_epoch0
         cls = loader.minibatch_class
         pos = self._epoch_pos[cls]
         self._publish_metrics(
-            {k: v[pos] for k, v in self._epoch_outs[cls].items()})
+            {k: v[e][pos] for k, v in self._epoch_outs[cls].items()})
         self._epoch_pos[cls] = pos + 1
 
+    def _epochs_per_dispatch(self):
+        """How many epochs may be fused into the next dispatch WITHOUT
+        changing semantics: never past a point where the decision could
+        stop (max_epochs bound, or patience running out — improvement
+        inside the chunk only ever extends patience), and only 1 when
+        epoch-entry snapshots are kept (their params copy is per-chunk).
+        """
+        if self._keep_epoch_entry:
+            return 1
+        decision = getattr(self.workflow, "decision", None)
+        if self.epochs_per_dispatch is not None:
+            chunk = max(1, int(self.epochs_per_dispatch))
+        elif decision is None:
+            return 1
+        else:
+            if self._last_epoch_seconds is None:
+                # no timing yet (first dispatch also pays compilation):
+                # measure one epoch before scaling up
+                chunk = 1
+            else:
+                chunk = int(self.target_dispatch_seconds
+                            / max(self._last_epoch_seconds, 1e-4))
+            chunk = min(max(chunk, 1), self.max_epochs_per_dispatch)
+            # quantize to a power of two: each distinct chunk length is
+            # a separate compiled program, so bound the ramp to
+            # ~log2(cap) compiles (the decision bounds below may still
+            # cut an exact tail chunk — one more compile at the very
+            # end of training)
+            chunk = 1 << (chunk.bit_length() - 1)
+        # stop-criterion bounds apply to FORCED chunk sizes too: a
+        # dispatch must never run past a point where the decision could
+        # stop, or final params would drift from decision.history
+        if decision is not None:
+            if decision.max_epochs is not None:
+                chunk = min(chunk,
+                            decision.max_epochs - decision.epoch_number)
+            if decision.fail_iterations is not None:
+                chunk = min(chunk, decision.fail_iterations
+                            - decision._epochs_since_best)
+        return max(1, chunk)
+
     def _dispatch_epoch(self):
-        """Run the WHOLE epoch (every class segment, serving order) as
-        one compiled program; fetch all stacked metrics in one host
-        round-trip."""
+        """Run a CHUNK of whole epochs (every class segment, serving
+        order) as one compiled program; fetch all stacked metrics in
+        one host round-trip."""
         import jax
         loader = self.loader
+        n_epochs = self._epochs_per_dispatch()
+        orders = loader.peek_epoch_orders(n_epochs)
+        n_epochs = len(orders)
         full = loader.device_full_arrays(
             None if self.batch_sharding is None
             else self.param_sharding)  # replicate dataset on the mesh
         classes = [cls for cls, _ in loader._order]
         segments, idxs, valids = [], {}, {}
+        serves_per_epoch = 0
         for cls in classes:
             train = cls == CLASS_TRAIN
             seg_key = "c%d" % cls
             segments.append((
                 seg_key, train,
                 self.train_units if train else self.eval_units))
-            idx_mat, vl = loader.class_schedule(cls)
+            mats = []
+            for order in orders:
+                idx_mat, vl = loader.class_schedule(cls, order)
+                mats.append(idx_mat)
+            idx_stack = numpy.stack(mats)        # (E, n_mb, mb)
+            serves_per_epoch += idx_stack.shape[1]
             if self.batch_sharding is not None:
                 # shard the within-minibatch (batch) dim over the data
                 # axis: on-device gathers execute shard-local and DP
@@ -172,22 +248,26 @@ class XLAStep(Unit):
                 mesh = self.batch_sharding.mesh
                 axis = self.batch_sharding.spec[0]
                 n_dev = mesh.shape[axis]
-                mb = idx_mat.shape[1]
+                mb = idx_stack.shape[2]
                 mb_pad = roundup(mb, n_dev)
                 if mb_pad != mb:
                     # pad rows repeat the last index; `valids` masking
                     # already zeroes their loss/gradient contribution
-                    pad = numpy.repeat(idx_mat[:, -1:],
-                                       mb_pad - mb, axis=1)
-                    idx_mat = numpy.concatenate([idx_mat, pad], axis=1)
-                idx_mat = jax.device_put(idx_mat, NamedSharding(
-                    mesh, PartitionSpec(None, axis)))
+                    pad = numpy.repeat(idx_stack[:, :, -1:],
+                                       mb_pad - mb, axis=2)
+                    idx_stack = numpy.concatenate([idx_stack, pad],
+                                                  axis=2)
+                idx_stack = jax.device_put(idx_stack, NamedSharding(
+                    mesh, PartitionSpec(None, None, axis)))
                 vl = jax.device_put(vl, NamedSharding(
                     mesh, PartitionSpec()))
-            idxs[seg_key] = idx_mat
+            idxs[seg_key] = idx_stack
             valids[seg_key] = vl
         fn = self.compiler.compile_epoch_scan(self._batch_spec, segments)
-        key = jax.random.fold_in(self.base_key, self.step_index)
+        offsets = numpy.int32(
+            self.step_index
+            + serves_per_epoch * numpy.arange(n_epochs, dtype=numpy.int64))
+        key = self.base_key
         # Stash a CONSISTENT epoch-entry view (params + optimizer state
         # + step counter — the point the epoch's validation metric
         # describes, since valid is served before train): improved-
@@ -200,14 +280,25 @@ class XLAStep(Unit):
             self._pre_epoch_params = copy(self.params)
             self._pre_epoch_state = copy(self.state)
             self._pre_epoch_step_index = self.step_index
-        self.step_index += sum(idxs[k].shape[0] for k in idxs)
+        self.step_index += serves_per_epoch * n_epochs
+        t0 = time.perf_counter()
         self.params, self.state, outs = fn(
             self.params, self.state, full, idxs, valids,
-            self._gather_hyper(), key)
+            self._gather_hyper(), key, offsets)
         host_outs = _fetch_tree(outs)
+        dt = time.perf_counter() - t0
+        if n_epochs in self._seen_chunk_lengths:
+            # a clean (compile-free) run of this program: usable for
+            # sizing the next chunk
+            self._last_epoch_seconds = dt / n_epochs
+        else:
+            self._seen_chunk_lengths.add(n_epochs)
         self._epoch_outs = {cls: host_outs["c%d" % cls]
                             for cls in classes}
         self._epoch_pos = {cls: 0 for cls in classes}
+        self._serving_epoch = loader.epoch_number
+        self._chunk_epoch0 = loader.epoch_number
+        self._chunk_len = n_epochs
         self._dispatched_epoch = loader.epoch_number
 
     def _run_per_step(self):
