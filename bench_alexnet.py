@@ -1,0 +1,52 @@
+"""AlexNet throughput benchmark (BASELINE.md tracked metric #1).
+
+Full AlexNet (227×227×3, one tower, 16-class head on the synthetic
+corpus — the classifier width changes <2% of the FLOPs) trained through
+the streaming pipeline: host decode/augment in threads, uint8 windows
+shipped to the device, whole fwd+bwd+update scan per window. Timing is
+epoch-aligned and includes every stage; the first epoch (compilation)
+is excluded.
+
+With a real ImageNet tree under ``root.imagenet.loader.base_dir`` the
+same benchmark measures real-JPEG decode throughput; the synthetic
+corpus (noise + prototype generation, roughly JPEG-decode-priced)
+stands in when no data exists (zero-egress environment) and is labelled
+by the caller as such.
+"""
+
+import time
+
+
+def alexnet_images_per_sec(measure_epochs=1):
+    import veles.prng as prng
+    prng.seed_all(99)
+    from veles.config import root
+    from veles.loader.base import CLASS_TRAIN
+    from veles.znicz_tpu.models import imagenet
+    from bench import _run_one_chunk
+
+    root.imagenet.loader.update({
+        "minibatch_size": 128, "n_train": 1536, "n_valid": 256,
+        "n_classes": 16})
+    root.imagenet.decision.max_epochs = 1024
+    wf = imagenet.create_workflow(name="BenchAlexNet")
+    wf.initialize(device="xla")
+    loader, step = wf.loader, wf.xla_step
+
+    def count(ld):
+        return int(ld.minibatch_size) \
+            if ld.minibatch_class == CLASS_TRAIN else 0
+
+    import jax
+    _run_one_chunk(loader, step, count)     # epoch 1: compile + run
+    t0 = time.perf_counter()
+    images = 0
+    for _ in range(measure_epochs):
+        images += _run_one_chunk(loader, step, count)
+    jax.block_until_ready(step.params)
+    return images / (time.perf_counter() - t0)
+
+
+if __name__ == "__main__":
+    print('{"metric": "alexnet_synth_images_per_sec", "value": %.1f}'
+          % alexnet_images_per_sec())

@@ -1,0 +1,151 @@
+"""Image-loader family + AlexNet sample tests (SURVEY.md §2.3 "Image
+loaders", §2.8 ImageNet row): directory ingestion, label-from-path,
+augmentation geometry, and the flagship conv stack training end-to-end
+through the streaming pipeline."""
+
+import os
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    """3 class dirs × 12 PNGs of distinct solid colors."""
+    from PIL import Image
+    base = tmp_path_factory.mktemp("imgs")
+    colors = {"apple": (200, 30, 30), "pear": (30, 200, 30),
+              "plum": (30, 30, 200)}
+    gen = numpy.random.Generator(numpy.random.PCG64(7))
+    for cls, color in colors.items():
+        d = base / cls
+        d.mkdir()
+        for i in range(12):
+            arr = numpy.clip(
+                numpy.asarray(color)[None, None]
+                + gen.normal(0, 12, (40, 48, 3)), 0, 255
+            ).astype(numpy.uint8)
+            Image.fromarray(arr).save(d / ("img%02d.png" % i))
+    return str(base)
+
+
+def _make_loader(image_tree, **kw):
+    from veles.loader.image import AutoLabelFileImageLoader
+    from veles.workflow import Workflow
+
+    prng.seed_all(5)
+    wf = Workflow(None, name="ImgWF")
+    kw.setdefault("scale", (32, 32))
+    kw.setdefault("crop", (28, 28))
+    kw.setdefault("mirror", "random")
+    kw.setdefault("minibatch_size", 8)
+    ld = AutoLabelFileImageLoader(wf, base_dir=image_tree,
+                                  name="loader", **kw)
+    ld.initialize()
+    return ld
+
+
+def test_auto_label_split_and_classes(image_tree):
+    ld = _make_loader(image_tree)
+    # 36 images, valid_ratio 0.1 → stride 10: ceil split per class dir
+    assert sum(ld.class_lengths) == 36
+    assert ld.class_lengths[1] > 0 and ld.class_lengths[2] > 0
+    assert ld.n_classes == 3
+    labels = {ld.label_of(i) for i in range(sum(ld.class_lengths))}
+    assert labels == {0, 1, 2}
+
+
+def test_decode_augment_shapes(image_tree):
+    ld = _make_loader(image_tree)
+    out = ld.materialize_samples(numpy.arange(5))
+    assert out["data"].shape == (5, 28, 28, 3)
+    assert out["data"].dtype == numpy.uint8
+    assert out["labels"].shape == (5,)
+
+
+def test_eval_crop_deterministic(image_tree):
+    """Eval phase: center crop, no mirror — bitwise repeatable."""
+    ld = _make_loader(image_tree)
+    ld.train_phase << False
+    a = ld.materialize_samples(numpy.arange(4))["data"]
+    b = ld.materialize_samples(numpy.arange(4))["data"]
+    assert numpy.array_equal(a, b)
+
+
+def test_label_colors_learnable(image_tree):
+    """The solid-color classes must be learnable through the full
+    streaming pipeline (decode → augment → ship → conv stack)."""
+    from veles.znicz_tpu.standard_workflow import StandardWorkflow
+
+    prng.seed_all(11)
+    from veles.loader.image import AutoLabelFileImageLoader
+    layers = [
+        {"type": "conv_relu",
+         "->": {"n_kernels": 8, "kx": 5, "ky": 5, "sliding": 2},
+         "<-": {"learning_rate": 0.02, "gradient_moment": 0.5}},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "softmax", "->": {"output_sample_shape": 3},
+         "<-": {"learning_rate": 0.02, "gradient_moment": 0.5}},
+    ]
+    wf = StandardWorkflow(
+        None, name="ImgTrain", layers=layers,
+        loader_factory=lambda w: AutoLabelFileImageLoader(
+            w, base_dir=image_tree, name="loader", scale=(32, 32),
+            crop=(28, 28), mirror="random", minibatch_size=8),
+        decision_config={"max_epochs": 6, "fail_iterations": 50})
+    wf.initialize(device="cpu")
+    assert wf.xla_step.stream_mode
+    wf.run()
+    hist = [h["validation"]["metric"] for h in wf.decision.history]
+    assert hist[-1] < 0.5, hist   # 3 classes, random = 0.67
+
+
+def test_alexnet_sample_trains_scaled_down():
+    """The AlexNet sample (full layer stack, reduced geometry) trains
+    through the synthetic streaming loader on both backends' XLA path."""
+    from veles.znicz_tpu.models import imagenet
+
+    prng.seed_all(13)
+    saved = imagenet.root.imagenet.loader.to_dict()
+    root.imagenet.loader.update({
+        "minibatch_size": 8, "n_train": 48, "n_valid": 16,
+        "n_classes": 4, "scale": (75, 75), "crop": (67, 67)})
+    root.imagenet.decision.max_epochs = 3
+    try:
+        wf = imagenet.create_workflow(name="AlexTiny")
+        wf.initialize(device="cpu")
+        assert wf.xla_step.stream_mode
+        wf.run()
+    finally:
+        root.imagenet.loader.update(saved)
+    assert len(wf.decision.history) == 3
+    # dropout/LRN/pool geometry all exercised; training must not blow up
+    losses = [h["train"]["loss"] for h in wf.decision.history]
+    assert numpy.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 1.5
+
+
+def test_file_image_loader_explicit_labels(image_tree):
+    from veles.loader.image import FileImageLoader
+    from veles.workflow import Workflow
+
+    prng.seed_all(3)
+    wf = Workflow(None, name="FileWF")
+    paths = []
+    for cls in sorted(os.listdir(image_tree)):
+        d = os.path.join(image_tree, cls)
+        paths += [os.path.join(d, f) for f in sorted(os.listdir(d))[:3]]
+    ld = FileImageLoader(
+        wf, name="loader", train_paths=paths[3:],
+        valid_paths=paths[:3],
+        train_labels=list(range(len(paths) - 3)),
+        valid_labels=[0, 1, 2],
+        scale=(16, 16), minibatch_size=4)
+    ld.initialize()
+    assert ld.class_lengths == [0, 3, len(paths) - 3]
+    out = ld.materialize_samples(numpy.asarray([0, 1, 2]))
+    assert list(out["labels"]) == [0, 1, 2]
+    assert out["data"].shape == (3, 16, 16, 3)

@@ -397,6 +397,62 @@ class StepCompiler:
                 batch_spec, segments)
         return self._compiled[key]
 
+    # window-scan compilation (the STREAMING fast path: the dataset
+    # does not fit on device, so stacked windows of minibatches are
+    # shipped up and consumed by one scan program each — one dispatch
+    # and one metric fetch per window instead of per minibatch) -------
+
+    def build_window_scan(self, batch_spec, train, units, transform):
+        """Return ``window(params, state, stacked, valids, hyper, key0)
+        -> (params, state, stacked_outputs)``.
+
+        ``stacked``: dict name -> (B, mb, ...) host-built minibatch
+        stack; ``valids``: (B,) true row counts; ``transform``: the
+        loader's ``xla_batch_transform`` (device-side uint8→float
+        normalization etc.), applied per minibatch inside the scan.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        units = list(units)
+        spec = dict(batch_spec)
+
+        def window_fn(params, state, stacked, valids, hyper, key0):
+            def body(carry, xs):
+                params, state = carry
+                i, batch, valid = xs
+
+                def bind(ctx):
+                    for name, (unit, attr) in spec.items():
+                        if name == "batch_size":
+                            ctx.set(unit, attr, valid)
+                        elif name in batch:
+                            ctx.set(unit, attr,
+                                    transform(name, batch[name]))
+                ctx = self.trace_step(
+                    params, state, hyper, jax.random.fold_in(key0, i),
+                    train, units, bind)
+                return (ctx.params, ctx.state), ctx.outputs
+
+            n_mb = valids.shape[0]
+            (params, state), outs = jax.lax.scan(
+                body, (params, state),
+                (jnp.arange(n_mb), stacked, valids))
+            return params, state, outs
+
+        donate = (0, 1) if self.donate else ()
+        return jax.jit(window_fn, donate_argnums=donate)
+
+    def compile_window_scan(self, batch_spec, train, units, transform):
+        key = ("window",
+               tuple(sorted((name, unit.name, attr)
+                            for name, (unit, attr) in batch_spec.items())),
+               train, tuple(u.name for u in units))
+        if key not in self._compiled:
+            self._compiled[key] = self.build_window_scan(
+                batch_spec, train, units, transform)
+        return self._compiled[key]
+
 
 class AcceleratedWorkflow(Workflow):
     """Workflow owning a Device (reference ``AcceleratedWorkflow`` [U])."""

@@ -34,6 +34,11 @@ class Loader(Unit, IDistributable):
     #: minibatches can be gathered by index on device (enables the
     #: class-scan fast path in XLAStep)
     supports_device_gather = False
+    #: True when the loader can materialize minibatch windows on demand
+    #: (host decode/augment) for the streaming fast path in XLAStep —
+    #: the dataset does NOT need to fit on device; data is shipped in
+    #: stacked windows with the metrics fetched once per window
+    supports_streaming = False
 
     def __init__(self, workflow, minibatch_size=100, shuffle=True,
                  prng_key="loader", **kwargs):
@@ -199,6 +204,27 @@ class Loader(Unit, IDistributable):
                 valids[i] = len(chunk)
             return idx_mat, valids
         raise ValueError("class %d not in this epoch's order" % cls)
+
+    # -- streaming fast-path hooks (see XLAStep._dispatch_stream_epoch) --
+
+    def epoch_plan(self):
+        """[(cls, idx_mat, valids), ...] for the CURRENT epoch in
+        serving order, without advancing serving state."""
+        return [(cls, *self.class_schedule(cls))
+                for cls, _ in self._order]
+
+    def materialize_window(self, cls, idx_mat):
+        """dict name -> (B, mb, ...) host arrays for the given rows of
+        minibatch indices (B minibatches). Streaming loaders override
+        to decode/augment; the base gathers nothing."""
+        raise NotImplementedError(
+            "%s does not support streaming" % self.name)
+
+    def xla_batch_transform(self, name, tensor):
+        """Traced per-minibatch transform applied on DEVICE to streamed
+        batch tensors (e.g. uint8 -> normalized float, so the host→
+        device link carries bytes, not floats). Default: identity."""
+        return tensor
 
     def run(self):
         self.epoch_ended << False

@@ -1,0 +1,263 @@
+"""Image loaders — directory/file ingestion with augmentation.
+
+Re-design of ``veles/loader/image.py`` / ``file_image.py`` [U]
+(SURVEY.md §2.3 "Image loaders"): scale to a target size, random crop +
+horizontal mirror for training (center crop, no mirror for eval),
+grayscale/RGB color conversion, label-from-path. Decoding runs in the
+loader's thread pool (streaming windows overlap the device compute —
+see ``veles/loader/stream.py``); images travel to the device as uint8
+and are normalized there (``xla_batch_transform``), so the host→device
+link carries a quarter of the float bytes.
+"""
+
+import os
+
+import numpy
+
+from veles.loader.stream import StreamLoader
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".gif")
+
+
+class ImageLoaderBase(StreamLoader):
+    """Streams decoded+augmented images.
+
+    Parameters (reference knobs [U]):
+
+    * ``scale`` — (h, w) to resize decoded images to (before crop).
+    * ``crop`` — (h, w) window cut from the scaled image: random
+      position for train minibatches, centered for eval.
+    * ``mirror`` — ``"random"`` flips train images with p=0.5 (eval
+      never flips); ``False`` disables.
+    * ``color_space`` — "RGB" or "GRAY".
+    * ``normalize_mean``/``normalize_std`` — device-side f32
+      normalization of the uint8 pixels ((x - mean) / std after
+      scaling to [0, 1]).
+    """
+
+    def __init__(self, workflow, scale=None, crop=None, mirror=False,
+                 color_space="RGB", normalize_mean=0.5,
+                 normalize_std=0.5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.scale = tuple(scale) if scale else None
+        self.crop = tuple(crop) if crop else None
+        if mirror not in (False, "random"):
+            raise ValueError("mirror must be False or 'random'")
+        self.mirror = mirror
+        self.color_space = color_space
+        self.normalize_mean = float(normalize_mean)
+        self.normalize_std = float(normalize_std)
+        # augmentation draws are STATELESS per (seed, sample, epoch):
+        # decode runs in pool threads, where a shared stateful
+        # generator would race; pure derivation keeps fixed-seed
+        # reproducibility regardless of thread scheduling, and must
+        # not perturb the shuffle stream
+        from veles import prng
+        self.aug_seed = prng.get(
+            kwargs.get("aug_prng_key", "image_augment")).state_seed
+
+    # -- subclass surface ---------------------------------------------
+
+    def decode_image(self, index):
+        """uint8 HWC array for GLOBAL sample index (pre-augmentation)."""
+        raise NotImplementedError
+
+    def label_of(self, index):
+        raise NotImplementedError
+
+    # -- geometry ------------------------------------------------------
+
+    @property
+    def channels(self):
+        return 1 if self.color_space == "GRAY" else 3
+
+    def sample_shape(self):
+        if self.crop:
+            return self.crop + (self.channels,)
+        if self.scale:
+            return self.scale + (self.channels,)
+        raise ValueError(
+            "%s needs scale= or crop= for a static sample shape"
+            % self.name)
+
+    def sample_spec(self):
+        return {"data": (self.sample_shape(), numpy.uint8),
+                "labels": ((), numpy.int32)}
+
+    # -- decode + augment ---------------------------------------------
+
+    def _to_color(self, img):
+        from PIL import Image
+        if self.color_space == "GRAY":
+            return img.convert("L")
+        return img.convert("RGB")
+
+    def _decode_file(self, path):
+        from PIL import Image
+        with Image.open(path) as img:
+            img = self._to_color(img)
+            if self.scale:
+                img = img.resize((self.scale[1], self.scale[0]),
+                                 Image.BILINEAR)
+            arr = numpy.asarray(img, numpy.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
+    def _aug_draws(self, index):
+        """3 uniforms in [0,1) — crop y, crop x, mirror — pure in
+        (aug_seed, sample index, epoch)."""
+        gen = numpy.random.Generator(numpy.random.PCG64(
+            (self.aug_seed ^ (int(index) * 0x9E3779B1)
+             ^ (self.epoch_number * 0x85EBCA6B))
+            & 0xFFFFFFFFFFFFFFFF))
+        return gen.random(3)
+
+    def _augment(self, arr, train, draws):
+        """``draws``: 3 uniforms in [0,1) — crop y, crop x, mirror."""
+        ch, cw = self.crop if self.crop else arr.shape[:2]
+        h, w = arr.shape[:2]
+        if (h, w) != (ch, cw):
+            if train:
+                y = int(draws[0] * (h - ch + 1))
+                x = int(draws[1] * (w - cw + 1))
+            else:
+                y, x = (h - ch) // 2, (w - cw) // 2
+            arr = arr[y:y + ch, x:x + cw]
+        if train and self.mirror == "random" and draws[2] < 0.5:
+            arr = arr[:, ::-1]
+        return arr
+
+    def materialize_samples(self, indices):
+        train = bool(self.train_phase)
+        shape = self.sample_shape()
+        data = numpy.empty((len(indices),) + shape, numpy.uint8)
+        labels = numpy.empty(len(indices), numpy.int32)
+        for i, idx in enumerate(numpy.asarray(indices)):
+            draws = self._aug_draws(idx) if train else None
+            arr = self._augment(self.decode_image(int(idx)), train,
+                                draws)
+            if arr.shape != shape:
+                raise ValueError(
+                    "%s: decoded %r, expected %r (set scale=)"
+                    % (self.name, arr.shape, shape))
+            data[i] = arr
+            labels[i] = self.label_of(int(idx))
+        return {"data": data, "labels": labels}
+
+    def xla_batch_transform(self, name, tensor):
+        if name != "data":
+            return tensor
+        import jax.numpy as jnp
+        mean = self.normalize_mean
+        std = max(self.normalize_std, 1e-6)
+        return (tensor.astype(jnp.float32) / 255.0 - mean) / std
+
+    def fill_minibatch(self):
+        """Host (numpy-oracle) path serves the SAME normalized floats
+        the device sees."""
+        idx = self.minibatch_indices.mem[:self.minibatch_size]
+        batch = self.materialize_samples(numpy.asarray(idx))
+        pad = self.max_minibatch_size - len(idx)
+        data = (batch["data"].astype(numpy.float32) / 255.0
+                - self.normalize_mean) / max(self.normalize_std, 1e-6)
+        self.minibatch_data.map_invalidate()
+        self.minibatch_data.mem[:len(idx)] = data
+        self.minibatch_labels.map_invalidate()
+        self.minibatch_labels.mem[:len(idx)] = batch["labels"]
+        if pad:
+            self.minibatch_data.mem[len(idx):] = data[-1:]
+            self.minibatch_labels.mem[len(idx):] = batch["labels"][-1:]
+
+    def create_minibatch_data(self):
+        # the HOST minibatch mirror is float (oracle path); the
+        # STREAMED windows stay uint8 (materialize_window path)
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape(),
+            numpy.float32))
+        self.minibatch_labels.reset(numpy.zeros(
+            (self.max_minibatch_size,), numpy.int32))
+
+
+class FileImageLoader(ImageLoaderBase):
+    """Explicit (path, label) lists per class.
+
+    ``test_paths`` / ``valid_paths`` / ``train_paths``: lists of file
+    paths; ``labels`` maps path -> int, or pass parallel label lists.
+    """
+
+    def __init__(self, workflow, train_paths=(), valid_paths=(),
+                 test_paths=(), train_labels=None, valid_labels=None,
+                 test_labels=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._paths = list(test_paths) + list(valid_paths) \
+            + list(train_paths)
+        self._class_sizes = [len(test_paths), len(valid_paths),
+                             len(train_paths)]
+        self._label_names = None
+        labels = []
+        for lst, pths in ((test_labels, test_paths),
+                          (valid_labels, valid_paths),
+                          (train_labels, train_paths)):
+            if lst is None:
+                lst = [self.infer_label(p) for p in pths]
+            labels.extend(lst)
+        self._labels = numpy.asarray(labels, numpy.int32) \
+            if labels else numpy.zeros(0, numpy.int32)
+
+    def infer_label(self, path):
+        """Default label inference: parent directory name (stable
+        sorted mapping built lazily)."""
+        return self._dir_label(os.path.basename(os.path.dirname(path)))
+
+    def _dir_label(self, name):
+        if self._label_names is None:
+            dirs = sorted({os.path.basename(os.path.dirname(p))
+                           for p in self._paths})
+            self._label_names = {d: i for i, d in enumerate(dirs)}
+        return self._label_names[name]
+
+    def load_data(self):
+        if not self._paths:
+            raise ValueError("%s: no image paths" % self.name)
+        self.class_lengths = list(self._class_sizes)
+
+    def decode_image(self, index):
+        return self._decode_file(self._paths[index])
+
+    def label_of(self, index):
+        return int(self._labels[index])
+
+    @property
+    def n_classes(self):
+        return int(self._labels.max()) + 1 if len(self._labels) else 0
+
+
+class AutoLabelFileImageLoader(FileImageLoader):
+    """Directory-tree ingestion: ``<base>/<class_name>/*.png``, label =
+    class directory (sorted order); a fraction is held out for
+    validation (deterministic stride split, so the same tree always
+    yields the same split)."""
+
+    def __init__(self, workflow, base_dir=None, valid_ratio=0.1,
+                 **kwargs):
+        paths_by_class = {}
+        for entry in sorted(os.listdir(base_dir)):
+            sub = os.path.join(base_dir, entry)
+            if not os.path.isdir(sub):
+                continue
+            files = sorted(
+                os.path.join(sub, f) for f in os.listdir(sub)
+                if f.lower().endswith(IMAGE_EXTS))
+            if files:
+                paths_by_class[entry] = files
+        if not paths_by_class:
+            raise ValueError("no class directories under %r" % base_dir)
+        train, valid = [], []
+        stride = max(int(round(1.0 / valid_ratio)), 2) \
+            if valid_ratio > 0 else 0
+        for files in paths_by_class.values():
+            for i, p in enumerate(files):
+                (valid if stride and i % stride == 0 else train).append(p)
+        super().__init__(workflow, train_paths=train,
+                         valid_paths=valid, **kwargs)

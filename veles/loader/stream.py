@@ -1,0 +1,149 @@
+"""Streaming loaders — datasets that do NOT live device-resident.
+
+The reference's ImageNet-tier loaders stream from disk with host-side
+augmentation (SURVEY.md §2.3 "Image loaders", §7 stage 6 "host async
+prefetch + device_put double-buffering"). The TPU translation is the
+XLAStep streaming mode: the loader materializes WINDOWS of stacked
+minibatches on the host (decode/augment in a thread pool, overlapped
+with device compute), XLAStep ships each window up once (cheap: the
+tunnel uplink is fast, and image data travels as uint8) and runs a
+compiled scan over the window's minibatches; metrics come back in one
+fetch per window.
+
+This module provides the array-backed base used directly for synthetic
+benchmarks and as the machinery under ``veles.loader.image``.
+"""
+
+import concurrent.futures
+
+import numpy
+
+from veles.loader.base import Loader
+
+
+class StreamLoader(Loader):
+    """Streams minibatch windows; subclasses produce individual samples.
+
+    Contract: implement :meth:`load_data` (set ``class_lengths``) and
+    :meth:`materialize_samples` (global indices -> dict of per-sample
+    arrays). Decoding parallelism and window stacking live here.
+    """
+
+    supports_streaming = True
+    #: True when materialize_samples is vectorized numpy (GIL-bound):
+    #: the window is produced in ONE call — fanning rows out to decode
+    #: threads only adds GIL thrash. File/image loaders (whose decode
+    #: releases the GIL inside the codec) leave this False.
+    window_vectorized = False
+
+    def __init__(self, workflow, prefetch_workers=8, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.prefetch_workers = int(prefetch_workers)
+        self._pool = None
+
+    @property
+    def pool(self):
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.prefetch_workers,
+                thread_name_prefix="%s-decode" % self.name)
+        return self._pool
+
+    # -- subclass surface ---------------------------------------------
+
+    def materialize_samples(self, indices):
+        """dict name -> (len(indices), ...) host arrays for the given
+        GLOBAL sample indices (the train/eval distinction, augmentation
+        etc. are up to the subclass via ``self.train_phase`` — windows
+        are materialized per class so the phase is unambiguous)."""
+        raise NotImplementedError
+
+    def sample_spec(self):
+        """dict name -> (shape, dtype) of ONE sample, used to allocate
+        the (never host-filled) minibatch template Arrays."""
+        raise NotImplementedError
+
+    # -- Loader plumbing ----------------------------------------------
+
+    def create_minibatch_data(self):
+        spec = self.sample_spec()
+        shape, dtype = spec["data"]
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + tuple(shape), dtype))
+        if "labels" in spec:
+            lshape, ldtype = spec["labels"]
+            self.minibatch_labels.reset(numpy.zeros(
+                (self.max_minibatch_size,) + tuple(lshape), ldtype))
+        if "targets" in spec:
+            tshape, tdtype = spec["targets"]
+            self.minibatch_targets.reset(numpy.zeros(
+                (self.max_minibatch_size,) + tuple(tshape), tdtype))
+
+    def fill_minibatch(self):
+        """Host path (numpy oracle / per-step mode): materialize just
+        this minibatch."""
+        idx = self.minibatch_indices.mem[:self.minibatch_size]
+        batch = self.materialize_samples(numpy.asarray(idx))
+        pad = self.max_minibatch_size - len(idx)
+        for name, arr in batch.items():
+            target = {"data": self.minibatch_data,
+                      "labels": self.minibatch_labels,
+                      "targets": self.minibatch_targets}[name]
+            target.map_invalidate()
+            target.mem[:len(idx)] = arr
+            if pad:
+                target.mem[len(idx):] = arr[-1:]
+
+    def materialize_window(self, cls, idx_mat):
+        """Stack B minibatches: one vectorized call over the whole
+        window when the producer is numpy-bound, else decode rows in
+        the thread pool (one future per minibatch)."""
+        idx_mat = numpy.asarray(idx_mat)
+        if self.window_vectorized:
+            b, mb = idx_mat.shape
+            flat = self.materialize_samples(idx_mat.reshape(-1))
+            return {name: arr.reshape((b, mb) + arr.shape[1:])
+                    for name, arr in flat.items()}
+        futures = [self.pool.submit(self.materialize_samples, row)
+                   for row in idx_mat]
+        batches = [f.result() for f in futures]
+        return {name: numpy.stack([b[name] for b in batches])
+                for name in batches[0]}
+
+
+class ArrayStreamLoader(StreamLoader):
+    """Streaming view over in-memory arrays (synthetic benchmarks, and
+    the honest stand-in for 'dataset too big for HBM' testing: nothing
+    is device-resident; every window travels the host→device link)."""
+
+    window_vectorized = True
+
+    def __init__(self, workflow, data=None, labels=None, targets=None,
+                 class_lengths=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._data = data
+        self._labels = labels
+        self._targets = targets
+        if class_lengths is not None:
+            self.class_lengths = list(class_lengths)
+
+    def load_data(self):
+        if self._data is None:
+            raise ValueError("%s: data unset" % self.name)
+
+    def sample_spec(self):
+        spec = {"data": (self._data.shape[1:], self._data.dtype)}
+        if self._labels is not None:
+            spec["labels"] = (self._labels.shape[1:], self._labels.dtype)
+        if self._targets is not None:
+            spec["targets"] = (self._targets.shape[1:],
+                               self._targets.dtype)
+        return spec
+
+    def materialize_samples(self, indices):
+        out = {"data": self._data[indices]}
+        if self._labels is not None:
+            out["labels"] = self._labels[indices]
+        if self._targets is not None:
+            out["targets"] = self._targets[indices]
+        return out

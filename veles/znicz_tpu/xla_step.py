@@ -86,8 +86,29 @@ class XLAStep(Unit):
         self.scan_mode = bool(
             getattr(self.loader, "supports_device_gather", False)
             and not getattr(self.workflow, "is_slave", False))
-        if self.scan_mode:
+        # streaming fast path: dataset stays on host, stacked windows
+        # of minibatches ship up; one dispatch + one metric fetch per
+        # window (SURVEY.md §7 stage 6 "async prefetch + double
+        # buffering", done the XLA way)
+        self.stream_mode = bool(
+            not self.scan_mode
+            and getattr(self.loader, "supports_streaming", False)
+            and not getattr(self.workflow, "is_slave", False))
+        if self.scan_mode or self.stream_mode:
             self.loader.device_gather = True
+        #: streaming window bounds: device-side bytes per shipped
+        #: window and minibatches per compiled scan. The byte cap must
+        #: stay under the tunnel's fast-path transfer limit (~128MB on
+        #: remote TPU links: larger single transfers drop from ~2GB/s
+        #: to ~0.25GB/s)
+        self.max_window_bytes = 96 << 20
+        self.max_window_minibatches = 64
+        #: windows per metric fetch: the ~100ms d2h round-trip is
+        #: per-fetch latency, so draining several windows' outputs in
+        #: ONE packed fetch amortizes it
+        self.stream_fetch_windows = 4
+        self._stage_pool = None
+        self._last_put = None
         self._dispatched_epoch = None
         self._epoch_outs = {}
         self._epoch_pos = {}
@@ -151,16 +172,19 @@ class XLAStep(Unit):
                 if hasattr(gd, "hyperparams")}
 
     def run(self):
-        if self.scan_mode:
-            self._run_scan_mode()
+        if self.scan_mode or self.stream_mode:
+            self._run_fused_mode()
         else:
             self._run_per_step()
 
-    def _run_scan_mode(self):
+    def _run_fused_mode(self):
         loader = self.loader
         if self._dispatched_epoch is None or \
                 loader.epoch_number >= self._chunk_epoch0 + self._chunk_len:
-            self._dispatch_epoch()
+            if self.scan_mode:
+                self._dispatch_epoch()
+            else:
+                self._dispatch_stream_epoch()
         if loader.epoch_number != self._serving_epoch:
             self._serving_epoch = loader.epoch_number
             self._epoch_pos = {cls: 0 for cls in self._epoch_outs}
@@ -301,6 +325,125 @@ class XLAStep(Unit):
         self._chunk_len = n_epochs
         self._dispatched_epoch = loader.epoch_number
 
+    # -- streaming dispatch -------------------------------------------
+
+    def _window_minibatches(self):
+        """Minibatches per shipped window, bounded by device bytes and
+        the scan length. Sized from the loader's STREAMED sample spec
+        (e.g. uint8 images), not the float host mirror."""
+        loader = self.loader
+        spec = getattr(loader, "sample_spec", None)
+        if spec is not None:
+            per_mb = loader.max_minibatch_size * sum(
+                int(numpy.prod(shape, dtype=numpy.int64) or 1)
+                * numpy.dtype(dt).itemsize
+                for shape, dt in spec().values())
+        else:
+            per_mb = loader.minibatch_data.mem.nbytes
+            if loader.minibatch_labels:
+                per_mb += loader.minibatch_labels.mem.nbytes
+            if getattr(loader, "minibatch_targets", None) is not None \
+                    and loader.minibatch_targets:
+                per_mb += loader.minibatch_targets.mem.nbytes
+        w = max(1, int(self.max_window_bytes // max(per_mb, 1)))
+        return min(w, self.max_window_minibatches)
+
+    def _put_window(self, stacked):
+        """Ship a stacked window up, sharding the within-minibatch dim
+        over the data axis under DP (pad rows repeat the last sample;
+        the evaluator's valid-row mask zeroes their contribution).
+
+        Transfers are serialized one-in-flight (the tunnel collapses to
+        a slow path when multiple large transfers overlap): each call
+        first waits for the PREVIOUS window's transfer, so the current
+        upload still overlaps the previous window's compute."""
+        import jax
+        if self._last_put is not None:
+            jax.block_until_ready(self._last_put)
+        if self.batch_sharding is None:
+            out = {k: jax.device_put(v) for k, v in stacked.items()}
+            self._last_put = list(out.values())
+            return out
+        from jax.sharding import NamedSharding, PartitionSpec
+        from veles.memory import roundup
+        mesh = self.batch_sharding.mesh
+        axis = self.batch_sharding.spec[0]
+        n_dev = mesh.shape[axis]
+        out = {}
+        for k, v in stacked.items():
+            mb = v.shape[1]
+            mb_pad = roundup(mb, n_dev)
+            if mb_pad != mb:
+                pad = numpy.repeat(v[:, -1:], mb_pad - mb, axis=1)
+                v = numpy.concatenate([v, pad], axis=1)
+            out[k] = jax.device_put(v, NamedSharding(
+                mesh, PartitionSpec(None, axis)))
+        self._last_put = list(out.values())
+        return out
+
+    def _dispatch_stream_epoch(self):
+        """Stream ONE epoch: for each class segment, ship windows of
+        stacked minibatches and run a compiled scan per window.
+        Pipelined two ways: window staging (host decode/augment) runs
+        in a background thread two windows ahead, and each window's
+        metric fetch is deferred until the NEXT window has been
+        dispatched — the ~100ms tunnel round-trip overlaps device
+        compute instead of serializing with it."""
+        import concurrent.futures
+        import jax
+        loader = self.loader
+        if self._stage_pool is None:
+            self._stage_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="%s-stage" % self.name)
+        plan = loader.epoch_plan()
+        hyper = self._gather_hyper()
+        w_size = self._window_minibatches()
+        spans = []         # (cls, valids_slice, idx_rows)
+        for cls, idx_mat, valids in plan:
+            for lo in range(0, len(idx_mat), w_size):
+                hi = min(lo + w_size, len(idx_mat))
+                spans.append((cls, valids[lo:hi], idx_mat[lo:hi]))
+        # lazy staging with depth-2 backpressure: completed windows
+        # must never pile up in host RAM ahead of the device
+        stage_depth = 2
+        staged = []
+
+        def stage(j):
+            cls, _, rows = spans[j]
+            staged.append(self._stage_pool.submit(
+                loader.materialize_window, cls, rows))
+        for j in range(min(stage_depth, len(spans))):
+            stage(j)
+        outs_per_cls = {cls: [] for cls, _, _ in plan}
+        pending = []       # (cls, device outputs) — fetch lags by one
+        for i, (cls, valids_w, _) in enumerate(spans):
+            train = cls == CLASS_TRAIN
+            units = self.train_units if train else self.eval_units
+            fn = self.compiler.compile_window_scan(
+                self._batch_spec, train, units,
+                loader.xla_batch_transform)
+            stacked = self._put_window(staged.pop(0).result())
+            if i + stage_depth < len(spans):
+                stage(i + stage_depth)
+            key0 = jax.random.fold_in(self.base_key, self.step_index)
+            self.step_index += len(valids_w)
+            self.params, self.state, outs = fn(
+                self.params, self.state, stacked, valids_w, hyper, key0)
+            pending.append((cls, outs))
+            if len(pending) > self.stream_fetch_windows:
+                _drain_pending(pending, outs_per_cls, keep=1)
+        _drain_pending(pending, outs_per_cls, keep=0)
+        self._epoch_outs = {
+            cls: {k: numpy.concatenate(
+                [w[k] for w in ws])[None]      # add the epoch dim
+                for k in ws[0]}
+            for cls, ws in outs_per_cls.items()}
+        self._epoch_pos = {cls: 0 for cls in self._epoch_outs}
+        self._serving_epoch = loader.epoch_number
+        self._chunk_epoch0 = loader.epoch_number
+        self._chunk_len = 1
+        self._dispatched_epoch = loader.epoch_number
+
     def _run_per_step(self):
         import jax
         train = self.loader.minibatch_class == CLASS_TRAIN
@@ -404,6 +547,19 @@ class XLAStep(Unit):
                                    self.param_sharding)
         self.state = _device_tree(self.compiler.gather_state(),
                                   self.param_sharding)
+
+
+def _drain_pending(pending, outs_per_cls, keep):
+    """Fetch all but the newest ``keep`` pending window outputs in ONE
+    packed d2h transfer (latency amortization; the kept windows keep
+    the device pipeline ahead of the host)."""
+    take = pending[:len(pending) - keep] if keep else list(pending)
+    if not take:
+        return
+    del pending[:len(take)]
+    fetched = _fetch_tree([o for _, o in take])
+    for (c, _), o in zip(take, fetched):
+        outs_per_cls[c].append(o)
 
 
 def _device_tree(tree, sharding=None):
