@@ -1,0 +1,178 @@
+"""LR scheduling policies + NNRollback (SURVEY.md §2.4 "LR scheduling"
+/ "Divergence rollback").
+
+The schedule must be applied INSIDE the compiled step (the iteration
+counter is traced STATE), agree between the numpy oracle and the XLA
+path, and survive multi-epoch fused dispatches without retraces."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+from veles.znicz_tpu.lr_adjust import (
+    StepPolicy, ExpPolicy, InvPolicy, ArbitraryStepPolicy, make_policy)
+
+
+@pytest.mark.parametrize("policy", [
+    StepPolicy(gamma=0.5, step=3),
+    ExpPolicy(gamma=0.9),
+    InvPolicy(gamma=0.01, power=0.5),
+    ArbitraryStepPolicy([(0.1, 2), (0.01, 3), (0.001, 1)]),
+])
+def test_policy_traced_matches_numpy(policy):
+    """Each policy formula gives identical values under numpy and under
+    jax.jit tracing (same function, both backends)."""
+    import jax
+    import jax.numpy as jnp
+
+    base = numpy.float32(0.04)
+    fn = jax.jit(lambda t: policy(jnp, base, t))
+    for t in range(8):
+        expect = policy(numpy, base, t)
+        got = float(fn(jnp.int32(t)))
+        assert abs(float(expect) - got) < 1e-7, (t, expect, got)
+
+
+def test_make_policy_from_dict():
+    p = make_policy({"name": "step", "gamma": 0.25, "step": 10})
+    assert isinstance(p, StepPolicy)
+    assert p.gamma == 0.25 and p.step == 10
+    assert make_policy(None) is None
+    assert make_policy(p) is p
+
+
+def _mnist_wf(backend, name, policy=None, max_epochs=3, lr=0.02):
+    prng.seed_all(4242)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: getattr(root.mnist.loader, k, None)
+             for k in ("minibatch_size", "n_train", "n_valid")}
+    root.mnist.loader.update({"minibatch_size": 25,
+                              "n_train": 200, "n_valid": 50})
+    root.mnist.decision.max_epochs = max_epochs
+    try:
+        wf = mnist.create_workflow(name=name)
+        for gd in wf.gds:
+            gd.learning_rate = lr
+            gd.learning_rate_bias = lr
+        if policy is not None:
+            wf.link_lr_adjuster(policy)
+        wf.initialize(device=backend)
+        wf.run()
+    finally:
+        root.mnist.loader.update(
+            {k: v for k, v in saved.items() if v is not None})
+    return wf
+
+
+def test_schedule_parity_numpy_vs_xla():
+    """MNIST trained under a step policy: oracle and compiled paths
+    follow the same schedule (weights stay close, history matches)."""
+    policy = {"name": "step", "gamma": 0.5, "step": 10}
+    wf_np = _mnist_wf("numpy", "LrNp", policy)
+    wf_x = _mnist_wf("cpu", "LrXla", policy)
+    for a, b in zip(wf_np.decision.history, wf_x.decision.history):
+        assert abs(a["train"]["loss"] - b["train"]["loss"]) < 5e-3, \
+            (a, b)
+    w_np = wf_np.forwards[0].weights.map_read().mem
+    w_x = wf_x.forwards[0].weights.map_read().mem
+    assert numpy.allclose(w_np, w_x, atol=5e-3)
+    # counter advanced once per train minibatch; the numpy graph skips
+    # the GD chain on the final minibatch once decision.complete fires
+    # (gate_skip), the fused epoch applies it — long-standing 1-step
+    # tail difference between the paths
+    n_train_steps = 3 * (200 // 25)
+    assert int(wf_x.gds[0].iteration.map_read().mem) == n_train_steps
+    assert int(wf_np.gds[0].iteration.map_read().mem) == n_train_steps - 1
+
+
+def test_zero_lr_schedule_freezes_weights_inside_compiled_step():
+    """An all-zero ArbitraryStepPolicy must freeze weights ON DEVICE —
+    proving the schedule is applied inside the compiled step, not by
+    host-side lr mutation between dispatches."""
+    policy = ArbitraryStepPolicy([(0.0, 1)])
+    prng.seed_all(99)
+    from veles.znicz_tpu.models import mnist
+    root.mnist.decision.max_epochs = 2
+    wf = mnist.create_workflow(name="LrFreeze")
+    wf.link_lr_adjuster(policy)
+    wf.initialize(device="cpu")
+    w0 = numpy.array(wf.forwards[0].weights.map_read().mem)
+    wf.run()
+    w1 = wf.forwards[0].weights.map_read().mem
+    assert numpy.array_equal(w0, w1), "zero-lr schedule did not freeze"
+
+
+def test_schedule_survives_chunked_dispatch():
+    """Chunked multi-epoch dispatch must produce the same schedule as
+    per-epoch dispatch (the counter lives in traced state)."""
+    def run(chunk):
+        prng.seed_all(5150)
+        from veles.znicz_tpu.models import mnist
+        saved = {k: getattr(root.mnist.loader, k, None)
+                 for k in ("minibatch_size", "n_train", "n_valid")}
+        root.mnist.loader.update({"minibatch_size": 20,
+                                  "n_train": 100, "n_valid": 40})
+        root.mnist.decision.max_epochs = 4
+        try:
+            wf = mnist.create_workflow(name="LrChunk%d" % chunk)
+            wf.link_lr_adjuster({"name": "exp", "gamma": 0.98})
+            wf.initialize(device="cpu")
+            wf.xla_step.epochs_per_dispatch = chunk
+            wf.run()
+        finally:
+            root.mnist.loader.update(
+                {k: v for k, v in saved.items() if v is not None})
+        return wf.decision.history
+
+    h1, h4 = run(1), run(4)
+    for a, b in zip(h1, h4):
+        assert a["validation"]["metric"] == b["validation"]["metric"]
+        assert abs(a["train"]["loss"] - b["train"]["loss"]) < 1e-5
+
+
+@pytest.mark.parametrize("backend", ["numpy", "cpu"])
+def test_rollback_on_blowup(backend):
+    """A deliberately divergent lr triggers NNRollback: weights return
+    to the stashed copy and learning rates are cut."""
+    prng.seed_all(31337)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: getattr(root.mnist.loader, k, None)
+             for k in ("minibatch_size", "n_train", "n_valid")}
+    root.mnist.loader.update({"minibatch_size": 20,
+                              "n_train": 100, "n_valid": 40})
+    root.mnist.decision.max_epochs = 6
+    try:
+        wf = mnist.create_workflow(name="Rollback_%s" % backend)
+        # epoch 1 trains sanely; then the lr explodes via a schedule
+        # step so a later epoch diverges
+        wf.link_lr_adjuster(ArbitraryStepPolicy([(0.02, 5), (60.0, 1)]))
+        rb = wf.link_rollback(lr_cut=0.25, blowup_factor=2.0)
+        wf.initialize(device=backend)
+        with numpy.errstate(all="ignore"):
+            wf.run()
+    finally:
+        root.mnist.loader.update(
+            {k: v for k, v in saved.items() if v is not None})
+    assert rb.rollback_count >= 1, "no rollback despite lr blow-up"
+    # restored weights are the finite stash, not the diverged values
+    w = wf.forwards[0].weights.map_read().mem
+    assert numpy.isfinite(w).all()
+    # learning rates were cut
+    assert wf.gds[0].learning_rate == pytest.approx(
+        0.02 * 0.25 ** rb.rollback_count)
+
+
+def test_rollback_bounds_epoch_fusion():
+    """An NNRollback in the graph must cap multi-epoch dispatch fusion
+    at its check interval."""
+    prng.seed_all(2020)
+    from veles.znicz_tpu.models import mnist
+    root.mnist.decision.max_epochs = 3
+    wf = mnist.create_workflow(name="RollbackChunk")
+    wf.link_rollback(interval=1)
+    wf.initialize(device="cpu")
+    wf.xla_step.epochs_per_dispatch = 8   # forced, but must be clipped
+    wf.run()
+    assert wf.xla_step._chunk_len == 1
+    assert len(wf.decision.history) == 3

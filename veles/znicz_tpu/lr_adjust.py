@@ -1,0 +1,119 @@
+"""Learning-rate scheduling policies.
+
+Re-design of znicz ``lr_adjust.py`` [U] (SURVEY.md §2.4 "LR
+scheduling": Caffe-style lr policies — step/exp/inv/arbitrary —
+applied per-GD-unit over iterations).
+
+TPU-first shape: in the reference a ``LearningRateAdjust`` unit runs
+between steps and mutates ``gd.learning_rate`` host-side — impossible
+here, because whole epochs execute as ONE compiled XLA program. Instead
+each GD unit carries an ``iteration`` counter in its traced STATE
+pytree and the policy is a pure ``(xp, base_lr, t) -> lr`` function
+evaluated INSIDE the compiled step, so:
+
+* the schedule advances per train minibatch with zero host involvement
+  and zero retraces (the base lr stays a traced hyperparameter);
+* numpy oracle and XLA path share one policy formula (``xp`` is numpy
+  or jax.numpy);
+* checkpoint/resume carries the counter automatically (STATE rides in
+  every snapshot).
+
+Policies may be given as objects or config dicts
+(``{"name": "step", "gamma": 0.1, "step": 1000}``), including inside a
+layer spec's ``"<-"`` gradient kwargs.
+"""
+
+import numpy
+
+
+class LRPolicy:
+    """Base: a pure, trace-compatible lr schedule."""
+
+    def __call__(self, xp, lr, t):
+        raise NotImplementedError
+
+    def __repr__(self):
+        args = ", ".join("%s=%r" % kv for kv in sorted(vars(self).items()))
+        return "%s(%s)" % (type(self).__name__, args)
+
+
+class FixedPolicy(LRPolicy):
+    """lr(t) = base (explicit no-op, for config symmetry)."""
+
+    def __call__(self, xp, lr, t):
+        return lr
+
+
+class StepPolicy(LRPolicy):
+    """lr(t) = base * gamma ** floor(t / step)  (Caffe "step")."""
+
+    def __init__(self, gamma=0.1, step=1000):
+        self.gamma = float(gamma)
+        self.step = int(step)
+
+    def __call__(self, xp, lr, t):
+        k = (t // self.step).astype(numpy.float32) \
+            if hasattr(t, "astype") else float(t // self.step)
+        return lr * self.gamma ** k
+
+
+class ExpPolicy(LRPolicy):
+    """lr(t) = base * gamma ** t  (Caffe "exp")."""
+
+    def __init__(self, gamma=0.999):
+        self.gamma = float(gamma)
+
+    def __call__(self, xp, lr, t):
+        tf = t.astype(numpy.float32) if hasattr(t, "astype") else float(t)
+        return lr * self.gamma ** tf
+
+
+class InvPolicy(LRPolicy):
+    """lr(t) = base * (1 + gamma * t) ** -power  (Caffe "inv")."""
+
+    def __init__(self, gamma=0.0001, power=0.75):
+        self.gamma = float(gamma)
+        self.power = float(power)
+
+    def __call__(self, xp, lr, t):
+        tf = t.astype(numpy.float32) if hasattr(t, "astype") else float(t)
+        return lr * (1.0 + self.gamma * tf) ** (-self.power)
+
+
+class ArbitraryStepPolicy(LRPolicy):
+    """Explicit piecewise schedule: ``[(lr0, n0), (lr1, n1), ...]`` —
+    use ``lr_i`` for ``n_i`` iterations; the last value persists
+    (reference ``ArbitraryStepPolicy`` [U]). Replaces the base lr."""
+
+    def __init__(self, schedule):
+        if not schedule:
+            raise ValueError("empty schedule")
+        self.schedule = [(float(v), int(n)) for v, n in schedule]
+
+    def __call__(self, xp, lr, t):
+        bounds = numpy.cumsum([n for _, n in self.schedule[:-1]])
+        values = xp.asarray([v for v, _ in self.schedule],
+                            dtype=numpy.float32)
+        idx = xp.searchsorted(xp.asarray(bounds, dtype=numpy.int32),
+                              t, side="right")
+        return values[idx]
+
+
+POLICIES = {
+    "fixed": FixedPolicy,
+    "step": StepPolicy,
+    "exp": ExpPolicy,
+    "inv": InvPolicy,
+    "arbitrary_step": ArbitraryStepPolicy,
+}
+
+
+def make_policy(spec):
+    """None | LRPolicy | callable | {"name": ..., **kwargs} → policy."""
+    if spec is None or isinstance(spec, LRPolicy) or callable(spec):
+        return spec
+    if isinstance(spec, dict):
+        spec = dict(spec)
+        name = spec.pop("name")
+        return POLICIES[name](**spec)
+    raise TypeError("cannot build an lr policy from %r" % (spec,))

@@ -1,0 +1,113 @@
+"""NNRollback — divergence rollback.
+
+Re-design of znicz ``nn_rollback.py`` [U] (SURVEY.md §2.4 "Divergence
+rollback": snapshot weights in RAM; on loss blow-up restore & cut lr).
+
+Host-side unit linked after the Decision. At each epoch end it judges
+the epoch's loss:
+
+* healthy (finite, and not worse than ``blowup_factor ×`` the best loss
+  seen) → keep a RAM copy of the current params/optimizer state when
+  the loss improved;
+* blown up (NaN/inf or past the factor) → restore the stashed copy into
+  the unit Arrays, multiply every GD unit's learning rate by
+  ``lr_cut``, and re-upload to the device.
+
+TPU notes: the lr cut needs NO retrace — base lr is a traced
+hyperparameter refetched each dispatch. Rollback checks happen at epoch
+granularity, so the unit bounds multi-epoch dispatch fusion via
+``max_fused_epochs`` (a chunk must never run past a point where a
+rollback could trigger, same rule as the decision's stop criteria).
+"""
+
+import math
+
+from veles.loader.base import CLASS_VALID, CLASS_TRAIN
+from veles.units import Unit
+
+
+class NNRollback(Unit):
+    """RAM-snapshot weight rollback on loss divergence."""
+
+    def __init__(self, workflow, lr_cut=0.5, blowup_factor=4.0,
+                 interval=1, **kwargs):
+        super().__init__(workflow, **kwargs)
+        #: multiply learning rates by this on rollback
+        self.lr_cut = float(lr_cut)
+        #: loss > blowup_factor * best ⇒ rollback (NaN/inf always does)
+        self.blowup_factor = float(blowup_factor)
+        #: epochs between checks (= max fused epochs per dispatch)
+        self.interval = int(interval)
+        self.rollback_count = 0
+        self._stash = None
+        self._best_loss = None
+
+    def max_fused_epochs(self):
+        """Consulted by XLAStep when sizing multi-epoch dispatches."""
+        return self.interval
+
+    # -- stash / restore ----------------------------------------------
+
+    def _epoch_loss(self):
+        d = self.workflow.decision
+        for cls in (CLASS_VALID, CLASS_TRAIN):
+            acc = d.last_epoch_metrics[cls]
+            if acc and acc["samples"]:
+                return acc["loss"] / acc["samples"]
+        return None
+
+    def _snapshot(self):
+        wf = self.workflow
+        if wf.xla_step is not None:
+            wf.xla_step.sync_host()
+        self._stash = {
+            u.name: (u.export_params(), u.export_state())
+            for u in wf._stateful_units()}
+
+    def _restore(self):
+        wf = self.workflow
+        for u in wf._stateful_units():
+            if u.name in self._stash:
+                params, state = self._stash[u.name]
+                u.import_params(params)
+                u.import_state(state)
+        for gd in wf.gds:
+            if gd is not None:
+                gd.learning_rate *= self.lr_cut
+                gd.learning_rate_bias *= self.lr_cut
+        if wf.xla_step is not None:
+            wf.xla_step.refresh_device()
+        self.rollback_count += 1
+        self.warning(
+            "loss blow-up: rolled back to last good weights, "
+            "learning rates cut by %.3g (rollback #%d)",
+            self.lr_cut, self.rollback_count)
+
+    def run(self):
+        d = self.workflow.decision
+        if not bool(d.epoch_ended):
+            return
+        loss = self._epoch_loss()
+        if loss is None:
+            return
+        blown = not math.isfinite(loss) or (
+            self._best_loss is not None
+            and loss > self.blowup_factor * self._best_loss)
+        if blown and self._stash is not None:
+            self._restore()
+            return
+        if self._best_loss is None or loss < self._best_loss:
+            self._best_loss = loss
+            self._snapshot()
+
+    # -- checkpoint support -------------------------------------------
+
+    def get_state(self):
+        return {"rollback_count": self.rollback_count,
+                "best_loss": None if self._best_loss is None
+                else float(self._best_loss)}
+
+    def set_state(self, state):
+        self.rollback_count = int(state.get("rollback_count", 0))
+        best = state.get("best_loss")
+        self._best_loss = None if best is None else float(best)

@@ -159,7 +159,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
 
     FORWARD = None
     STATE = ("vel_weights", "vel_bias", "acc_weights", "acc_bias",
-             "acc_count")
+             "acc_count", "iteration")
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -179,11 +179,21 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             "gradient_moment_bias", self.gradient_moment)
         #: accumulate gradients over N steps before applying
         self.accumulate_gradient = int(kwargs.get("accumulate_gradient", 1))
+        # lr schedules (SURVEY.md §2.4 "LR scheduling"): pure policies
+        # evaluated inside the compiled step on the traced iteration
+        # counter — see veles/znicz_tpu/lr_adjust.py
+        from veles.znicz_tpu.lr_adjust import make_policy
+        self.lr_policy = make_policy(kwargs.get("lr_policy"))
+        self.lr_policy_bias = make_policy(
+            kwargs.get("lr_policy_bias", kwargs.get("lr_policy")))
         self.vel_weights = Array()
         self.vel_bias = Array()
         self.acc_weights = Array()
         self.acc_bias = Array()
         self.acc_count = Array()
+        #: train-minibatch counter driving the lr schedule (traced STATE
+        #: so chunked epoch scans advance it on device)
+        self.iteration = Array()
 
     # pairing ----------------------------------------------------------
 
@@ -226,6 +236,8 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
                 self.acc_bias.reset(numpy.zeros_like(f.bias.mem))
             if not self.acc_count:
                 self.acc_count.reset(numpy.zeros((), numpy.int32))
+        if not self.iteration:
+            self.iteration.reset(numpy.zeros((), numpy.int32))
 
     # hyper-parameters (traced scalars; changing them never retraces) --
 
@@ -275,10 +287,19 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         acc = xp.where(apply_now, xp.zeros_like(acc), acc)
         return w, vel, acc
 
+    @staticmethod
+    def _scheduled_lr(xp, policy, base_lr, t):
+        return base_lr if policy is None else policy(xp, base_lr, t)
+
     # numpy oracle update ---------------------------------------------
 
     def update_weights_numpy(self, grad_w, grad_b):
         f = self.forward
+        t = int(self.iteration.map_read().mem) if self.iteration else 0
+        lr_w = self._scheduled_lr(numpy, self.lr_policy,
+                                  self.learning_rate, t)
+        lr_b = self._scheduled_lr(numpy, self.lr_policy_bias,
+                                  self.learning_rate_bias, t)
         accumulating = self.accumulate_gradient > 1
         apply_now = True
         acc_w = acc_b = None
@@ -292,7 +313,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         self.vel_weights.map_write()
         w, vel, acc = self._step_param(
             numpy, f.weights.mem, self.vel_weights.mem, acc_w, grad_w,
-            apply_now, self.learning_rate, self.gradient_moment,
+            apply_now, lr_w, self.gradient_moment,
             self.weights_decay, self.l1_vs_l2)
         f.weights.mem[...] = w
         self.vel_weights.mem[...] = vel
@@ -305,13 +326,16 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             self.vel_bias.map_write()
             b, velb, accb = self._step_param(
                 numpy, f.bias.mem, self.vel_bias.mem, acc_b, grad_b,
-                apply_now, self.learning_rate_bias,
+                apply_now, lr_b,
                 self.gradient_moment_bias, self.weights_decay_bias,
                 self.l1_vs_l2_bias)
             f.bias.mem[...] = b
             self.vel_bias.mem[...] = velb
             if accb is not None:
                 self.acc_bias.mem[...] = accb
+        if self.iteration:
+            self.iteration.map_write()
+            self.iteration.mem[...] = t + 1
 
     # traced update ----------------------------------------------------
 
@@ -321,6 +345,11 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         h = ctx.hyper[self.name]
         params = ctx.unit_params(f)
         state = ctx.unit_state(self)
+        t = state["iteration"]
+        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t)
+        lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
+                                  h["lr_bias"], t)
+        ctx.update_state(self, iteration=(t + 1).astype(jnp.int32))
         accumulating = self.accumulate_gradient > 1
         apply_now = True
         acc_w = acc_b = None
@@ -335,7 +364,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         grad_w = ctx.pmean(grad_w)
         w, vel, acc = self._step_param(
             jnp, w, vel, acc_w, grad_w.astype(w.dtype), apply_now,
-            h["lr"], h["moment"], h["l2"], h["l1_vs_l2"])
+            lr_w, h["moment"], h["l2"], h["l1_vs_l2"])
         # ZeroFiller mask (traced via hyperparams): pin masked entries
         # at zero INSIDE the trace — host-side mutation never reaches
         # device-resident params
@@ -352,7 +381,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             grad_b = ctx.pmean(grad_b)
             b, velb, accb = self._step_param(
                 jnp, b, velb, acc_b, grad_b.astype(b.dtype), apply_now,
-                h["lr_bias"], h["moment_bias"], h["l2_bias"],
+                lr_b, h["moment_bias"], h["l2_bias"],
                 h["l1_vs_l2_bias"])
             ctx.update_params(f, bias=b)
             ctx.update_state(self, vel_bias=velb)
@@ -407,6 +436,7 @@ class NNWorkflow(AcceleratedWorkflow):
         self.gds = []
         self.repeater = None
         self.snapshotter = None
+        self.rollback = None
         self.xla_step = None
         #: distributed role (set by the Launcher); slaves receive their
         #: minibatch index ranges from the master

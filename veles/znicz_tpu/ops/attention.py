@@ -242,11 +242,15 @@ class GDTransformerFFN(GradientDescentBase):
             self.err_input.map_invalidate()
             self.err_input.mem[...] = dx
         self.update_weights_numpy(gw1, gb1)
+        t = int(self.iteration.map_read().mem) - 1
         self._np_update(f.weights2, self.vel_weights2, gw2,
-                        self.learning_rate, self.gradient_moment,
+                        self._scheduled_lr(numpy, self.lr_policy,
+                                           self.learning_rate, t),
+                        self.gradient_moment,
                         self.weights_decay, self.l1_vs_l2)
         self._np_update(f.bias2, self.vel_bias2, gb2,
-                        self.learning_rate_bias,
+                        self._scheduled_lr(numpy, self.lr_policy_bias,
+                                           self.learning_rate_bias, t),
                         self.gradient_moment_bias,
                         self.weights_decay_bias, self.l1_vs_l2_bias)
 
@@ -270,14 +274,19 @@ class GDTransformerFFN(GradientDescentBase):
         self.update_weights_xla(ctx, gw1, gb1)
         h = ctx.hyper[self.name]
         st = ctx.unit_state(self)
+        # update_weights_xla already advanced the schedule counter
+        t = st["iteration"] - 1
+        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t)
+        lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
+                                  h["lr_bias"], t)
         w2, vel2 = p["weights2"], st["vel_weights2"]
         w2, vel2 = self.apply_update(
-            jnp, w2, vel2, ctx.pmean(gw2).astype(w2.dtype), h["lr"],
+            jnp, w2, vel2, ctx.pmean(gw2).astype(w2.dtype), lr_w,
             h["moment"], h["l2"], h["l1_vs_l2"])
         b2, velb2 = p["bias2"], st["vel_bias2"]
         b2, velb2 = self.apply_update(
             jnp, b2, velb2, ctx.pmean(gb2).astype(b2.dtype),
-            h["lr_bias"], h["moment_bias"], h["l2_bias"],
+            lr_b, h["moment_bias"], h["l2_bias"],
             h["l1_vs_l2_bias"])
         ctx.update_params(f, weights2=w2, bias2=b2)
         ctx.update_state(self, vel_weights2=vel2, vel_bias2=velb2)
@@ -483,12 +492,17 @@ class GDMultiHeadAttention(GradientDescentBase):
             self.err_input.map_invalidate()
             self.err_input.mem[...] = dx
         self.update_weights_numpy(gw, gb if f.include_bias else None)
+        t = int(self.iteration.map_read().mem) - 1
         self._np_update(f.weights_out, self.vel_weights_out, gwo,
-                        self.learning_rate, self.gradient_moment,
+                        self._scheduled_lr(numpy, self.lr_policy,
+                                           self.learning_rate, t),
+                        self.gradient_moment,
                         self.weights_decay, self.l1_vs_l2)
         if f.include_bias:
             self._np_update(f.bias_out, self.vel_bias_out, gbo,
-                            self.learning_rate_bias,
+                            self._scheduled_lr(
+                                numpy, self.lr_policy_bias,
+                                self.learning_rate_bias, t),
                             self.gradient_moment_bias,
                             self.weights_decay_bias, self.l1_vs_l2_bias)
 
@@ -541,9 +555,14 @@ class GDMultiHeadAttention(GradientDescentBase):
         self.update_weights_xla(ctx, gw, gb if f.include_bias else None)
         h = ctx.hyper[self.name]
         st = ctx.unit_state(self)
+        # update_weights_xla already advanced the schedule counter
+        t = st["iteration"] - 1
+        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t)
+        lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
+                                  h["lr_bias"], t)
         w_o, vel = p["weights_out"], st["vel_weights_out"]
         w_o, vel = self.apply_update(
-            jnp, w_o, vel, ctx.pmean(gwo).astype(w_o.dtype), h["lr"],
+            jnp, w_o, vel, ctx.pmean(gwo).astype(w_o.dtype), lr_w,
             h["moment"], h["l2"], h["l1_vs_l2"])
         ctx.update_params(f, weights_out=w_o)
         ctx.update_state(self, vel_weights_out=vel)
@@ -551,7 +570,7 @@ class GDMultiHeadAttention(GradientDescentBase):
             b_o, velb = p["bias_out"], st["vel_bias_out"]
             b_o, velb = self.apply_update(
                 jnp, b_o, velb, ctx.pmean(gbo).astype(b_o.dtype),
-                h["lr_bias"], h["moment_bias"], h["l2_bias"],
+                lr_b, h["moment_bias"], h["l2_bias"],
                 h["l1_vs_l2_bias"])
             ctx.update_params(f, bias_out=b_o)
             ctx.update_state(self, vel_bias_out=velb)

@@ -136,6 +136,31 @@ class StandardWorkflowBase(NNWorkflow):
         self.repeater.link_from(prev)
         return self.gds
 
+    def link_lr_adjuster(self, lr_policy=None, bias_lr_policy=None):
+        """Attach an lr schedule to every GD unit (reference
+        ``link_lr_adjuster`` [U]; SURVEY.md §2.4 "LR scheduling").
+        Policies are objects or config dicts — see
+        ``veles/znicz_tpu/lr_adjust.py``. Per-layer policies can also be
+        set directly in a layer's ``"<-"`` kwargs as ``lr_policy``.
+        Call BEFORE initialize (policy formulas bake into the trace)."""
+        from veles.znicz_tpu.lr_adjust import make_policy
+        policy = make_policy(lr_policy)
+        bias_policy = make_policy(bias_lr_policy) or policy
+        for gd in self.gds:
+            if gd is not None:
+                gd.lr_policy = policy
+                gd.lr_policy_bias = bias_policy
+        return self.gds
+
+    def link_rollback(self, **cfg):
+        """Divergence rollback after each epoch's decision (reference
+        ``NNRollback`` [U]; SURVEY.md §2.4 "Divergence rollback")."""
+        from veles.znicz_tpu.nn_rollback import NNRollback
+        rb = NNRollback(self, name="rollback", **cfg)
+        rb.link_from(self.decision)
+        self.rollback = rb
+        return rb
+
     def link_snapshotter(self, **cfg):
         """Checkpoint writer gated on improved validation (reference
         behaviour [U]; SURVEY.md §3.4)."""

@@ -224,6 +224,12 @@ class XLAStep(Unit):
             # cut an exact tail chunk — one more compile at the very
             # end of training)
             chunk = 1 << (chunk.bit_length() - 1)
+        # host-side epoch observers (NNRollback etc.) may bound fusion:
+        # a dispatch must never run past a point where they could act
+        for u in getattr(self.workflow, "_units", ()):
+            bound = getattr(u, "max_fused_epochs", None)
+            if callable(bound):
+                chunk = min(chunk, max(1, int(bound())))
         # stop-criterion bounds apply to FORCED chunk sizes too: a
         # dispatch must never run past a point where the decision could
         # stop, or final params would drift from decision.history
