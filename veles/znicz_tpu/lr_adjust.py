@@ -89,14 +89,14 @@ class ArbitraryStepPolicy(LRPolicy):
         if not schedule:
             raise ValueError("empty schedule")
         self.schedule = [(float(v), int(n)) for v, n in schedule]
+        self._bounds = numpy.cumsum(
+            [n for _, n in self.schedule[:-1]]).astype(numpy.int32)
+        self._values = numpy.asarray(
+            [v for v, _ in self.schedule], numpy.float32)
 
     def __call__(self, xp, lr, t):
-        bounds = numpy.cumsum([n for _, n in self.schedule[:-1]])
-        values = xp.asarray([v for v, _ in self.schedule],
-                            dtype=numpy.float32)
-        idx = xp.searchsorted(xp.asarray(bounds, dtype=numpy.int32),
-                              t, side="right")
-        return values[idx]
+        idx = xp.searchsorted(xp.asarray(self._bounds), t, side="right")
+        return xp.asarray(self._values)[idx]
 
 
 POLICIES = {

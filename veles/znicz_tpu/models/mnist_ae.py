@@ -1,0 +1,87 @@
+"""MnistAE sample: convolutional autoencoder (conv → pool → depool →
+deconv) trained with MSE against the input image.
+
+Rebuild of reference ``samples/MnistAE`` [U] (SURVEY.md §2.8 row 6
+"MnistAE / VideoAE — deconv autoencoders"): exercises the Deconv /
+Depooling unit pairs end-to-end. The decode path pins its output sizes
+to the mirrored encode units via ``output_shape_source`` (ints in the
+layer config name earlier layers by index), and the loader serves the
+image itself as the regression target, so StandardWorkflow auto-selects
+``EvaluatorMSE`` + ``DecisionMSE``.
+"""
+
+import numpy
+
+from veles.config import root
+from veles.loader.fullbatch import FullBatchLoader
+from veles.znicz_tpu.models import datasets
+from veles.znicz_tpu.standard_workflow import StandardWorkflow
+
+root.mnist_ae.update({
+    "loader": {"minibatch_size": 100,
+               "n_train": 2000, "n_valid": 500},
+    "layers": [
+        # encode: (28,28,1) -> conv tanh (24,24,9) -> avg pool (12,12,9)
+        {"type": "conv_tanh",
+         "->": {"n_kernels": 9, "kx": 5, "ky": 5},
+         "<-": {"learning_rate": 0.002, "weights_decay": 0.0,
+                "gradient_moment": 0.5}},
+        {"type": "avg_pooling", "->": {"kx": 2, "ky": 2}},
+        # decode: depool back to the conv output size, deconv back to
+        # the image (output_shape_source = layer index to mirror)
+        {"type": "depooling", "->": {"output_shape_source": 1}},
+        # deconv's weight gradient sums over all ~576 output positions
+        # each weight touches, so its usable lr is ~100x smaller than a
+        # dense layer's (same property as the reference's GDDeconv [U])
+        {"type": "deconv",
+         "->": {"n_kernels": 9, "kx": 5, "ky": 5,
+                "output_shape_source": 0},
+         "<-": {"learning_rate": 2e-5, "weights_decay": 0.0,
+                "gradient_moment": 0.5}},
+    ],
+    "decision": {"max_epochs": 4, "fail_iterations": 20},
+})
+
+
+class MnistAELoader(FullBatchLoader):
+    """Image in, image out: ``original_targets`` aliases the data, so
+    the MSE evaluator reconstructs the input (reference MnistAE loader
+    shape [U])."""
+
+    def __init__(self, workflow, n_train=None, n_valid=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._n_train = n_train
+        self._n_valid = n_valid
+
+    def load_data(self):
+        cfg = root.mnist_ae.loader
+        tx, _, vx, _ = datasets.load_mnist(
+            n_train=self._n_train or cfg.get("n_train", 2000),
+            n_valid=self._n_valid or cfg.get("n_valid", 500))
+        data = numpy.concatenate([vx, tx])[..., None]  # NHWC, C=1
+        self.original_data.mem = data
+        self.original_targets.mem = data
+        self.class_lengths = [0, len(vx), len(tx)]
+
+
+def create_workflow(name="MnistAEWorkflow"):
+    cfg = root.mnist_ae
+    return StandardWorkflow(
+        None, name=name,
+        layers=cfg.layers,
+        loader_factory=lambda wf: MnistAELoader(
+            wf, name="loader",
+            minibatch_size=cfg.loader.minibatch_size),
+        decision_config=cfg.decision.to_dict(),
+    )
+
+
+def run(load, main):
+    """Reference sample entry shape [U]: velescli calls this."""
+    load(StandardWorkflow,
+         layers=root.mnist_ae.layers,
+         loader_factory=lambda wf: MnistAELoader(
+             wf, name="loader",
+             minibatch_size=root.mnist_ae.loader.minibatch_size),
+         decision_config=root.mnist_ae.decision.to_dict())
+    main()

@@ -36,15 +36,18 @@ class NNRollback(Unit):
         self.lr_cut = float(lr_cut)
         #: loss > blowup_factor * best ⇒ rollback (NaN/inf always does)
         self.blowup_factor = float(blowup_factor)
-        #: epochs between checks (= max fused epochs per dispatch)
+        #: kept for API compatibility; checks happen every epoch
         self.interval = int(interval)
         self.rollback_count = 0
         self._stash = None
         self._best_loss = None
 
     def max_fused_epochs(self):
-        """Consulted by XLAStep when sizing multi-epoch dispatches."""
-        return self.interval
+        """Consulted by XLAStep when sizing multi-epoch dispatches: a
+        rollback can fire at ANY epoch end, and a restore mid-chunk
+        would leave the rest of the chunk serving already-diverged
+        metrics — so never fuse past one epoch."""
+        return 1
 
     # -- stash / restore ----------------------------------------------
 
@@ -59,7 +62,11 @@ class NNRollback(Unit):
     def _snapshot(self):
         wf = self.workflow
         if wf.xla_step is not None:
-            wf.xla_step.sync_host()
+            # at_valid: the epoch's validation metric was measured on
+            # the epoch-ENTRY params (valid is served before train), so
+            # "last good" must stash those — the post-train values may
+            # already have diverged inside the very epoch being judged
+            wf.xla_step.sync_host(at_valid=True)
         self._stash = {
             u.name: (u.export_params(), u.export_state())
             for u in wf._stateful_units()}
@@ -73,8 +80,10 @@ class NNRollback(Unit):
                 u.import_state(state)
         for gd in wf.gds:
             if gd is not None:
-                gd.learning_rate *= self.lr_cut
-                gd.learning_rate_bias *= self.lr_cut
+                # scale AFTER the lr policy: schedules like
+                # ArbitraryStepPolicy replace the base lr, so cutting
+                # learning_rate alone would not change the effective lr
+                gd.lr_scale *= self.lr_cut
         if wf.xla_step is not None:
             wf.xla_step.refresh_device()
         self.rollback_count += 1
@@ -93,8 +102,19 @@ class NNRollback(Unit):
         blown = not math.isfinite(loss) or (
             self._best_loss is not None
             and loss > self.blowup_factor * self._best_loss)
-        if blown and self._stash is not None:
-            self._restore()
+        if blown:
+            if self._stash is not None:
+                self._restore()
+            else:
+                # nothing good to restore yet: never stash a blown
+                # state (a NaN best_loss would disable every later
+                # comparison), just cut the lr and hope
+                for gd in self.workflow.gds:
+                    if gd is not None:
+                        gd.lr_scale *= self.lr_cut
+                self.warning(
+                    "loss blow-up before any good epoch: no stash to "
+                    "restore; learning rates cut by %.3g", self.lr_cut)
             return
         if self._best_loss is None or loss < self._best_loss:
             self._best_loss = loss

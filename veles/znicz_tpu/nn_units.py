@@ -177,6 +177,11 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         self.gradient_moment = kwargs.get("gradient_moment", 0.0)
         self.gradient_moment_bias = kwargs.get(
             "gradient_moment_bias", self.gradient_moment)
+        #: host-adjustable multiplier applied AFTER the lr policy
+        #: (NNRollback's lr cut uses this: policies like
+        #: ArbitraryStepPolicy replace the base lr, so cutting
+        #: ``learning_rate`` alone would be a silent no-op)
+        self.lr_scale = 1.0
         #: accumulate gradients over N steps before applying
         self.accumulate_gradient = int(kwargs.get("accumulate_gradient", 1))
         # lr schedules (SURVEY.md §2.4 "LR scheduling"): pure policies
@@ -251,6 +256,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             "l1_vs_l2_bias": numpy.float32(self.l1_vs_l2_bias),
             "moment": numpy.float32(self.gradient_moment),
             "moment_bias": numpy.float32(self.gradient_moment_bias),
+            "lr_scale": numpy.float32(self.lr_scale),
         }
         # ZeroFiller mask rides along as a traced input (not a baked
         # constant) so host-side mask edits reach the compiled step
@@ -297,9 +303,10 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         f = self.forward
         t = int(self.iteration.map_read().mem) if self.iteration else 0
         lr_w = self._scheduled_lr(numpy, self.lr_policy,
-                                  self.learning_rate, t)
+                                  self.learning_rate, t) * self.lr_scale
         lr_b = self._scheduled_lr(numpy, self.lr_policy_bias,
-                                  self.learning_rate_bias, t)
+                                  self.learning_rate_bias, t) \
+            * self.lr_scale
         accumulating = self.accumulate_gradient > 1
         apply_now = True
         acc_w = acc_b = None
@@ -346,9 +353,10 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         params = ctx.unit_params(f)
         state = ctx.unit_state(self)
         t = state["iteration"]
-        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t)
+        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t) \
+            * h["lr_scale"]
         lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
-                                  h["lr_bias"], t)
+                                  h["lr_bias"], t) * h["lr_scale"]
         ctx.update_state(self, iteration=(t + 1).astype(jnp.int32))
         accumulating = self.accumulate_gradient > 1
         apply_now = True

@@ -31,6 +31,11 @@ class EvaluatorBase(AcceleratedUnit):
         #: host metrics for Decision
         self.loss = 0.0
         self.n_err = 0
+        #: worst sample of the last minibatch (reference max-error
+        #: tracking [U]; consumed by ImageSaver): per-sample loss of
+        #: the worst valid row + its minibatch-local position
+        self.max_err = 0.0
+        self.max_err_idx = 0
 
     def initialize(self, device=None, **kwargs):
         super().initialize(device=device, **kwargs)
@@ -40,7 +45,15 @@ class EvaluatorBase(AcceleratedUnit):
 
     def metric_sinks(self):
         """Where XLAStep publishes step outputs on the host unit."""
-        return [("n_err", "n_err"), ("loss", "loss")]
+        return [("n_err", "n_err"), ("loss", "loss"),
+                ("max_err", "max_err"), ("max_err_idx", "max_err_idx")]
+
+    @staticmethod
+    def _worst(xp, per_sample, fmask):
+        """(max loss, argmax) over VALID rows; deterministic
+        first-occurrence tie-break in both backends."""
+        masked = per_sample * fmask
+        return xp.max(masked), xp.argmax(masked)
 
 
 class EvaluatorSoftmax(EvaluatorBase):
@@ -62,6 +75,12 @@ class EvaluatorSoftmax(EvaluatorBase):
             self.confusion_matrix.reset(
                 numpy.zeros((n_classes, n_classes), numpy.int32))
 
+    def metric_sinks(self):
+        sinks = super().metric_sinks()
+        if self.compute_confusion:
+            sinks.append(("confusion", "confusion_matrix"))
+        return sinks
+
     # shared math ------------------------------------------------------
 
     def _compute(self, xp, probs, labels, max_idx, valid):
@@ -75,7 +94,14 @@ class EvaluatorSoftmax(EvaluatorBase):
         logp = xp.log(xp.maximum(p_true, 1e-30))
         loss = -xp.sum(logp * fmask) / valid.astype(probs.dtype)
         wrong = xp.sum((max_idx != labels) & mask)
-        return err, loss, wrong
+        max_err, max_idx_b = self._worst(xp, -logp, fmask)
+        conf = None
+        if self.compute_confusion:
+            pred_oh = (max_idx[:, None] ==
+                       xp.arange(n_classes)[None, :]).astype(probs.dtype)
+            conf = ((pred_oh * fmask[:, None]).T @ onehot) \
+                .astype(xp.int32)
+        return err, loss, wrong, max_err, max_idx_b, conf
 
     # oracle -----------------------------------------------------------
 
@@ -84,17 +110,17 @@ class EvaluatorSoftmax(EvaluatorBase):
         labels = numpy.asarray(self.labels.map_read().mem, numpy.int32)
         max_idx = numpy.argmax(probs, axis=-1).astype(numpy.int32)
         valid = numpy.int32(int(self.batch_size))
-        err, loss, wrong = self._compute(
+        err, loss, wrong, max_err, max_err_idx, conf = self._compute(
             numpy, probs.astype(numpy.float32), labels, max_idx, valid)
         self.err_output.map_invalidate()
         self.err_output.mem[...] = err
         self.loss = float(loss)
         self.n_err = int(wrong)
-        if self.compute_confusion:
+        self.max_err = float(max_err)
+        self.max_err_idx = int(max_err_idx)
+        if conf is not None:
             self.confusion_matrix.map_write()
-            m = self.confusion_matrix.mem
-            for i in range(int(valid)):
-                m[max_idx[i], labels[i]] += 1
+            self.confusion_matrix.mem += conf
 
     # traced -----------------------------------------------------------
 
@@ -104,11 +130,15 @@ class EvaluatorSoftmax(EvaluatorBase):
         labels = ctx.get(self, "labels").astype(jnp.int32)
         max_idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)
         valid = ctx.get(self, "batch_size")  # traced int scalar
-        err, loss, wrong = self._compute(
+        err, loss, wrong, max_err, max_err_idx, conf = self._compute(
             jnp, probs, labels, max_idx, valid)
         ctx.set(self, "err_output", err)
         ctx.export("loss", loss)
         ctx.export("n_err", wrong.astype(jnp.int32))
+        ctx.export("max_err", max_err)
+        ctx.export("max_err_idx", max_err_idx.astype(jnp.int32))
+        if conf is not None:
+            ctx.export("confusion", conf)
 
 
 class EvaluatorMSE(EvaluatorBase):
@@ -132,28 +162,33 @@ class EvaluatorMSE(EvaluatorBase):
         err = 2.0 * diff / valid.astype(y2.dtype)
         per_sample = xp.mean(diff * diff, axis=1)
         mse = xp.sum(per_sample) / valid.astype(y2.dtype)
-        return err, mse
+        max_err, max_idx = self._worst(xp, per_sample, fmask)
+        return err, mse, max_err, max_idx
 
     def numpy_run(self):
         y = self.input.map_read().mem.astype(numpy.float32)
         t = self.target.map_read().mem.astype(numpy.float32)
         valid = numpy.float32(int(self.batch_size))
-        err, mse = self._compute(numpy, y, t, valid)
+        err, mse, max_err, max_err_idx = self._compute(numpy, y, t, valid)
         self.err_output.map_invalidate()
         self.err_output.mem[...] = err.reshape(self.err_output.shape)
         self.mse = float(mse)
         self.loss = float(mse)
         self.n_err = 0
+        self.max_err = float(max_err)
+        self.max_err_idx = int(max_err_idx)
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
         y = ctx.get(self, "input")
         t = ctx.get(self, "target")
         valid = ctx.get(self, "batch_size").astype(jnp.float32)
-        err, mse = self._compute(jnp, y, t, valid)
+        err, mse, max_err, max_err_idx = self._compute(jnp, y, t, valid)
         ctx.set(self, "err_output", err.reshape(y.shape))
         ctx.export("loss", mse)
         ctx.export("n_err", jnp.int32(0))
+        ctx.export("max_err", max_err)
+        ctx.export("max_err_idx", max_err_idx.astype(jnp.int32))
 
 
 class EvaluatorLM(EvaluatorBase):

@@ -76,7 +76,14 @@ class StandardWorkflowBase(NNWorkflow):
         prev_unit, prev_attr = src, src_attr
         for spec in self.layers_config:
             cls = forward_by_name(spec["type"])
-            fwd = cls(self, **spec.get("->", {}))
+            kwargs = dict(spec.get("->", {}))
+            # an int output_shape_source names an earlier layer by
+            # index (autoencoders pin deconv/depooling output sizes to
+            # the mirrored forward's INPUT shape, reference-style [U])
+            if isinstance(kwargs.get("output_shape_source"), int):
+                kwargs["output_shape_source"] = \
+                    self.forwards[kwargs["output_shape_source"]]
+            fwd = cls(self, **kwargs)
             fwd.link_from(prev_unit)
             fwd.link_attrs(prev_unit, ("input", prev_attr))
             self.forwards.append(fwd)

@@ -487,6 +487,16 @@ class XLAStep(Unit):
                 if key not in outputs:
                     continue
                 value = outputs[key]
+                if getattr(value, "ndim", 0):
+                    # array metric (e.g. confusion matrix): ACCUMULATE
+                    # into the unit's host Array, matching the numpy
+                    # oracle's `mem += counts` semantics
+                    arr = getattr(unit, attr, None)
+                    if arr is not None and hasattr(arr, "map_write") \
+                            and arr:
+                        arr.map_write()
+                        arr.mem += numpy.asarray(value)
+                    continue
                 value = float(value) if hasattr(value, "dtype") \
                     and value.dtype.kind == "f" else int(value)
                 setattr(unit, attr, value)
@@ -501,7 +511,8 @@ class XLAStep(Unit):
         linked after initialize still works)."""
         return self.scan_mode and (
             self._keep_entry_requested
-            or getattr(self.workflow, "snapshotter", None) is not None)
+            or getattr(self.workflow, "snapshotter", None) is not None
+            or getattr(self.workflow, "rollback", None) is not None)
 
     def snapshot_view(self, at_valid=False):
         """A CONSISTENT (params, state, step_index) triple.
