@@ -1,0 +1,56 @@
+// Minimal JSON value + recursive-descent parser — just enough to read
+// the workflow archive's contents.json (SURVEY.md §2.6 libVeles:
+// "loads a workflow archive ... contents.json topology"). No external
+// deps by design: the engine must build standalone.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace veles {
+namespace json {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+class Value {
+ public:
+  enum class Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Type type = Type::kNull;
+  bool bool_v = false;
+  double num_v = 0.0;
+  std::string str_v;
+  std::vector<ValuePtr> arr_v;
+  std::map<std::string, ValuePtr> obj_v;
+
+  bool is_null() const { return type == Type::kNull; }
+  bool AsBool() const { return bool_v; }
+  double AsDouble() const { return num_v; }
+  int64_t AsInt() const { return static_cast<int64_t>(num_v); }
+  const std::string& AsString() const { return str_v; }
+
+  // object access; throws on missing key
+  const Value& at(const std::string& key) const;
+  // object access with default-null
+  ValuePtr get(const std::string& key) const;
+  bool has(const std::string& key) const {
+    return obj_v.count(key) != 0;
+  }
+  size_t size() const { return arr_v.size(); }
+  const Value& operator[](size_t i) const { return *arr_v.at(i); }
+
+  std::vector<int64_t> AsIntVector() const;
+};
+
+// Parses a complete JSON document; throws std::runtime_error on error.
+ValuePtr Parse(const std::string& text);
+
+// Reads a file and parses it.
+ValuePtr ParseFile(const std::string& path);
+
+}  // namespace json
+}  // namespace veles

@@ -1,0 +1,24 @@
+// SIMD matrix primitives — the rebuild of the reference's veles-simd
+// submodule (SURVEY.md §2.6: "SIMD primitive library used by libZnicz:
+// matrix multiply, elementwise — SSE/AVX + ARM NEON paths").
+//
+// One gemm serves both the dense layers and the im2col'd convolutions,
+// exactly the reference's structure (§2.5: one tiled GEMM reused by
+// all2all AND conv). AVX2+FMA is used when the compiler targets it
+// (-march native/haswell+); the scalar path is always correct.
+#pragma once
+
+#include <cstdint>
+
+namespace veles {
+
+// c[m, n] = a[m, k] @ b[k, n]          (b_transposed = false)
+// c[m, n] = a[m, k] @ b[n, k]^T        (b_transposed = true)
+// Row-major, c is overwritten.
+void Gemm(const float* a, const float* b, float* c,
+          int64_t m, int64_t k, int64_t n, bool b_transposed);
+
+// y[i] += bias broadcast over rows: y is (m, n), bias is (n,)
+void AddBias(float* y, const float* bias, int64_t m, int64_t n);
+
+}  // namespace veles

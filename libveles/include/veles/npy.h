@@ -1,0 +1,23 @@
+// Minimal .npy (NumPy format v1.0/2.0) reader + writer for float32 /
+// int32, C-order — the weight/fixture interchange format between the
+// Python trainer and this engine (SURVEY.md §3.5: "NumpyArrayLoader
+// reads weights"; the reference shipped .npy inside its workflow
+// archive, and so do we).
+#pragma once
+
+#include <string>
+
+#include "veles/tensor.h"
+
+namespace veles {
+namespace npy {
+
+// Loads a .npy file. Accepts '<f4' (read directly) and '<i4'/'<i8'
+// (converted to float). Throws std::runtime_error on malformed input.
+Tensor Load(const std::string& path);
+
+// Saves float32 C-order v1.0 .npy.
+void Save(const std::string& path, const Tensor& t);
+
+}  // namespace npy
+}  // namespace veles

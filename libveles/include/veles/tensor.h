@@ -1,0 +1,55 @@
+// Tensor — contiguous row-major float/int32 buffer with a shape.
+//
+// TPU-era rebuild of the reference C++ inference engine's array types
+// (SURVEY.md §2.6 libVeles: WorkflowLoader/NumpyArrayLoader operate on
+// raw float buffers). Layout is NHWC everywhere, matching the Python
+// side (veles/znicz_tpu/ops/conv_math.py docstring).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles {
+
+class Tensor {
+ public:
+  Tensor() = default;
+  explicit Tensor(std::vector<int64_t> shape) { Reset(std::move(shape)); }
+
+  void Reset(std::vector<int64_t> shape) {
+    shape_ = std::move(shape);
+    data_.assign(static_cast<size_t>(NumElements()), 0.0f);
+  }
+
+  int64_t NumElements() const {
+    int64_t n = 1;
+    for (int64_t d : shape_) n *= d;
+    return n;
+  }
+
+  const std::vector<int64_t>& shape() const { return shape_; }
+  int64_t dim(size_t i) const { return shape_.at(i); }
+  size_t rank() const { return shape_.size(); }
+
+  float* data() { return data_.data(); }
+  const float* data() const { return data_.data(); }
+
+  std::string ShapeString() const {
+    std::string s = "(";
+    for (size_t i = 0; i < shape_.size(); ++i) {
+      if (i) s += ", ";
+      s += std::to_string(shape_[i]);
+    }
+    return s + ")";
+  }
+
+ private:
+  std::vector<int64_t> shape_;
+  std::vector<float> data_;
+};
+
+}  // namespace veles

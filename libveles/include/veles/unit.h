@@ -1,0 +1,66 @@
+// Unit + UnitFactory — the engine's forward-op registry.
+//
+// Rebuild of libVeles `Unit`/`UnitFactory` + libZnicz's unit
+// implementations (SURVEY.md §2.6, §3.5: "UnitFactory::Create(
+// 'All2AllTanh') ... Workflow::Execute(input)"). Type names match the
+// Python registry (veles/znicz_tpu/nn_units.py forward_unit names) so
+// contents.json maps 1:1.
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "veles/json.h"
+#include "veles/tensor.h"
+
+namespace veles {
+
+class Unit {
+ public:
+  virtual ~Unit() = default;
+
+  // Loads config + weights; `dir` is the archive directory for
+  // resolving relative .npy paths.
+  virtual void Configure(const json::Value& spec, const std::string& dir) {}
+
+  virtual void Execute(const Tensor& in, Tensor* out) const = 0;
+
+  const std::string& name() const { return name_; }
+  void set_name(std::string n) { name_ = std::move(n); }
+
+ private:
+  std::string name_;
+};
+
+using UnitPtr = std::unique_ptr<Unit>;
+
+class UnitFactory {
+ public:
+  using Creator = std::function<UnitPtr()>;
+
+  static UnitFactory& Instance();
+
+  void Register(const std::string& type, Creator creator);
+  UnitPtr Create(const std::string& type) const;
+  bool Knows(const std::string& type) const {
+    return creators_.count(type) != 0;
+  }
+
+ private:
+  std::map<std::string, Creator> creators_;
+};
+
+// Registration helper:
+//   VELES_REGISTER_UNIT("all2all_tanh", All2AllTanh);
+#define VELES_REGISTER_UNIT(type_name, cls)                        \
+  namespace {                                                      \
+  const bool cls##_registered_ = [] {                              \
+    ::veles::UnitFactory::Instance().Register(                     \
+        type_name, [] { return ::veles::UnitPtr(new cls()); });    \
+    return true;                                                   \
+  }();                                                             \
+  }
+
+}  // namespace veles

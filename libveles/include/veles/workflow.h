@@ -1,0 +1,45 @@
+// WorkflowLoader + Workflow — the libVeles rebuild (SURVEY.md §2.6,
+// §3.5): loads the archive exported by the Python side
+// (veles/export_inference.py — contents.json topology + .npy weights)
+// and executes the forward chain with no Python at runtime.
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "veles/unit.h"
+
+namespace veles {
+
+class Workflow {
+ public:
+  // Runs the unit chain; `in` is a (B, ...) batch.
+  void Execute(const Tensor& in, Tensor* out) const;
+
+  void Append(UnitPtr unit) { units_.push_back(std::move(unit)); }
+  size_t size() const { return units_.size(); }
+  const Unit& unit(size_t i) const { return *units_.at(i); }
+
+  const std::string& name() const { return name_; }
+  void set_name(std::string n) { name_ = std::move(n); }
+
+  const std::vector<int64_t>& input_sample_shape() const {
+    return input_sample_shape_;
+  }
+  void set_input_sample_shape(std::vector<int64_t> s) {
+    input_sample_shape_ = std::move(s);
+  }
+
+ private:
+  std::string name_;
+  std::vector<int64_t> input_sample_shape_;
+  std::vector<UnitPtr> units_;
+};
+
+class WorkflowLoader {
+ public:
+  // `dir` contains contents.json plus the referenced .npy files.
+  static Workflow Load(const std::string& dir);
+};
+
+}  // namespace veles

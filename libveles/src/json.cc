@@ -1,0 +1,218 @@
+#include "veles/json.h"
+
+#include <cctype>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+namespace veles {
+namespace json {
+
+const Value& Value::at(const std::string& key) const {
+  auto it = obj_v.find(key);
+  if (it == obj_v.end())
+    throw std::runtime_error("json: missing key '" + key + "'");
+  return *it->second;
+}
+
+ValuePtr Value::get(const std::string& key) const {
+  auto it = obj_v.find(key);
+  if (it == obj_v.end()) return std::make_shared<Value>();
+  return it->second;
+}
+
+std::vector<int64_t> Value::AsIntVector() const {
+  std::vector<int64_t> out;
+  out.reserve(arr_v.size());
+  for (const auto& v : arr_v) out.push_back(v->AsInt());
+  return out;
+}
+
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  ValuePtr ParseDocument() {
+    ValuePtr v = ParseValue();
+    SkipWs();
+    if (pos_ != s_.size()) Fail("trailing characters");
+    return v;
+  }
+
+ private:
+  void Fail(const std::string& msg) {
+    throw std::runtime_error("json parse error at offset " +
+                             std::to_string(pos_) + ": " + msg);
+  }
+
+  void SkipWs() {
+    while (pos_ < s_.size() && std::isspace(
+        static_cast<unsigned char>(s_[pos_]))) ++pos_;
+  }
+
+  char Peek() {
+    if (pos_ >= s_.size()) Fail("unexpected end");
+    return s_[pos_];
+  }
+
+  void Expect(char c) {
+    if (Peek() != c) Fail(std::string("expected '") + c + "'");
+    ++pos_;
+  }
+
+  ValuePtr ParseValue() {
+    SkipWs();
+    char c = Peek();
+    switch (c) {
+      case '{': return ParseObject();
+      case '[': return ParseArray();
+      case '"': return ParseString();
+      case 't': case 'f': return ParseBool();
+      case 'n': return ParseNull();
+      default: return ParseNumber();
+    }
+  }
+
+  ValuePtr ParseObject() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::kObject;
+    Expect('{');
+    SkipWs();
+    if (Peek() == '}') { ++pos_; return v; }
+    while (true) {
+      SkipWs();
+      ValuePtr key = ParseString();
+      SkipWs();
+      Expect(':');
+      v->obj_v[key->str_v] = ParseValue();
+      SkipWs();
+      if (Peek() == ',') { ++pos_; continue; }
+      Expect('}');
+      break;
+    }
+    return v;
+  }
+
+  ValuePtr ParseArray() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::kArray;
+    Expect('[');
+    SkipWs();
+    if (Peek() == ']') { ++pos_; return v; }
+    while (true) {
+      v->arr_v.push_back(ParseValue());
+      SkipWs();
+      if (Peek() == ',') { ++pos_; continue; }
+      Expect(']');
+      break;
+    }
+    return v;
+  }
+
+  ValuePtr ParseString() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::kString;
+    Expect('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) Fail("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) Fail("bad escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) Fail("bad \\u escape");
+            unsigned code = std::strtoul(
+                s_.substr(pos_, 4).c_str(), nullptr, 16);
+            pos_ += 4;
+            // BMP-only UTF-8 encode (enough for config strings)
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: Fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    v->str_v = std::move(out);
+    return v;
+  }
+
+  ValuePtr ParseBool() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::kBool;
+    if (s_.compare(pos_, 4, "true") == 0) {
+      v->bool_v = true;
+      pos_ += 4;
+    } else if (s_.compare(pos_, 5, "false") == 0) {
+      v->bool_v = false;
+      pos_ += 5;
+    } else {
+      Fail("bad literal");
+    }
+    return v;
+  }
+
+  ValuePtr ParseNull() {
+    if (s_.compare(pos_, 4, "null") != 0) Fail("bad literal");
+    pos_ += 4;
+    return std::make_shared<Value>();
+  }
+
+  ValuePtr ParseNumber() {
+    size_t start = pos_;
+    while (pos_ < s_.size() &&
+           (std::isdigit(static_cast<unsigned char>(s_[pos_])) ||
+            s_[pos_] == '-' || s_[pos_] == '+' || s_[pos_] == '.' ||
+            s_[pos_] == 'e' || s_[pos_] == 'E'))
+      ++pos_;
+    if (pos_ == start) Fail("bad number");
+    auto v = std::make_shared<Value>();
+    v->type = Value::Type::kNumber;
+    v->num_v = std::strtod(s_.substr(start, pos_ - start).c_str(), nullptr);
+    return v;
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+}  // namespace
+
+ValuePtr Parse(const std::string& text) {
+  return Parser(text).ParseDocument();
+}
+
+ValuePtr ParseFile(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return Parse(ss.str());
+}
+
+}  // namespace json
+}  // namespace veles

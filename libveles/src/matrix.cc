@@ -1,0 +1,107 @@
+#include "veles/matrix.h"
+
+#include <cstring>
+#include <vector>
+
+#if defined(__AVX2__) && defined(__FMA__)
+#include <immintrin.h>
+#endif
+
+namespace veles {
+namespace {
+
+// Panel sizes chosen for L1/L2 residency on a generic x86 core; the
+// reference tuned BLOCK_SIZE per GPU from a device database
+// (SURVEY.md §2.5) — a CPU inference engine only needs one sane tile.
+constexpr int64_t kMc = 64;   // rows of A per panel
+constexpr int64_t kNc = 256;  // cols of B per panel
+constexpr int64_t kKc = 256;  // depth per panel
+
+#if defined(__AVX2__) && defined(__FMA__)
+
+// Inner kernel: c_row[0:n) += a_val * b_row[0:n) with 8-wide FMA.
+inline void AxpyRow(float a_val, const float* b_row, float* c_row,
+                    int64_t n) {
+  __m256 av = _mm256_set1_ps(a_val);
+  int64_t j = 0;
+  for (; j + 8 <= n; j += 8) {
+    __m256 c = _mm256_loadu_ps(c_row + j);
+    __m256 b = _mm256_loadu_ps(b_row + j);
+    _mm256_storeu_ps(c_row + j, _mm256_fmadd_ps(av, b, c));
+  }
+  for (; j < n; ++j) c_row[j] += a_val * b_row[j];
+}
+
+inline float DotRow(const float* a, const float* b, int64_t k) {
+  __m256 acc = _mm256_setzero_ps();
+  int64_t i = 0;
+  for (; i + 8 <= k; i += 8) {
+    acc = _mm256_fmadd_ps(_mm256_loadu_ps(a + i),
+                          _mm256_loadu_ps(b + i), acc);
+  }
+  alignas(32) float lanes[8];
+  _mm256_store_ps(lanes, acc);
+  float s = lanes[0] + lanes[1] + lanes[2] + lanes[3] +
+            lanes[4] + lanes[5] + lanes[6] + lanes[7];
+  for (; i < k; ++i) s += a[i] * b[i];
+  return s;
+}
+
+#else
+
+inline void AxpyRow(float a_val, const float* b_row, float* c_row,
+                    int64_t n) {
+  for (int64_t j = 0; j < n; ++j) c_row[j] += a_val * b_row[j];
+}
+
+inline float DotRow(const float* a, const float* b, int64_t k) {
+  float s = 0.0f;
+  for (int64_t i = 0; i < k; ++i) s += a[i] * b[i];
+  return s;
+}
+
+#endif
+
+}  // namespace
+
+void Gemm(const float* a, const float* b, float* c,
+          int64_t m, int64_t k, int64_t n, bool b_transposed) {
+  if (b_transposed) {
+    // c[i, j] = dot(a_row_i, b_row_j): both operands stream
+    // contiguously — no packing needed.
+    for (int64_t i = 0; i < m; ++i) {
+      const float* ai = a + i * k;
+      float* ci = c + i * n;
+      for (int64_t j = 0; j < n; ++j) ci[j] = DotRow(ai, b + j * k, k);
+    }
+    return;
+  }
+  std::memset(c, 0, sizeof(float) * m * n);
+  // Blocked SAXPY formulation: C[i, :] += A[i, p] * B[p, :], panels
+  // keep the streamed B rows hot in cache.
+  for (int64_t p0 = 0; p0 < k; p0 += kKc) {
+    int64_t p1 = p0 + kKc < k ? p0 + kKc : k;
+    for (int64_t j0 = 0; j0 < n; j0 += kNc) {
+      int64_t j1 = j0 + kNc < n ? j0 + kNc : n;
+      for (int64_t i0 = 0; i0 < m; i0 += kMc) {
+        int64_t i1 = i0 + kMc < m ? i0 + kMc : m;
+        for (int64_t i = i0; i < i1; ++i) {
+          const float* ai = a + i * k;
+          float* ci = c + i * n;
+          for (int64_t p = p0; p < p1; ++p) {
+            AxpyRow(ai[p], b + p * n + j0, ci + j0, j1 - j0);
+          }
+        }
+      }
+    }
+  }
+}
+
+void AddBias(float* y, const float* bias, int64_t m, int64_t n) {
+  for (int64_t i = 0; i < m; ++i) {
+    float* row = y + i * n;
+    for (int64_t j = 0; j < n; ++j) row[j] += bias[j];
+  }
+}
+
+}  // namespace veles

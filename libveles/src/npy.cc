@@ -1,0 +1,133 @@
+#include "veles/npy.h"
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <vector>
+
+namespace veles {
+namespace npy {
+namespace {
+
+const char kMagic[] = "\x93NUMPY";
+
+std::string ReadHeader(std::ifstream& f, const std::string& path) {
+  char magic[6];
+  f.read(magic, 6);
+  if (!f || std::memcmp(magic, kMagic, 6) != 0)
+    throw std::runtime_error(path + ": not a .npy file");
+  unsigned char ver[2];
+  f.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t header_len = 0;
+  if (ver[0] == 1) {
+    unsigned char b[2];
+    f.read(reinterpret_cast<char*>(b), 2);
+    header_len = b[0] | (b[1] << 8);
+  } else {
+    unsigned char b[4];
+    f.read(reinterpret_cast<char*>(b), 4);
+    header_len = b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24);
+  }
+  std::string header(header_len, '\0');
+  f.read(&header[0], header_len);
+  if (!f) throw std::runtime_error(path + ": truncated .npy header");
+  return header;
+}
+
+// Pulls "'key': value" out of the header dict (values are simple
+// enough that full dict parsing is overkill).
+std::string DictValue(const std::string& header, const std::string& key) {
+  size_t pos = header.find("'" + key + "'");
+  if (pos == std::string::npos)
+    throw std::runtime_error(".npy header missing key " + key);
+  pos = header.find(':', pos);
+  size_t end = pos + 1;
+  int depth = 0;
+  while (end < header.size()) {
+    char c = header[end];
+    if (c == '(' || c == '[') ++depth;
+    if (c == ')' || c == ']') --depth;
+    if (depth == 0 && (c == ',' || c == '}')) break;
+    ++end;
+  }
+  std::string v = header.substr(pos + 1, end - pos - 1);
+  size_t a = v.find_first_not_of(" \t");
+  size_t b = v.find_last_not_of(" \t");
+  return a == std::string::npos ? "" : v.substr(a, b - a + 1);
+}
+
+std::vector<int64_t> ParseShape(const std::string& s) {
+  std::vector<int64_t> shape;
+  std::string digits;
+  for (char c : s) {
+    if (c >= '0' && c <= '9') {
+      digits += c;
+    } else if (!digits.empty()) {
+      shape.push_back(std::stoll(digits));
+      digits.clear();
+    }
+  }
+  if (!digits.empty()) shape.push_back(std::stoll(digits));
+  return shape;  // empty = 0-d scalar
+}
+
+}  // namespace
+
+Tensor Load(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::string header = ReadHeader(f, path);
+  std::string descr = DictValue(header, "descr");
+  if (DictValue(header, "fortran_order").find("True") != std::string::npos)
+    throw std::runtime_error(path + ": fortran_order unsupported");
+  std::vector<int64_t> shape = ParseShape(DictValue(header, "shape"));
+  Tensor t(shape.empty() ? std::vector<int64_t>{1} : shape);
+  int64_t n = t.NumElements();
+  if (descr.find("f4") != std::string::npos) {
+    f.read(reinterpret_cast<char*>(t.data()), n * 4);
+  } else if (descr.find("i4") != std::string::npos ||
+             descr.find("u4") != std::string::npos) {
+    std::vector<int32_t> raw(n);
+    f.read(reinterpret_cast<char*>(raw.data()), n * 4);
+    for (int64_t i = 0; i < n; ++i) t.data()[i] = static_cast<float>(raw[i]);
+  } else if (descr.find("i8") != std::string::npos) {
+    std::vector<int64_t> raw(n);
+    f.read(reinterpret_cast<char*>(raw.data()), n * 8);
+    for (int64_t i = 0; i < n; ++i) t.data()[i] = static_cast<float>(raw[i]);
+  } else {
+    throw std::runtime_error(path + ": unsupported dtype " + descr);
+  }
+  if (!f) throw std::runtime_error(path + ": truncated .npy data");
+  return t;
+}
+
+void Save(const std::string& path, const Tensor& t) {
+  std::ostringstream shape;
+  shape << "(";
+  for (size_t i = 0; i < t.rank(); ++i) {
+    shape << t.shape()[i] << (t.rank() == 1 || i + 1 < t.rank() ? "," : "");
+    if (i + 1 < t.rank()) shape << " ";
+  }
+  shape << ")";
+  std::string header = "{'descr': '<f4', 'fortran_order': False, "
+                       "'shape': " + shape.str() + ", }";
+  // pad so magic(6)+ver(2)+len(2)+header is a multiple of 64
+  size_t total = 10 + header.size() + 1;
+  header += std::string((64 - total % 64) % 64, ' ');
+  header += '\n';
+  std::ofstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot write " + path);
+  f.write(kMagic, 6);
+  char ver[2] = {1, 0};
+  f.write(ver, 2);
+  uint16_t len = static_cast<uint16_t>(header.size());
+  char lenb[2] = {static_cast<char>(len & 0xff),
+                  static_cast<char>(len >> 8)};
+  f.write(lenb, 2);
+  f.write(header.data(), header.size());
+  f.write(reinterpret_cast<const char*>(t.data()), t.NumElements() * 4);
+}
+
+}  // namespace npy
+}  // namespace veles

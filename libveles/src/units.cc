@@ -1,0 +1,385 @@
+// The libZnicz rebuild: forward unit implementations on the SIMD gemm
+// (SURVEY.md §2.6 libZnicz "C++ implementations of znicz forward units
+// for libVeles"). Formulas and layouts mirror the Python ops exactly:
+//
+//   all2all  — veles/znicz_tpu/ops/all2all.py (W is (fan_in, neurons),
+//              or (neurons, fan_in) when weights_transposed)
+//   conv     — veles/znicz_tpu/ops/conv.py (W is (n_kernels, ky*kx*C),
+//              im2col patch order (ky, kx, C), NHWC)
+//   pooling  — veles/znicz_tpu/ops/pooling.py (ceil output size,
+//              bottom/right edge windows clipped)
+//   lrn      — veles/znicz_tpu/ops/normalization.py
+//   activations — veles/znicz_tpu/ops/activations.py (incl. the
+//              1.7159*tanh(2x/3) scaled tanh)
+
+#include <algorithm>
+#include <cmath>
+#include <limits>
+#include <stdexcept>
+#include <vector>
+
+#include "veles/matrix.h"
+#include "veles/npy.h"
+#include "veles/unit.h"
+
+namespace veles {
+namespace {
+
+constexpr float kTanhA = 1.7159f;
+constexpr float kTanhB = 2.0f / 3.0f;
+
+enum class Act { kLinear, kTanh, kRelu, kStrictRelu, kSigmoid, kSoftmax };
+
+void ApplyActivation(Act act, float* y, int64_t rows, int64_t cols) {
+  int64_t n = rows * cols;
+  switch (act) {
+    case Act::kLinear:
+      return;
+    case Act::kTanh:
+      for (int64_t i = 0; i < n; ++i)
+        y[i] = kTanhA * std::tanh(kTanhB * y[i]);
+      return;
+    case Act::kRelu:  // soft relu: log(1 + e^x), overflow-safe
+      for (int64_t i = 0; i < n; ++i)
+        y[i] = y[i] > 0 ? y[i] + std::log1p(std::exp(-y[i]))
+                        : std::log1p(std::exp(y[i]));
+      return;
+    case Act::kStrictRelu:
+      for (int64_t i = 0; i < n; ++i) y[i] = std::max(y[i], 0.0f);
+      return;
+    case Act::kSigmoid:
+      for (int64_t i = 0; i < n; ++i)
+        y[i] = 0.5f * (std::tanh(0.5f * y[i]) + 1.0f);
+      return;
+    case Act::kSoftmax:
+      for (int64_t r = 0; r < rows; ++r) {
+        float* row = y + r * cols;
+        float mx = row[0];
+        for (int64_t j = 1; j < cols; ++j) mx = std::max(mx, row[j]);
+        float sum = 0.0f;
+        for (int64_t j = 0; j < cols; ++j) {
+          row[j] = std::exp(row[j] - mx);
+          sum += row[j];
+        }
+        for (int64_t j = 0; j < cols; ++j) row[j] /= sum;
+      }
+      return;
+  }
+}
+
+std::string ResolvePath(const std::string& dir, const std::string& rel) {
+  return dir.empty() ? rel : dir + "/" + rel;
+}
+
+// -- dense ------------------------------------------------------------
+
+class All2All : public Unit {
+ public:
+  explicit All2All(Act act = Act::kLinear) : act_(act) {}
+
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    weights_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    if (!spec.get("bias")->is_null()) {
+      bias_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
+      has_bias_ = true;
+    }
+    transposed_ = spec.get("weights_transposed")->AsBool();
+    const json::Value& cfg = spec.at("config");
+    neurons_ = cfg.at("neurons").AsInt();
+    int64_t fan_in = transposed_ ? weights_.dim(1) : weights_.dim(0);
+    int64_t w_neurons = transposed_ ? weights_.dim(0) : weights_.dim(1);
+    if (w_neurons != neurons_)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    fan_in_ = fan_in;
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    int64_t b = in.dim(0);
+    if (in.NumElements() != b * fan_in_)
+      throw std::runtime_error(name() + ": bad input size " +
+                               in.ShapeString());
+    out->Reset({b, neurons_});
+    // transposed_: W is (neurons, fan_in) and y = x @ W^T; otherwise W
+    // is (fan_in, neurons) and y = x @ W
+    Gemm(in.data(), weights_.data(), out->data(), b, fan_in_, neurons_,
+         transposed_);
+    if (has_bias_) AddBias(out->data(), bias_.data(), b, neurons_);
+    ApplyActivation(act_, out->data(), b, neurons_);
+  }
+
+ private:
+  Act act_;
+  Tensor weights_, bias_;
+  bool has_bias_ = false;
+  bool transposed_ = false;
+  int64_t neurons_ = 0, fan_in_ = 0;
+};
+
+struct All2AllLinear : All2All { All2AllLinear() : All2All(Act::kLinear) {} };
+struct All2AllTanh : All2All { All2AllTanh() : All2All(Act::kTanh) {} };
+struct All2AllRelu : All2All { All2AllRelu() : All2All(Act::kRelu) {} };
+struct All2AllStrictRelu : All2All {
+  All2AllStrictRelu() : All2All(Act::kStrictRelu) {}
+};
+struct All2AllSigmoid : All2All {
+  All2AllSigmoid() : All2All(Act::kSigmoid) {}
+};
+struct All2AllSoftmax : All2All {
+  All2AllSoftmax() : All2All(Act::kSoftmax) {}
+};
+
+VELES_REGISTER_UNIT("all2all", All2AllLinear)
+VELES_REGISTER_UNIT("all2all_tanh", All2AllTanh)
+VELES_REGISTER_UNIT("all2all_relu", All2AllRelu)
+VELES_REGISTER_UNIT("all2all_str", All2AllStrictRelu)
+VELES_REGISTER_UNIT("all2all_sigmoid", All2AllSigmoid)
+VELES_REGISTER_UNIT("softmax", All2AllSoftmax)
+
+// -- convolution -------------------------------------------------------
+
+struct Pad4 { int64_t top, bottom, left, right; };
+
+Pad4 ReadPadding(const json::Value& cfg) {
+  std::vector<int64_t> p = cfg.at("padding").AsIntVector();
+  return {p.at(0), p.at(1), p.at(2), p.at(3)};
+}
+
+class Conv : public Unit {
+ public:
+  explicit Conv(Act act = Act::kLinear) : act_(act) {}
+
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    weights_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    if (!spec.get("bias")->is_null()) {
+      bias_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
+      has_bias_ = true;
+    }
+    const json::Value& cfg = spec.at("config");
+    n_kernels_ = cfg.at("n_kernels").AsInt();
+    ky_ = cfg.at("ky").AsInt();
+    kx_ = cfg.at("kx").AsInt();
+    std::vector<int64_t> s = cfg.at("sliding").AsIntVector();
+    sy_ = s.at(0);
+    sx_ = s.at(1);
+    pad_ = ReadPadding(cfg);
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    if (in.rank() != 4)
+      throw std::runtime_error(name() + ": conv input must be NHWC, got " +
+                               in.ShapeString());
+    int64_t b = in.dim(0), h = in.dim(1), w = in.dim(2), c = in.dim(3);
+    int64_t kkc = ky_ * kx_ * c;
+    if (weights_.dim(0) != n_kernels_ || weights_.dim(1) != kkc)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    int64_t oy = (h + pad_.top + pad_.bottom - ky_) / sy_ + 1;
+    int64_t ox = (w + pad_.left + pad_.right - kx_) / sx_ + 1;
+    // im2col, patch order (ky, kx, C) — conv_math.im2col
+    std::vector<float> cols(static_cast<size_t>(b * oy * ox * kkc), 0.0f);
+    for (int64_t bi = 0; bi < b; ++bi) {
+      const float* img = in.data() + bi * h * w * c;
+      for (int64_t yo = 0; yo < oy; ++yo) {
+        for (int64_t xo = 0; xo < ox; ++xo) {
+          float* patch =
+              cols.data() + ((bi * oy + yo) * ox + xo) * kkc;
+          for (int64_t p = 0; p < ky_; ++p) {
+            int64_t yi = yo * sy_ + p - pad_.top;
+            if (yi < 0 || yi >= h) continue;  // zero padding
+            for (int64_t q = 0; q < kx_; ++q) {
+              int64_t xi = xo * sx_ + q - pad_.left;
+              if (xi < 0 || xi >= w) continue;
+              std::copy_n(img + (yi * w + xi) * c, c,
+                          patch + (p * kx_ + q) * c);
+            }
+          }
+        }
+      }
+    }
+    out->Reset({b, oy, ox, n_kernels_});
+    // v = cols @ W^T, exactly the Python oracle's GEMM
+    Gemm(cols.data(), weights_.data(), out->data(), b * oy * ox, kkc,
+         n_kernels_, /*b_transposed=*/true);
+    if (has_bias_)
+      AddBias(out->data(), bias_.data(), b * oy * ox, n_kernels_);
+    ApplyActivation(act_, out->data(), b * oy * ox, n_kernels_);
+  }
+
+ private:
+  Act act_;
+  Tensor weights_, bias_;
+  bool has_bias_ = false;
+  int64_t n_kernels_ = 0, ky_ = 0, kx_ = 0, sy_ = 1, sx_ = 1;
+  Pad4 pad_{0, 0, 0, 0};
+};
+
+struct ConvLinear : Conv { ConvLinear() : Conv(Act::kLinear) {} };
+struct ConvTanh : Conv { ConvTanh() : Conv(Act::kTanh) {} };
+struct ConvRelu : Conv { ConvRelu() : Conv(Act::kRelu) {} };
+struct ConvStrictRelu : Conv { ConvStrictRelu() : Conv(Act::kStrictRelu) {} };
+struct ConvSigmoid : Conv { ConvSigmoid() : Conv(Act::kSigmoid) {} };
+
+VELES_REGISTER_UNIT("conv", ConvLinear)
+VELES_REGISTER_UNIT("conv_tanh", ConvTanh)
+VELES_REGISTER_UNIT("conv_relu", ConvRelu)
+VELES_REGISTER_UNIT("conv_str", ConvStrictRelu)
+VELES_REGISTER_UNIT("conv_sigmoid", ConvSigmoid)
+
+// -- pooling ------------------------------------------------------------
+
+class Pooling : public Unit {
+ public:
+  explicit Pooling(bool is_max) : is_max_(is_max) {}
+
+  void Configure(const json::Value& spec, const std::string&) override {
+    const json::Value& cfg = spec.at("config");
+    ky_ = cfg.at("ky").AsInt();
+    kx_ = cfg.at("kx").AsInt();
+    std::vector<int64_t> s = cfg.at("sliding").AsIntVector();
+    sy_ = s.at(0);
+    sx_ = s.at(1);
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    if (in.rank() != 4)
+      throw std::runtime_error(name() + ": pooling input must be NHWC");
+    int64_t b = in.dim(0), h = in.dim(1), w = in.dim(2), c = in.dim(3);
+    // ceil semantics: partial bottom/right windows pool too
+    int64_t oy = (std::max<int64_t>(h - ky_, 0) + sy_ - 1) / sy_ + 1;
+    int64_t ox = (std::max<int64_t>(w - kx_, 0) + sx_ - 1) / sx_ + 1;
+    out->Reset({b, oy, ox, c});
+    for (int64_t bi = 0; bi < b; ++bi) {
+      const float* img = in.data() + bi * h * w * c;
+      for (int64_t yo = 0; yo < oy; ++yo) {
+        for (int64_t xo = 0; xo < ox; ++xo) {
+          float* dst = out->data() + ((bi * oy + yo) * ox + xo) * c;
+          for (int64_t ci = 0; ci < c; ++ci) {
+            float acc = is_max_ ? -std::numeric_limits<float>::infinity()
+                                : 0.0f;
+            int64_t count = 0;
+            for (int64_t p = 0; p < ky_; ++p) {
+              int64_t yi = yo * sy_ + p;
+              if (yi >= h) break;
+              for (int64_t q = 0; q < kx_; ++q) {
+                int64_t xi = xo * sx_ + q;
+                if (xi >= w) break;
+                float v = img[(yi * w + xi) * c + ci];
+                if (is_max_) {
+                  acc = std::max(acc, v);
+                } else {
+                  acc += v;
+                }
+                ++count;
+              }
+            }
+            dst[ci] = is_max_ ? acc : acc / std::max<int64_t>(count, 1);
+          }
+        }
+      }
+    }
+  }
+
+ private:
+  bool is_max_;
+  int64_t ky_ = 2, kx_ = 2, sy_ = 2, sx_ = 2;
+};
+
+struct MaxPooling : Pooling { MaxPooling() : Pooling(true) {} };
+struct AvgPooling : Pooling { AvgPooling() : Pooling(false) {} };
+
+VELES_REGISTER_UNIT("max_pooling", MaxPooling)
+VELES_REGISTER_UNIT("avg_pooling", AvgPooling)
+
+// -- local response normalization ---------------------------------------
+
+class LRNorm : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string&) override {
+    const json::Value& cfg = spec.at("config");
+    alpha_ = static_cast<float>(cfg.at("alpha").AsDouble());
+    beta_ = static_cast<float>(cfg.at("beta").AsDouble());
+    n_ = cfg.at("n").AsInt();
+    k_ = static_cast<float>(cfg.at("k").AsDouble());
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    int64_t c = in.shape().back();
+    int64_t rows = in.NumElements() / c;
+    out->Reset(in.shape());
+    int64_t half_lo = (n_ - 1) / 2;  // conv_math.sliding_channel_sum
+    for (int64_t r = 0; r < rows; ++r) {
+      const float* x = in.data() + r * c;
+      float* y = out->data() + r * c;
+      for (int64_t i = 0; i < c; ++i) {
+        float d = k_;
+        int64_t lo = std::max<int64_t>(i - half_lo, 0);
+        int64_t hi = std::min<int64_t>(i - half_lo + n_ - 1, c - 1);
+        for (int64_t j = lo; j <= hi; ++j) d += alpha_ * x[j] * x[j];
+        y[i] = x[i] * std::pow(d, -beta_);
+      }
+    }
+  }
+
+ private:
+  float alpha_ = 1e-4f, beta_ = 0.75f, k_ = 2.0f;
+  int64_t n_ = 5;
+};
+
+VELES_REGISTER_UNIT("norm", LRNorm)
+
+// -- pass-through + standalone activations -------------------------------
+
+class Identity : public Unit {
+ public:
+  // Dropout is inverted (scaling happens at train time), so inference
+  // is the identity — veles/znicz_tpu/ops/dropout.py
+  void Execute(const Tensor& in, Tensor* out) const override { *out = in; }
+};
+
+VELES_REGISTER_UNIT("dropout", Identity)
+
+class Activation : public Unit {
+ public:
+  explicit Activation(Act act) : act_(act) {}
+  void Execute(const Tensor& in, Tensor* out) const override {
+    *out = in;
+    ApplyActivation(act_, out->data(), 1, out->NumElements());
+  }
+
+ private:
+  Act act_;
+};
+
+struct ActTanh : Activation { ActTanh() : Activation(Act::kTanh) {} };
+struct ActRelu : Activation { ActRelu() : Activation(Act::kRelu) {} };
+struct ActStrict : Activation {
+  ActStrict() : Activation(Act::kStrictRelu) {}
+};
+struct ActSigmoid : Activation {
+  ActSigmoid() : Activation(Act::kSigmoid) {}
+};
+
+VELES_REGISTER_UNIT("activation_tanh", ActTanh)
+VELES_REGISTER_UNIT("activation_relu", ActRelu)
+VELES_REGISTER_UNIT("activation_str", ActStrict)
+VELES_REGISTER_UNIT("activation_sigmoid", ActSigmoid)
+
+}  // namespace
+
+UnitFactory& UnitFactory::Instance() {
+  static UnitFactory factory;
+  return factory;
+}
+
+void UnitFactory::Register(const std::string& type, Creator creator) {
+  creators_[type] = std::move(creator);
+}
+
+UnitPtr UnitFactory::Create(const std::string& type) const {
+  auto it = creators_.find(type);
+  if (it == creators_.end())
+    throw std::runtime_error("UnitFactory: unknown unit type '" + type +
+                             "'");
+  return it->second();
+}
+
+}  // namespace veles

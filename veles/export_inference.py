@@ -1,0 +1,111 @@
+"""Inference-archive export for the C++ engine (``libveles/``).
+
+Rebuild of the reference's workflow export consumed by libVeles
+(SURVEY.md §3.5: "workflow.export(path) → archive: contents.json +
+*.npy"; §2.6 libVeles "loads a workflow archive exported from Python").
+The archive is a plain directory:
+
+    contents.json      — format/version, workflow name, ordered unit
+                         list with per-unit config + weight file refs
+    <unit>_weights.npy — float32 parameter arrays (C-order)
+
+Unit ``type`` strings are the ``forward_unit`` registry names, which
+the C++ ``UnitFactory`` registers 1:1 (libveles/src/units.cc), so the
+two sides can never drift silently: an unknown type fails loudly in
+either direction.
+"""
+
+import json
+import os
+
+import numpy
+
+
+def _npy_name(unit, param):
+    return "%s_%s.npy" % (unit.name.replace("/", "_"), param)
+
+
+def _export_weighted(unit, path, spec):
+    w = numpy.asarray(unit.weights.map_read().mem, numpy.float32)
+    fname = _npy_name(unit, "weights")
+    numpy.save(os.path.join(path, fname), w)
+    spec["weights"] = fname
+    if unit.include_bias and unit.bias:
+        b = numpy.asarray(unit.bias.map_read().mem, numpy.float32)
+        fname = _npy_name(unit, "bias")
+        numpy.save(os.path.join(path, fname), b)
+        spec["bias"] = fname
+    else:
+        spec["bias"] = None
+
+
+def _unit_spec(unit, path):
+    """Serialize one forward unit; raises on unsupported types."""
+    from veles.znicz_tpu.ops.all2all import All2AllBase
+    from veles.znicz_tpu.ops.conv import ConvBase
+    from veles.znicz_tpu.ops.pooling import (
+        PoolingBase, StochasticPooling)
+    from veles.znicz_tpu.ops.normalization import LRNormalizerForward
+    from veles.znicz_tpu.ops.dropout import DropoutForward
+    from veles.znicz_tpu.ops.activation import ActivationForward
+
+    type_name = getattr(type(unit), "MAPPING", None)
+    spec = {"type": type_name, "name": unit.name, "config": {}}
+    if isinstance(unit, All2AllBase):
+        spec["config"]["neurons"] = int(unit.neurons)
+        spec["weights_transposed"] = bool(unit.weights_transposed)
+        _export_weighted(unit, path, spec)
+    elif isinstance(unit, ConvBase):
+        spec["config"].update({
+            "n_kernels": int(unit.n_kernels),
+            "kx": int(unit.kx), "ky": int(unit.ky),
+            "sliding": list(unit.sliding),
+            "padding": list(unit.padding),
+        })
+        _export_weighted(unit, path, spec)
+    elif isinstance(unit, StochasticPooling):
+        raise ValueError(
+            "%s: stochastic pooling has no deterministic inference "
+            "form in the C++ engine" % unit.name)
+    elif isinstance(unit, PoolingBase):
+        spec["config"].update({
+            "kx": int(unit.kx), "ky": int(unit.ky),
+            "sliding": list(unit.sliding),
+        })
+    elif isinstance(unit, LRNormalizerForward):
+        spec["config"].update({
+            "alpha": float(unit.alpha), "beta": float(unit.beta),
+            "n": int(unit.n), "k": float(unit.k),
+        })
+    elif isinstance(unit, (DropoutForward, ActivationForward)):
+        pass  # config-free (dropout is identity at inference)
+    else:
+        raise ValueError(
+            "cannot export unit %s (%s): no C++ engine counterpart"
+            % (unit.name, type(unit).__name__))
+    if type_name is None:
+        raise ValueError("unit %s has no registry MAPPING" % unit.name)
+    return spec
+
+
+def export_inference(workflow, path):
+    """Write the inference archive for ``workflow`` into directory
+    ``path`` (created if missing). Device-resident params are synced to
+    host first. Returns the contents.json path."""
+    os.makedirs(path, exist_ok=True)
+    step = getattr(workflow, "xla_step", None)
+    if step is not None:
+        step.sync_host()
+    units = [_unit_spec(u, path) for u in workflow.forwards]
+    doc = {
+        "format": 1,
+        "workflow": workflow.name,
+        "input_sample_shape": list(
+            workflow.loader.minibatch_data.shape[1:])
+        if getattr(workflow, "loader", None) is not None else None,
+        "units": units,
+    }
+    out = os.path.join(path, "contents.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    return out

@@ -45,7 +45,8 @@ def _find_mnist_dir():
 
 def load_mnist(n_train=6000, n_valid=1000):
     """(train_x, train_y, test_x, test_y) floats in [0,1]; real data if
-    on disk, synthetic otherwise (sizes apply to synthetic only)."""
+    on disk, synthetic otherwise. Sizes CAP both sources, so configs
+    and tests behave the same whether or not idx files are present."""
     d = _find_mnist_dir()
     if d is not None:
         def rd(stem):
@@ -58,7 +59,7 @@ def load_mnist(n_train=6000, n_valid=1000):
         ty = rd("train-labels-idx1-ubyte").astype(numpy.int32)
         vx = rd("t10k-images-idx3-ubyte").astype(numpy.float32) / 255.0
         vy = rd("t10k-labels-idx1-ubyte").astype(numpy.int32)
-        return tx, ty, vx, vy
+        return (tx[:n_train], ty[:n_train], vx[:n_valid], vy[:n_valid])
     return synthetic_images(n_train=n_train, n_valid=n_valid,
                             shape=(28, 28), n_classes=10,
                             key="mnist_synth")

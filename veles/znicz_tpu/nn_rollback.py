@@ -71,6 +71,14 @@ class NNRollback(Unit):
             u.name: (u.export_params(), u.export_state())
             for u in wf._stateful_units()}
 
+    def _cut_lr(self):
+        # scale AFTER the lr policy: schedules like ArbitraryStepPolicy
+        # replace the base lr, so cutting learning_rate alone would not
+        # change the effective lr
+        for gd in self.workflow.gds:
+            if gd is not None:
+                gd.lr_scale *= self.lr_cut
+
     def _restore(self):
         wf = self.workflow
         for u in wf._stateful_units():
@@ -78,12 +86,7 @@ class NNRollback(Unit):
                 params, state = self._stash[u.name]
                 u.import_params(params)
                 u.import_state(state)
-        for gd in wf.gds:
-            if gd is not None:
-                # scale AFTER the lr policy: schedules like
-                # ArbitraryStepPolicy replace the base lr, so cutting
-                # learning_rate alone would not change the effective lr
-                gd.lr_scale *= self.lr_cut
+        self._cut_lr()
         if wf.xla_step is not None:
             wf.xla_step.refresh_device()
         self.rollback_count += 1
@@ -109,9 +112,7 @@ class NNRollback(Unit):
                 # nothing good to restore yet: never stash a blown
                 # state (a NaN best_loss would disable every later
                 # comparison), just cut the lr and hope
-                for gd in self.workflow.gds:
-                    if gd is not None:
-                        gd.lr_scale *= self.lr_cut
+                self._cut_lr()
                 self.warning(
                     "loss blow-up before any good epoch: no stash to "
                     "restore; learning rates cut by %.3g", self.lr_cut)

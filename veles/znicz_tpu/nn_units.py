@@ -450,6 +450,12 @@ class NNWorkflow(AcceleratedWorkflow):
         #: minibatch index ranges from the master
         self.is_slave = False
 
+    def export_inference(self, path):
+        """Write the C++-engine archive (contents.json + .npy weights)
+        for this workflow's forward chain — SURVEY.md §3.5."""
+        from veles.export_inference import export_inference
+        return export_inference(self, path)
+
     # -- XLA rewiring + slot-ordered initialization --------------------
 
     def _rewire_xla(self):

@@ -135,6 +135,9 @@ class XLAStep(Unit):
         self._pre_epoch_state = None
         self._pre_epoch_step_index = 0
         self._keep_entry_requested = False
+        #: epoch whose entry copy is currently held (stream/per-step
+        #: modes take the copy at the first serve of each epoch)
+        self._entry_epoch = None
 
     def _build_batch_spec(self):
         spec = {
@@ -172,10 +175,26 @@ class XLAStep(Unit):
                 if hasattr(gd, "hyperparams")}
 
     def run(self):
+        if not self.scan_mode and self._keep_epoch_entry:
+            # stream/per-step: the first serve of an epoch sees the
+            # epoch-ENTRY params (valid is served before train), so
+            # copy them here; scan mode copies inside _dispatch_epoch
+            self._keep_entry_now()
         if self.scan_mode or self.stream_mode:
             self._run_fused_mode()
         else:
             self._run_per_step()
+
+    def _keep_entry_now(self):
+        if self.loader.epoch_number == self._entry_epoch:
+            return
+        import jax
+        import jax.numpy as jnp
+        copy = (lambda t: jax.tree_util.tree_map(jnp.copy, t))
+        self._pre_epoch_params = copy(self.params)
+        self._pre_epoch_state = copy(self.state)
+        self._pre_epoch_step_index = self.step_index
+        self._entry_epoch = self.loader.epoch_number
 
     def _run_fused_mode(self):
         loader = self.loader
@@ -506,13 +525,14 @@ class XLAStep(Unit):
     @property
     def _keep_epoch_entry(self):
         """Epoch-entry copies cost a params+state duplicate on device;
-        keep them when a snapshotter exists OR someone has asked for a
-        snapshot view before (evaluated per dispatch, so a snapshotter
-        linked after initialize still works)."""
-        return self.scan_mode and (
-            self._keep_entry_requested
-            or getattr(self.workflow, "snapshotter", None) is not None
-            or getattr(self.workflow, "rollback", None) is not None)
+        keep them when a snapshotter/rollback exists OR someone has
+        asked for a snapshot view before (evaluated per dispatch, so a
+        snapshotter linked after initialize still works). All execution
+        modes keep entries: scan mode copies at dispatch, stream and
+        per-step modes at the first serve of each epoch."""
+        return (self._keep_entry_requested
+                or getattr(self.workflow, "snapshotter", None) is not None
+                or getattr(self.workflow, "rollback", None) is not None)
 
     def snapshot_view(self, at_valid=False):
         """A CONSISTENT (params, state, step_index) triple.
@@ -525,11 +545,11 @@ class XLAStep(Unit):
             if self._pre_epoch_params is not None:
                 return (self._pre_epoch_params, self._pre_epoch_state,
                         self._pre_epoch_step_index)
-            if self.scan_mode and not self._keep_entry_requested:
+            if not self._keep_entry_requested:
                 # start keeping entries for future epochs and be loud:
                 # this checkpoint's params are post-train of the epoch
                 self._keep_entry_requested = True
-                if self._dispatched_epoch is not None:
+                if self.step_index:
                     self.warning(
                         "snapshot_view(at_valid) before any epoch-entry "
                         "copy exists: saving post-train params for this "
