@@ -86,9 +86,12 @@ Tensor Load(const std::string& path) {
   int64_t n = t.NumElements();
   if (descr.find("f4") != std::string::npos) {
     f.read(reinterpret_cast<char*>(t.data()), n * 4);
-  } else if (descr.find("i4") != std::string::npos ||
-             descr.find("u4") != std::string::npos) {
+  } else if (descr.find("i4") != std::string::npos) {
     std::vector<int32_t> raw(n);
+    f.read(reinterpret_cast<char*>(raw.data()), n * 4);
+    for (int64_t i = 0; i < n; ++i) t.data()[i] = static_cast<float>(raw[i]);
+  } else if (descr.find("u4") != std::string::npos) {
+    std::vector<uint32_t> raw(n);
     f.read(reinterpret_cast<char*>(raw.data()), n * 4);
     for (int64_t i = 0; i < n; ++i) t.data()[i] = static_cast<float>(raw[i]);
   } else if (descr.find("i8") != std::string::npos) {

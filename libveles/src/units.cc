@@ -86,6 +86,11 @@ class All2All : public Unit {
     transposed_ = spec.get("weights_transposed")->AsBool();
     const json::Value& cfg = spec.at("config");
     neurons_ = cfg.at("neurons").AsInt();
+    // dense layers may emit multi-dim samples (e.g. (4,4,8) feeding a
+    // conv); default to the flat (neurons,) sample
+    out_sample_ = cfg.has("output_sample_shape")
+                      ? cfg.at("output_sample_shape").AsIntVector()
+                      : std::vector<int64_t>{neurons_};
     int64_t fan_in = transposed_ ? weights_.dim(1) : weights_.dim(0);
     int64_t w_neurons = transposed_ ? weights_.dim(0) : weights_.dim(1);
     if (w_neurons != neurons_)
@@ -98,7 +103,9 @@ class All2All : public Unit {
     if (in.NumElements() != b * fan_in_)
       throw std::runtime_error(name() + ": bad input size " +
                                in.ShapeString());
-    out->Reset({b, neurons_});
+    std::vector<int64_t> oshape{b};
+    oshape.insert(oshape.end(), out_sample_.begin(), out_sample_.end());
+    out->Reset(oshape);
     // transposed_: W is (neurons, fan_in) and y = x @ W^T; otherwise W
     // is (fan_in, neurons) and y = x @ W
     Gemm(in.data(), weights_.data(), out->data(), b, fan_in_, neurons_,
@@ -113,6 +120,7 @@ class All2All : public Unit {
   bool has_bias_ = false;
   bool transposed_ = false;
   int64_t neurons_ = 0, fan_in_ = 0;
+  std::vector<int64_t> out_sample_;
 };
 
 struct All2AllLinear : All2All { All2AllLinear() : All2All(Act::kLinear) {} };
@@ -174,6 +182,10 @@ class Conv : public Unit {
       throw std::runtime_error(name() + ": weight shape mismatch");
     int64_t oy = (h + pad_.top + pad_.bottom - ky_) / sy_ + 1;
     int64_t ox = (w + pad_.left + pad_.right - kx_) / sx_ + 1;
+    if (oy <= 0 || ox <= 0)
+      throw std::runtime_error(
+          name() + ": input " + in.ShapeString() +
+          " smaller than the conv kernel");
     // im2col, patch order (ky, kx, C) — conv_math.im2col
     std::vector<float> cols(static_cast<size_t>(b * oy * ox * kkc), 0.0f);
     for (int64_t bi = 0; bi < b; ++bi) {

@@ -1,0 +1,201 @@
+"""Observability tail (SURVEY.md §2.7 rows 4-5, §5.5): plot rendering,
+the graphics server -> renderer-process stream, the web-status
+dashboard, and ImageSaver."""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+# -- renderers (pure functions) ---------------------------------------
+
+
+def test_render_kinds(tmp_path):
+    from veles.graphics_client import render_payload
+    rng = numpy.random.default_rng(3)
+    cases = [
+        ({"kind": "curves", "name": "curves", "title": "t"},
+         {"train": rng.random(5).astype(numpy.float32),
+          "validation": rng.random(5).astype(numpy.float32)}),
+        ({"kind": "image", "name": "img"},
+         {"image": rng.random((8, 8)).astype(numpy.float32)}),
+        ({"kind": "grid", "name": "grid"},
+         {"tiles": rng.random((10, 5, 5)).astype(numpy.float32)}),
+        ({"kind": "matrix", "name": "mat"},
+         {"matrix": (rng.random((4, 4)) * 9).astype(numpy.int32)}),
+    ]
+    for meta, arrays in cases:
+        path = render_payload(meta, arrays, str(tmp_path))
+        assert os.path.exists(path) and os.path.getsize(path) > 500
+
+
+def test_payload_roundtrip():
+    from veles.graphics import pack_payload, unpack_payload
+    meta = {"kind": "image", "name": "x", "cmap": "hot"}
+    arrays = {"image": numpy.arange(12, dtype=numpy.float32)
+              .reshape(3, 4)}
+    m2, a2 = unpack_payload(pack_payload(meta, arrays))
+    assert m2 == meta
+    numpy.testing.assert_array_equal(a2["image"], arrays["image"])
+
+
+# -- graphics server + renderer subprocess ----------------------------
+
+
+def test_graphics_stream_end_to_end(tmp_path):
+    from veles.graphics import GraphicsServer
+    out = str(tmp_path / "plots")
+    srv = GraphicsServer(out)
+    try:
+        # wait for the subprocess to connect
+        deadline = time.time() + 20
+        sent = False
+        payload = ({"kind": "image", "name": "som", "title": "hits"},
+                   {"image": numpy.eye(6, dtype=numpy.float32)})
+        while time.time() < deadline:
+            if srv.publish(*payload):
+                sent = True
+                break
+            time.sleep(0.05)
+        assert sent, "renderer never connected"
+    finally:
+        srv.close()
+    png = os.path.join(out, "som.png")
+    assert os.path.exists(png) and os.path.getsize(png) > 500
+    with open(os.path.join(out, "plots.json")) as f:
+        assert json.load(f)["som"]["kind"] == "image"
+
+
+# -- plot units on a real workflow ------------------------------------
+
+
+def _mnist_wf(name, backend="numpy", **decision):
+    prng.seed_all(404)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.mnist.loader.update(
+        {"n_train": 200, "n_valid": 80, "minibatch_size": 40})
+    root.mnist.decision.max_epochs = decision.get("max_epochs", 2)
+    try:
+        wf = mnist.create_workflow(name=name)
+        yield wf
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = 5
+
+
+def test_plotters_render_in_process(tmp_path):
+    gen = _mnist_wf("PlotWF")
+    wf = next(gen)
+    out = str(tmp_path / "plots")
+    wf.link_plotters(out_dir=out)
+    wf.initialize(device="numpy")
+    wf.run()
+    try:
+        next(gen)
+    except StopIteration:
+        pass
+    assert os.path.exists(os.path.join(out, "plot_metric.png"))
+    assert os.path.exists(os.path.join(out, "plot_weights.png"))
+
+
+def test_plotters_fused_path(tmp_path):
+    gen = _mnist_wf("PlotWFX")
+    wf = next(gen)
+    out = str(tmp_path / "plotsx")
+    wf.link_plotters(out_dir=out)
+    wf.initialize(device="cpu")
+    wf.run()
+    try:
+        next(gen)
+    except StopIteration:
+        pass
+    assert os.path.exists(os.path.join(out, "plot_metric.png"))
+
+
+# -- image saver ------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["numpy", "cpu"])
+def test_image_saver(tmp_path, backend):
+    gen = _mnist_wf("Saver_%s" % backend)
+    wf = next(gen)
+    out = str(tmp_path / "misses")
+    wf.link_image_saver(out, limit_per_epoch=8)
+    wf.initialize(device=backend)
+    wf.run()
+    try:
+        next(gen)
+    except StopIteration:
+        pass
+    saved = []
+    for d, _, files in os.walk(out):
+        saved += [os.path.join(d, f) for f in files]
+    assert saved, "no samples dumped"
+    arr = numpy.load(saved[0])
+    assert arr.shape == (784,)
+    assert wf.image_saver.total_saved == len(saved)
+
+
+def test_kohonen_hits_plotter(tmp_path):
+    prng.seed_all(11)
+    from veles.znicz_tpu.models import kohonen
+    from veles.znicz_tpu.nn_plotting_units import KohonenHits
+    root.kohonen.decision.max_epochs = 2
+    root.kohonen.loader.n_samples = 200
+    wf = kohonen.create_workflow(name="SomPlot")
+    out = str(tmp_path / "som")
+    hits = KohonenHits(wf, forward=wf.forwards[0], name="som_hits",
+                       out_dir=out)
+    hits.link_from(wf.decision)
+    hits.gate_skip = ~wf.decision.epoch_ended
+    wf.initialize(device="numpy")
+    wf.run()
+    png = os.path.join(out, "som_hits.png")
+    assert os.path.exists(png) and os.path.getsize(png) > 500
+
+
+# -- web status -------------------------------------------------------
+
+
+def test_web_status(tmp_path):
+    from veles.web_status import WebStatus, workflow_status
+    gen = _mnist_wf("WebWF")
+    wf = next(gen)
+    wf.initialize(device="numpy")
+    wf.run()
+    try:
+        next(gen)
+    except StopIteration:
+        pass
+    ws = WebStatus(port=0)
+    try:
+        ws.register(wf.name, workflow_status(wf))
+        base = "http://127.0.0.1:%d" % ws.port
+        doc = json.loads(urllib.request.urlopen(
+            base + "/status.json", timeout=10).read())
+        assert doc["WebWF"]["epoch"] == 2
+        assert doc["WebWF"]["complete"] is True
+        page = urllib.request.urlopen(base + "/", timeout=10) \
+            .read().decode()
+        assert "WebWF" in page
+        # remote launcher POST
+        req = urllib.request.Request(
+            base + "/update",
+            data=json.dumps({"name": "slave0", "mode": "slave",
+                             "epoch": 7}).encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=10)
+        doc = json.loads(urllib.request.urlopen(
+            base + "/status.json", timeout=10).read())
+        assert doc["slave0"]["epoch"] == 7
+    finally:
+        ws.close()

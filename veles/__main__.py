@@ -54,6 +54,17 @@ def build_argparser():
                    help="write decision history JSON here")
     p.add_argument("--no-stats", action="store_true",
                    help="skip the per-unit timing report")
+    p.add_argument("--graphics-dir", default=None,
+                   help="stream plots to a renderer process writing "
+                        "PNGs here (also auto-links the standard "
+                        "plotters when the workflow has none)")
+    p.add_argument("--web-status", type=int, default=None,
+                   metavar="PORT",
+                   help="serve the status dashboard on this port "
+                        "(0 = pick a free one)")
+    p.add_argument("--export-inference", default=None, metavar="DIR",
+                   help="after the run, export the C++-engine archive "
+                        "(contents.json + .npy) to DIR")
     return p
 
 
@@ -110,9 +121,18 @@ class Main:
             device=args.device, snapshot=args.snapshot,
             stats=not args.no_stats,
             listen_address=args.listen_address,
-            master_address=args.master_address)
+            master_address=args.master_address,
+            graphics_dir=args.graphics_dir,
+            web_status_port=args.web_status)
+        if args.graphics_dir and not getattr(
+                self.workflow, "plotters", None) \
+                and hasattr(self.workflow, "link_plotters"):
+            self.workflow.link_plotters(out_dir=args.graphics_dir)
         self.launcher.initialize(self.workflow, **kwargs)
         self.launcher.run()
+        if args.export_inference:
+            self.workflow.export_inference(args.export_inference)
+            print("inference archive -> %s" % args.export_inference)
         if args.result_file and self.workflow.decision is not None:
             with open(args.result_file, "w") as f:
                 json.dump({

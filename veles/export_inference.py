@@ -20,6 +20,17 @@ import os
 
 import numpy
 
+#: exactly the types libveles/src/units.cc registers — the export-time
+#: contract check; extending the engine means extending BOTH lists
+ENGINE_TYPES = frozenset({
+    "all2all", "all2all_tanh", "all2all_relu", "all2all_str",
+    "all2all_sigmoid", "softmax",
+    "conv", "conv_tanh", "conv_relu", "conv_str", "conv_sigmoid",
+    "max_pooling", "avg_pooling", "norm", "dropout",
+    "activation_tanh", "activation_relu", "activation_str",
+    "activation_sigmoid",
+})
+
 
 def _npy_name(unit, param):
     return "%s_%s.npy" % (unit.name.replace("/", "_"), param)
@@ -50,9 +61,15 @@ def _unit_spec(unit, path):
     from veles.znicz_tpu.ops.activation import ActivationForward
 
     type_name = getattr(type(unit), "MAPPING", None)
+    if type_name not in ENGINE_TYPES:
+        raise ValueError(
+            "cannot export unit %s (%s, type %r): no C++ engine "
+            "counterpart" % (unit.name, type(unit).__name__, type_name))
     spec = {"type": type_name, "name": unit.name, "config": {}}
     if isinstance(unit, All2AllBase):
         spec["config"]["neurons"] = int(unit.neurons)
+        spec["config"]["output_sample_shape"] = \
+            list(unit.output_sample_shape)
         spec["weights_transposed"] = bool(unit.weights_transposed)
         _export_weighted(unit, path, spec)
     elif isinstance(unit, ConvBase):
@@ -83,8 +100,6 @@ def _unit_spec(unit, path):
         raise ValueError(
             "cannot export unit %s (%s): no C++ engine counterpart"
             % (unit.name, type(unit).__name__))
-    if type_name is None:
-        raise ValueError("unit %s has no registry MAPPING" % unit.name)
     return spec
 
 

@@ -1,0 +1,144 @@
+"""Graphics pipeline — plot streaming to a separate renderer process.
+
+Re-design of ``veles/graphics_server.py`` / ``graphics_client.py`` [U]
+(SURVEY.md §2.7 "Graphics pipeline", §5.5): the reference pickled plot
+units onto a ZMQ PUB socket and a separate matplotlib process rendered
+them. The rebuild keeps the two-process shape (rendering must never
+block the training loop) with a dependency-free transport:
+
+* frames are **npz, not pickle** — a plot payload is numpy arrays + a
+  JSON meta dict, so the renderer never deserializes executable
+  content (unlike the master/slave channel, which needs arbitrary
+  objects and pays for it with HMAC — ``veles/server.py``);
+* localhost TCP, length-prefixed; the renderer subprocess is spawned
+  by :class:`GraphicsServer` and exits when the socket closes.
+
+``publish()`` is fire-and-forget from the training side: a dead or
+slow renderer drops frames rather than stalling the run (plots are off
+the hot path by design — SURVEY.md §5.8).
+"""
+
+import io
+import json
+import socket
+import struct
+import subprocess
+import sys
+import threading
+
+import numpy
+
+from veles.logger import Logger
+
+#: same generous-but-bounded cap rationale as veles/server.py
+MAX_FRAME_BYTES = 1 << 30
+
+
+def pack_payload(meta, arrays):
+    """(meta dict, {name: ndarray}) -> npz frame bytes."""
+    buf = io.BytesIO()
+    numpy.savez_compressed(
+        buf, __meta__=numpy.frombuffer(
+            json.dumps(meta).encode(), numpy.uint8), **arrays)
+    return buf.getvalue()
+
+
+def unpack_payload(blob):
+    """npz frame bytes -> (meta dict, {name: ndarray})."""
+    with numpy.load(io.BytesIO(blob), allow_pickle=False) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+    return meta, arrays
+
+
+def send_frame(sock, blob):
+    sock.sendall(struct.pack(">I", len(blob)) + blob)
+
+
+def recv_frame(sock):
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    size, = struct.unpack(">I", header)
+    if size > MAX_FRAME_BYTES:
+        raise ConnectionError("oversized graphics frame %d" % size)
+    return _recv_exact(sock, size)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class GraphicsServer(Logger):
+    """Accepts one renderer connection and streams plot frames to it.
+
+    ``out_dir`` is where the spawned renderer writes PNGs. Pass
+    ``spawn_client=False`` to attach an external renderer instead
+    (reference: many viewers could subscribe; one renderer is enough
+    for the file backend)."""
+
+    def __init__(self, out_dir, spawn_client=True, name="graphics"):
+        self.name = name
+        self.out_dir = out_dir
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self._listener.getsockname()[1]
+        self._conn = None
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self.client = None
+        self._accept_thread = threading.Thread(
+            target=self._accept, daemon=True,
+            name="%s-accept" % self.name)
+        self._accept_thread.start()
+        if spawn_client:
+            self.client = subprocess.Popen(
+                [sys.executable, "-m", "veles.graphics_client",
+                 "--connect", str(self.port), "--out", out_dir],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def _accept(self):
+        try:
+            conn, _ = self._listener.accept()
+            with self._lock:
+                self._conn = conn
+        except OSError:
+            pass  # listener closed before anyone connected
+
+    def publish(self, meta, arrays):
+        """Fire-and-forget: serialize + send; drop the frame if no
+        renderer is attached or the pipe broke."""
+        blob = pack_payload(meta, arrays)
+        with self._lock:
+            conn = self._conn
+            if conn is None:
+                self._dropped += 1
+                return False
+            try:
+                send_frame(conn, blob)
+                return True
+            except OSError:
+                self._dropped += 1
+                self._conn = None
+                return False
+
+    def close(self, wait=True):
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                self._conn.close()
+                self._conn = None
+        self._listener.close()
+        if self.client is not None and wait:
+            try:
+                self.client.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.client.kill()

@@ -1,0 +1,147 @@
+"""Renderer process: receives plot frames, writes PNGs.
+
+The ``graphics_client`` half of SURVEY.md §2.7's pipeline ("separate
+graphics_client process renders via matplotlib"). Runs standalone:
+
+    python -m veles.graphics_client --connect PORT --out DIR
+
+Each frame's ``meta["kind"]`` picks a renderer; every update rewrites
+``DIR/<name>.png`` plus a ``plots.json`` index (consumed by the web
+status page). Render functions are plain (meta, arrays, path) calls so
+tests can exercise them without sockets."""
+
+import argparse
+import json
+import os
+import socket
+import sys
+
+
+def _agg():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def render_curves(meta, arrays, path):
+    """Line plot: arrays = {label: 1-D series}; shared x = index
+    (epochs). The error-curve staple (reference AccumulatingPlotter)."""
+    plt = _agg()
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for label in meta.get("series", sorted(arrays)):
+        y = arrays[label]
+        ax.plot(range(len(y)), y, label=label, marker=".")
+    ax.set_xlabel(meta.get("xlabel", "epoch"))
+    ax.set_ylabel(meta.get("ylabel", ""))
+    ax.set_title(meta.get("title", ""))
+    ax.legend(loc="best", fontsize=8)
+    ax.grid(True, alpha=0.3)
+    fig.savefig(path, dpi=96, bbox_inches="tight")
+    plt.close(fig)
+
+
+def render_image(meta, arrays, path):
+    """Single 2-D heatmap (Kohonen hit maps, generic matrices)."""
+    plt = _agg()
+    fig, ax = plt.subplots(figsize=(5, 5))
+    im = ax.imshow(arrays["image"], cmap=meta.get("cmap", "viridis"),
+                   interpolation="nearest")
+    fig.colorbar(im, ax=ax, shrink=0.8)
+    ax.set_title(meta.get("title", ""))
+    fig.savefig(path, dpi=96, bbox_inches="tight")
+    plt.close(fig)
+
+
+def render_grid(meta, arrays, path):
+    """Tile a (N, h, w) stack into a rounded-square grid — the
+    Weights2D filter imager (reference nn_plotting_units [U])."""
+    import numpy
+    plt = _agg()
+    tiles = arrays["tiles"]
+    n = len(tiles)
+    cols = int(numpy.ceil(numpy.sqrt(n)))
+    rows = int(numpy.ceil(n / cols))
+    fig, axes = plt.subplots(rows, cols,
+                             figsize=(1.2 * cols, 1.2 * rows))
+    axes = numpy.atleast_1d(axes).ravel()
+    for ax in axes:
+        ax.axis("off")
+    for i in range(n):
+        axes[i].imshow(tiles[i], cmap=meta.get("cmap", "gray"),
+                       interpolation="nearest")
+    fig.suptitle(meta.get("title", ""))
+    fig.savefig(path, dpi=96, bbox_inches="tight")
+    plt.close(fig)
+
+
+def render_matrix(meta, arrays, path):
+    """Annotated integer matrix — the confusion-matrix view."""
+    plt = _agg()
+    m = arrays["matrix"]
+    fig, ax = plt.subplots(figsize=(5, 5))
+    ax.imshow(m, cmap="Blues")
+    if m.shape[0] <= 20:  # annotations unreadable beyond that
+        for i in range(m.shape[0]):
+            for j in range(m.shape[1]):
+                ax.text(j, i, str(int(m[i, j])), ha="center",
+                        va="center", fontsize=7)
+    ax.set_xlabel(meta.get("xlabel", "label"))
+    ax.set_ylabel(meta.get("ylabel", "prediction"))
+    ax.set_title(meta.get("title", ""))
+    fig.savefig(path, dpi=96, bbox_inches="tight")
+    plt.close(fig)
+
+
+RENDERERS = {
+    "curves": render_curves,
+    "image": render_image,
+    "grid": render_grid,
+    "matrix": render_matrix,
+}
+
+
+def render_payload(meta, arrays, out_dir):
+    """Render one payload; returns the written path."""
+    kind = meta["kind"]
+    name = "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in meta["name"])
+    path = os.path.join(out_dir, name + ".png")
+    RENDERERS[kind](meta, arrays, path)
+    return path
+
+
+def serve(port, out_dir):
+    from veles.graphics import recv_frame, unpack_payload
+    os.makedirs(out_dir, exist_ok=True)
+    sock = socket.create_connection(("127.0.0.1", port))
+    index = {}
+    while True:
+        blob = recv_frame(sock)
+        if blob is None:
+            break
+        try:
+            meta, arrays = unpack_payload(blob)
+            path = render_payload(meta, arrays, out_dir)
+            index[meta["name"]] = {
+                "kind": meta["kind"], "file": os.path.basename(path),
+                "title": meta.get("title", "")}
+            with open(os.path.join(out_dir, "plots.json"), "w") as f:
+                json.dump(index, f, indent=1)
+        except Exception as exc:  # a bad frame must not kill the feed
+            print("render error: %s" % exc, file=sys.stderr)
+    return index
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--connect", type=int, required=True,
+                   help="graphics server port on localhost")
+    p.add_argument("--out", required=True, help="PNG output directory")
+    args = p.parse_args(argv)
+    serve(args.connect, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

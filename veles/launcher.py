@@ -27,7 +27,8 @@ class Launcher(Logger):
     """Drives one workflow run."""
 
     def __init__(self, device=None, snapshot=None, stats=True,
-                 listen_address=None, master_address=None):
+                 listen_address=None, master_address=None,
+                 graphics_dir=None, web_status_port=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
@@ -36,6 +37,13 @@ class Launcher(Logger):
         self.master_address = master_address
         self.workflow = None
         self.interrupted = False
+        #: directory for streamed plot PNGs (spawns the renderer
+        #: process); None disables graphics (SURVEY.md §2.7)
+        self.graphics_dir = graphics_dir
+        #: port for the status dashboard; None disables it
+        self.web_status_port = web_status_port
+        self.graphics = None
+        self.web_status = None
 
     @property
     def mode(self):
@@ -59,6 +67,17 @@ class Launcher(Logger):
             state = load_snapshot(self.snapshot)
             workflow.restore_state(state)
             self.info("resumed from %s", self.snapshot)
+        if self.graphics_dir and self.mode != "slave":
+            # master/standalone only, like the reference (plots render
+            # in a separate process so they never block the run)
+            from veles.graphics import GraphicsServer
+            self.graphics = GraphicsServer(self.graphics_dir)
+            workflow.graphics = self.graphics
+        if self.web_status_port is not None:
+            from veles.web_status import WebStatus, workflow_status
+            self.web_status = WebStatus(port=self.web_status_port)
+            self.web_status.register(
+                workflow.name, workflow_status(workflow, self.mode))
         return workflow
 
     def run(self):
@@ -85,6 +104,13 @@ class Launcher(Logger):
         finally:
             if previous is not None:
                 signal.signal(signal.SIGINT, previous)
+            if self.graphics is not None:
+                self.graphics.close()
+            if self.web_status is not None:
+                # per-run dashboard dies with the run (a persistent
+                # fleet dashboard is a standalone WebStatus that
+                # launchers POST to via /update)
+                self.web_status.close()
         if self.stats:
             wf.print_stats(sys.stderr)
         return wf

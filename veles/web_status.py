@@ -1,0 +1,152 @@
+"""Web status dashboard.
+
+Re-design of ``veles/web_status.py`` [U] (SURVEY.md §2.7 "Web status",
+§5.5): the reference ran a central tornado server that every Launcher
+POSTed status JSON to, plus a JS frontend. The rebuild is a stdlib
+``http.server`` with the same three surfaces and no frontend build:
+
+* ``GET /``            — self-refreshing HTML dashboard
+* ``GET /status.json`` — machine-readable run status
+* ``POST /update``     — remote launchers push their status dicts
+                         (same-host launchers register a callable)
+
+Status is PULLED live from registered providers at request time, so
+there is no background reporting thread on the training side — the
+dashboard costs nothing between page loads (off the hot path,
+SURVEY.md §5.8)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles.logger import Logger
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles status</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body { font-family: monospace; margin: 2em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #999; padding: 4px 10px; text-align: left; }
+ th { background: #eee; }
+</style></head>
+<body><h2>veles-znicz-tpu — run status</h2>%s
+<p>raw: <a href="/status.json">status.json</a></p></body></html>
+"""
+
+
+def _row(cells, tag="td"):
+    return "<tr>" + "".join("<%s>%s</%s>" % (tag, c, tag)
+                            for c in cells) + "</tr>"
+
+
+class WebStatus(Logger):
+    """Serves run status on ``http://127.0.0.1:port``; port=0 picks a
+    free one (see ``.port``)."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self.name = "web_status"
+        self._providers = {}      # name -> callable() -> dict
+        self._pushed = {}         # name -> dict (remote POSTs)
+        self._lock = threading.Lock()
+        status = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/status.json"):
+                    body = json.dumps(status.snapshot(),
+                                      indent=1).encode()
+                    self._reply(200, body, "application/json")
+                elif self.path == "/":
+                    self._reply(200, status.render_page().encode(),
+                                "text/html")
+                else:
+                    self._reply(404, b"not found", "text/plain")
+
+            def do_POST(self):
+                if self.path != "/update":
+                    self._reply(404, b"not found", "text/plain")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    doc = json.loads(self.rfile.read(n))
+                    name = str(doc["name"])
+                except (ValueError, KeyError):
+                    self._reply(400, b"bad status json", "text/plain")
+                    return
+                with status._lock:
+                    status._pushed[name] = doc
+                self._reply(200, b"ok", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="web-status")
+        self._thread.start()
+        self.info("dashboard on http://%s:%d/", host, self.port)
+
+    # -- providers -----------------------------------------------------
+
+    def register(self, name, provider):
+        """``provider()`` -> status dict, called at page-load time."""
+        with self._lock:
+            self._providers[name] = provider
+
+    def snapshot(self):
+        out = {}
+        with self._lock:
+            providers = dict(self._providers)
+            out.update(self._pushed)
+        for name, fn in providers.items():
+            try:
+                out[name] = fn()
+            except Exception as exc:
+                out[name] = {"error": str(exc)}
+        return out
+
+    def render_page(self):
+        snap = self.snapshot()
+        if not snap:
+            return _PAGE % "<p>no runs registered</p>"
+        keys = ["mode", "workflow", "epoch", "best_metric",
+                "last_metrics", "complete"]
+        rows = [_row(["run"] + keys, "th")]
+        for name, st in sorted(snap.items()):
+            rows.append(_row(
+                [name] + [st.get(k, "") for k in keys]))
+        return _PAGE % ("<table>%s</table>" % "".join(rows))
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def workflow_status(workflow, mode="standalone"):
+    """Standard provider for an NN workflow (what Launchers register)."""
+    def provider():
+        d = getattr(workflow, "decision", None)
+        st = {"workflow": workflow.name, "mode": mode}
+        if d is not None:
+            st["epoch"] = d.epoch_number
+            st["best_metric"] = (None if d.best_metric in (None, float("inf"))
+                                 else round(float(d.best_metric), 6))
+            if d.history:
+                last = d.history[-1]
+                st["last_metrics"] = {
+                    k: (round(v["metric"], 6)
+                        if isinstance(v, dict) else v)
+                    for k, v in last.items() if k != "epoch"}
+            st["complete"] = bool(d.complete)
+        return st
+    return provider

@@ -1,0 +1,99 @@
+"""ImageSaver — dumps wrongly-classified / worst samples to disk.
+
+Re-design of znicz ``image_saver.py`` [U] (SURVEY.md §2.4 "Weight
+diagnostics ... misclassified-image dumper", §5.5). Host-side unit
+linked after the evaluator: on each serve it inspects the evaluator's
+outputs and writes offending samples under
+
+    out_dir/<epoch>/<cls>_<global_index>_pred<p>_true<t>.npy
+
+Compared to the reference (which re-encoded images via PIL) the
+rebuild stores raw float arrays — lossless, dependency-free, and
+directly loadable for inspection; the graphics renderer can turn them
+into PNGs on demand.
+
+On the fused XLA path per-sample predictions are not individually
+published — only the worst sample of each minibatch is identified
+(``evaluator.max_err_idx``), so there this unit records the per-serve
+worst offender rather than every miss (documented gap; the numpy
+oracle path records every miss, reference-style)."""
+
+import os
+
+import numpy
+
+from veles.units import Unit
+
+
+class ImageSaver(Unit):
+    def __init__(self, workflow, out_dir=None, limit_per_epoch=64,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.out_dir = out_dir
+        self.limit_per_epoch = int(limit_per_epoch)
+        self._saved_this_epoch = 0
+        self._epoch = 0
+        self.total_saved = 0
+
+    def _save(self, arr, cls, index, pred, true):
+        d = os.path.join(self.out_dir, "epoch%04d" % self._epoch)
+        os.makedirs(d, exist_ok=True)
+        fname = "c%d_i%d_pred%d_true%d.npy" % (cls, index, pred, true)
+        numpy.save(os.path.join(d, fname), arr)
+        self._saved_this_epoch += 1
+        self.total_saved += 1
+
+    def _sample(self, loader, mb_pos, global_idx):
+        """Sample array by position: the dataset originals when
+        resident (the fused path skips host minibatch fills), else the
+        host minibatch mirror."""
+        orig = getattr(loader, "original_data", None)
+        if orig is not None and orig:
+            return numpy.asarray(orig.map_read().mem[global_idx])
+        return numpy.asarray(
+            loader.minibatch_data.map_read().mem[mb_pos])
+
+    @staticmethod
+    def _label(loader, mb_pos, global_idx):
+        orig = getattr(loader, "original_labels", None)
+        if orig is not None and orig:
+            return int(orig.map_read().mem[global_idx])
+        if loader.minibatch_labels:
+            return int(loader.minibatch_labels.map_read().mem[mb_pos])
+        return -1
+
+    def run(self):
+        wf = self.workflow
+        loader, ev = wf.loader, wf.evaluator
+        if bool(loader.epoch_ended):
+            self._epoch += 1
+            self._saved_this_epoch = 0
+        if self.out_dir is None \
+                or self._saved_this_epoch >= self.limit_per_epoch:
+            return
+        indices = loader.minibatch_indices.map_read().mem \
+            if loader.minibatch_indices else None
+        n = int(loader.minibatch_size)
+        cls = int(loader.minibatch_class)
+        max_idx_arr = getattr(ev, "max_idx", None)
+        if max_idx_arr is not None and max_idx_arr \
+                and wf.xla_step is None:
+            # numpy oracle path: per-sample predictions are live —
+            # save every miss (reference behaviour)
+            preds = numpy.asarray(max_idx_arr.map_read().mem)
+            for i in range(n):
+                if self._saved_this_epoch >= self.limit_per_epoch:
+                    return
+                gidx = int(indices[i]) if indices is not None else i
+                true = self._label(loader, i, gidx)
+                if int(preds[i]) != true:
+                    self._save(self._sample(loader, i, gidx), cls,
+                               gidx, int(preds[i]), true)
+            return
+        # fused path: only the minibatch's worst sample is published
+        i = int(getattr(ev, "max_err_idx", 0))
+        if i >= n:
+            return
+        gidx = int(indices[i]) if indices is not None else i
+        self._save(self._sample(loader, i, gidx), cls, gidx, -1,
+                   self._label(loader, i, gidx))

@@ -1,0 +1,147 @@
+"""NN plotting units — error curves, weight imagers, confusion and
+Kohonen maps.
+
+Re-design of znicz ``nn_plotting_units.py`` + core ``plotting_units.py``
+[U] (SURVEY.md §2.4 "NN plotting units", §2.7 "Graphics pipeline"):
+each unit is a host-side graph node gated on ``decision.epoch_ended``
+that builds a payload (JSON meta + numpy arrays) and hands it to the
+workflow's :class:`veles.graphics.GraphicsServer`, which streams it to
+the renderer process (``veles/graphics_client.py``). With no graphics
+server attached the unit renders in-process to ``out_dir`` instead —
+same PNGs, no subprocess (handy for tests and headless runs).
+"""
+
+import os
+
+import numpy
+
+from veles.loader.base import CLASS_TEST, CLASS_VALID, CLASS_TRAIN
+from veles.units import Unit
+
+TRIAGE = {CLASS_TEST: "test", CLASS_VALID: "validation",
+          CLASS_TRAIN: "train"}
+
+
+class PlotterBase(Unit):
+    """Publishes a payload once per epoch (gate on epoch_ended is set
+    by the linker, mirroring the reference's rate-gating by decision)."""
+
+    def __init__(self, workflow, name=None, out_dir=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.out_dir = out_dir
+
+    def make_payload(self):
+        """-> (meta dict incl. kind+name, {arrayname: ndarray}), or
+        None to skip this epoch."""
+        raise NotImplementedError
+
+    def run(self):
+        payload = self.make_payload()
+        if payload is None:
+            return
+        meta, arrays = payload
+        meta.setdefault("name", self.name)
+        gfx = getattr(self.workflow, "graphics", None)
+        if gfx is not None:
+            gfx.publish(meta, arrays)
+        elif self.out_dir:
+            from veles.graphics_client import render_payload
+            os.makedirs(self.out_dir, exist_ok=True)
+            render_payload(meta, arrays, self.out_dir)
+
+
+class AccumulatingPlotter(PlotterBase):
+    """Per-epoch metric curves from decision.history (reference error
+    plot: one line per train/valid class)."""
+
+    def __init__(self, workflow, field="metric", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.field = field
+
+    def make_payload(self):
+        hist = self.workflow.decision.history
+        if not hist:
+            return None
+        series = {}
+        for cls_name in ("test", "validation", "train"):
+            ys = [h[cls_name][self.field] for h in hist
+                  if cls_name in h]
+            if ys:
+                series[cls_name] = numpy.asarray(ys, numpy.float32)
+        meta = {"kind": "curves", "title": "%s per epoch" % self.field,
+                "ylabel": self.field,
+                "series": sorted(series)}
+        return meta, series
+
+
+class Weights2D(PlotterBase):
+    """First-layer filter imager: tiles each neuron/kernel's weights as
+    a 2-D patch (reference ``Weights2D`` [U])."""
+
+    def __init__(self, workflow, unit=None, limit=64, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.unit = unit
+        self.limit = int(limit)
+
+    def make_payload(self):
+        u = self.unit or self.workflow.forwards[0]
+        if getattr(u, "weights", None) is None or not u.weights:
+            return None
+        w = numpy.asarray(u.weights.map_read().mem, numpy.float32)
+        if getattr(u, "weights_transposed", False):
+            tiles = w
+        else:
+            tiles = w.T                       # (neurons, fan_in)
+        tiles = tiles[:self.limit]
+        n, fan_in = tiles.shape
+        # choose a near-square patch: conv kernels know their shape,
+        # dense layers get the best rectangle
+        if hasattr(u, "kx") and hasattr(u, "ky"):
+            c = fan_in // (u.ky * u.kx)
+            patch = tiles.reshape(n, u.ky, u.kx, c)[..., 0]
+        else:
+            side = int(numpy.sqrt(fan_in))
+            while fan_in % side:
+                side -= 1
+            patch = tiles.reshape(n, side, fan_in // side)
+        meta = {"kind": "grid", "title": "%s weights" % u.name}
+        return meta, {"tiles": patch}
+
+
+class ConfusionMatrixPlotter(PlotterBase):
+    """Renders the evaluator's accumulated confusion matrix."""
+
+    def make_payload(self):
+        ev = self.workflow.evaluator
+        cm = getattr(ev, "confusion_matrix", None)
+        if cm is None or not cm:
+            return None
+        m = numpy.asarray(cm.map_read().mem)
+        meta = {"kind": "matrix", "title": "confusion",
+                "xlabel": "label", "ylabel": "prediction"}
+        return meta, {"matrix": m.astype(numpy.int32)}
+
+
+class KohonenHits(PlotterBase):
+    """SOM BMU hit-count map (reference ``KohonenHits`` [U]): how many
+    dataset samples map to each grid cell, computed host-side from the
+    current weights (SOM grids are tiny; a full-dataset argmin is
+    cheap off the hot path)."""
+
+    def __init__(self, workflow, forward=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward = forward
+
+    def make_payload(self):
+        f = self.forward
+        if f is None or not getattr(f, "weights", None) or not f.weights:
+            return None
+        data = self.workflow.loader.original_data
+        x = numpy.asarray(data.map_read().mem, numpy.float32)
+        x2 = x.reshape(len(x), -1)
+        w = numpy.asarray(f.weights.map_read().mem, numpy.float32)
+        bmu = numpy.argmin(f._dist2(numpy, x2, w), axis=1)
+        hits = numpy.bincount(bmu, minlength=f.neurons) \
+            .astype(numpy.float32)
+        meta = {"kind": "image", "title": "SOM hits", "cmap": "hot"}
+        return meta, {"image": hits.reshape(f.grid_shape)}

@@ -446,6 +446,10 @@ class NNWorkflow(AcceleratedWorkflow):
         self.snapshotter = None
         self.rollback = None
         self.xla_step = None
+        self.plotters = []
+        self.image_saver = None
+        #: GraphicsServer streaming plot payloads (set by the Launcher)
+        self.graphics = None
         #: distributed role (set by the Launcher); slaves receive their
         #: minibatch index ranges from the master
         self.is_slave = False

@@ -180,6 +180,43 @@ class StandardWorkflowBase(NNWorkflow):
         self.snapshotter = snap
         return snap
 
+    def link_plotters(self, out_dir=None, weights=True, confusion=None):
+        """Attach the standard plot set after the Decision, each gated
+        to fire once per epoch (reference ``link_*_plotter`` methods
+        [U]; SURVEY.md §2.7 "Graphics pipeline"). Payloads go to
+        ``workflow.graphics`` when a GraphicsServer is attached (the
+        Launcher does this), else render in-process into ``out_dir``."""
+        from veles.znicz_tpu.nn_plotting_units import (
+            AccumulatingPlotter, ConfusionMatrixPlotter, Weights2D)
+        from veles.znicz_tpu.ops.evaluator import EvaluatorSoftmax
+        units = [AccumulatingPlotter(self, name="plot_metric",
+                                     out_dir=out_dir)]
+        if weights:
+            units.append(Weights2D(self, name="plot_weights",
+                                   out_dir=out_dir))
+        if confusion is None:
+            confusion = isinstance(self.evaluator, EvaluatorSoftmax) \
+                and self.evaluator.compute_confusion
+        if confusion:
+            units.append(ConfusionMatrixPlotter(
+                self, name="plot_confusion", out_dir=out_dir))
+        for u in units:
+            u.link_from(self.decision)
+            u.gate_skip = ~self.decision.epoch_ended
+        self.plotters = units
+        return units
+
+    def link_image_saver(self, out_dir, **cfg):
+        """Dump misclassified/worst samples each serve (reference
+        ``ImageSaver`` [U]; SURVEY.md §5.5). Linked after Decision so
+        it works on both the per-unit and fused execution paths."""
+        from veles.znicz_tpu.image_saver import ImageSaver
+        saver = ImageSaver(self, name="image_saver", out_dir=out_dir,
+                           **cfg)
+        saver.link_from(self.decision)
+        self.image_saver = saver
+        return saver
+
     def link_end_point(self):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
