@@ -91,6 +91,11 @@ class All2All : public Unit {
     out_sample_ = cfg.has("output_sample_shape")
                       ? cfg.at("output_sample_shape").AsIntVector()
                       : std::vector<int64_t>{neurons_};
+    int64_t sample_elems = 1;
+    for (int64_t d : out_sample_) sample_elems *= d;
+    if (sample_elems != neurons_)
+      throw std::runtime_error(
+          name() + ": output_sample_shape product != neurons");
     int64_t fan_in = transposed_ ? weights_.dim(1) : weights_.dim(0);
     int64_t w_neurons = transposed_ ? weights_.dim(0) : weights_.dim(1);
     if (w_neurons != neurons_)

@@ -1,0 +1,169 @@
+"""Genetics GA + ensemble (SURVEY.md §2.7 rows 8-9, L9)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import Config, Tune, root
+from veles.genetics import GeneticOptimizer, apply_values
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ga_minimizes_quadratic():
+    """Pure-function sanity: the GA finds the box minimum."""
+    tunables = {"a": Tune(5.0, -10.0, 10.0),
+                "b": Tune(-3.0, -10.0, 10.0)}
+
+    def evaluate(v):
+        return (v["a"] - 2.0) ** 2 + (v["b"] - 7.0) ** 2
+
+    opt = GeneticOptimizer(evaluate, tunables, population_size=16,
+                           generations=12, seed=3)
+    best, fitness = opt.run()
+    assert fitness < 0.5, (best, fitness)
+    assert abs(best["a"] - 2.0) < 0.6
+    assert abs(best["b"] - 7.0) < 0.6
+
+
+def test_ga_respects_discrete_and_bounds():
+    tunables = {"n": Tune(4, 2, 16)}
+    seen = []
+
+    def evaluate(v):
+        seen.append(v["n"])
+        return abs(v["n"] - 9)
+
+    opt = GeneticOptimizer(evaluate, tunables, population_size=12,
+                           generations=8, seed=1)
+    best, fitness = opt.run()
+    assert all(isinstance(n, int) and 2 <= n <= 16 for n in seen)
+    assert best["n"] == 9 and fitness == 0
+
+
+def test_ga_failed_individuals_are_skipped():
+    tunables = {"x": Tune(0.0, -1.0, 1.0)}
+
+    def evaluate(v):
+        if v["x"] < 0:
+            raise RuntimeError("diverged")
+        return v["x"]
+
+    opt = GeneticOptimizer(evaluate, tunables, population_size=8,
+                           generations=3, seed=2)
+    best, fitness = opt.run()
+    assert numpy.isfinite(fitness) and best["x"] >= 0
+
+
+def test_find_and_apply_values():
+    from veles.genetics import find_tunables
+    cfg = Config("test_ga")
+    cfg.update({"layer": {"lr": Tune(0.1, 0.001, 1.0)}})
+    cfg.layers = [{"<-": {"lr": Tune(0.2, 0.01, 0.5)}}]
+    found = find_tunables(cfg)
+    assert set(found) == {"layer/lr", "layers/0/<-/lr"}
+    apply_values(cfg, {"layer/lr": 0.25, "layers/0/<-/lr": 0.3})
+    assert cfg.layer.lr == 0.25
+    assert cfg.layers[0]["<-"]["lr"] == 0.3
+
+
+def test_ga_improves_mnist_config():
+    """The acceptance criterion from VERDICT: GA demonstrably improves
+    a (deliberately mistuned) MNIST config."""
+    import copy
+
+    from veles.genetics import optimize_config
+    from veles.znicz_tpu.models import mnist
+    saved_layers = copy.deepcopy(root.mnist.layers)
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.mnist.loader.update(
+        {"n_train": 200, "n_valid": 80, "minibatch_size": 40})
+    root.mnist.decision.max_epochs = 2
+    # mistuned lr, marked searchable
+    for layer in root.mnist.layers:
+        if "<-" in layer:
+            layer["<-"]["learning_rate"] = Tune(1e-4, 1e-4, 0.1)
+
+    def run_one():
+        prng.seed_all(1234)
+        wf = mnist.create_workflow(name="GAMnist")
+        wf.initialize(device="numpy")
+        wf.run()
+        return float(wf.decision.best_metric)
+
+    try:
+        baseline = run_one()   # defaults = the mistuned lr
+        opt = optimize_config(root.mnist, run_one,
+                              population_size=5, generations=2, seed=9)
+    finally:
+        root.mnist.layers = saved_layers
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = 5
+    assert opt.best_fitness <= baseline, \
+        (opt.best_fitness, baseline)
+    assert opt.best_fitness < baseline - 0.05, \
+        "GA failed to improve the mistuned lr"
+
+
+def test_ensemble_beats_or_matches_members():
+    from veles.ensemble import Ensemble
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.mnist.loader.update(
+        {"n_train": 300, "n_valid": 100, "minibatch_size": 50})
+    root.mnist.decision.max_epochs = 2
+
+    def factory(name):
+        return mnist.create_workflow(name=name)
+
+    try:
+        ens = Ensemble(factory, n_models=3, base_seed=42,
+                       device="numpy")
+        ens.train()
+        report = ens.evaluate_classification()
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = 5
+    assert report["n_valid"] == 100
+    assert len(report["member_errors"]) == 3
+    # mean-of-softmax must not be worse than the weakest member
+    assert report["ensemble_error"] <= max(report["member_errors"]), \
+        report
+
+
+def test_cli_optimize_smoke(tmp_path):
+    """--optimize end-to-end through velescli (config file marks the
+    lr searchable with Tune, reference-style)."""
+    cfg = tmp_path / "ga_config.py"
+    cfg.write_text(
+        "from veles.config import root, Tune\n"
+        "for layer in root.mnist.layers:\n"
+        "    if '<-' in layer:\n"
+        "        layer['<-']['learning_rate'] = "
+        "Tune(0.02, 0.005, 0.1)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "veles",
+         os.path.join(REPO, "veles/znicz_tpu/models/mnist.py"),
+         str(cfg),
+         "root.mnist.loader.n_train=120",
+         "root.mnist.loader.n_valid=40",
+         "root.mnist.loader.minibatch_size=40",
+         "root.mnist.decision.max_epochs=1",
+         "-d", "numpy", "--seed", "5", "--no-stats",
+         "--optimize", "1x3"],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert numpy.isfinite(doc["best_fitness"])
+    assert doc["evaluations"] >= 3

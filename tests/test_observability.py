@@ -145,6 +145,28 @@ def test_image_saver(tmp_path, backend):
     assert wf.image_saver.total_saved == len(saved)
 
 
+def test_weights2d_conv_layer(tmp_path):
+    """Weights2D on a CONV first layer (weights are (n_kernels,
+    fan_in) — regression: the dense-layer transpose must not apply)."""
+    prng.seed_all(505)
+    from veles.znicz_tpu.models import cifar10
+    saved = {k: root.cifar.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.cifar.loader.update(
+        {"n_train": 100, "n_valid": 50, "minibatch_size": 50})
+    root.cifar.decision.max_epochs = 1
+    out = str(tmp_path / "convplots")
+    try:
+        wf = cifar10.create_workflow(name="ConvPlot")
+        wf.link_plotters(out_dir=out)
+        wf.initialize(device="numpy")
+        wf.run()
+    finally:
+        root.cifar.loader.update(saved)
+    png = os.path.join(out, "plot_weights.png")
+    assert os.path.exists(png) and os.path.getsize(png) > 500
+
+
 def test_kohonen_hits_plotter(tmp_path):
     prng.seed_all(11)
     from veles.znicz_tpu.models import kohonen

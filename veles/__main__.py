@@ -65,6 +65,13 @@ def build_argparser():
     p.add_argument("--export-inference", default=None, metavar="DIR",
                    help="after the run, export the C++-engine archive "
                         "(contents.json + .npy) to DIR")
+    p.add_argument("--optimize", default=None, metavar="GENSxPOP",
+                   help="genetic search over the config's Tune leaves "
+                        "(e.g. 6x12: 6 generations, population 12); "
+                        "fitness = best validation metric")
+    p.add_argument("--ensemble", type=int, default=None, metavar="N",
+                   help="train N differently-seeded instances and "
+                        "report ensemble vs member validation error")
     return p
 
 
@@ -143,6 +150,55 @@ class Main:
                 }, f, indent=2)
         return self.workflow
 
+    # -- meta-optimization modes (SURVEY.md §2.7 rows 8-9, L9) ---------
+
+    def _train_once(self, module):
+        """One full training run of the module with the CURRENT config;
+        -> best validation metric."""
+        self.workflow = None
+        module.run(self.load, self.main)
+        return float(self.workflow.decision.best_metric)
+
+    def optimize(self, module):
+        """``--optimize``: GA over every Tune leaf in root."""
+        from veles.genetics import optimize_config
+        gens, _, pop = self.args.optimize.partition("x")
+        seed = self.args.seed if self.args.seed is not None else 1
+
+        def run_one():
+            prng.seed_all(seed)   # identical universe per individual
+            return self._train_once(module)
+
+        opt = optimize_config(
+            root, run_one, generations=int(gens),
+            population_size=int(pop or 12), seed=seed)
+        print(json.dumps({
+            "best_fitness": opt.best_fitness,
+            "best_values": opt.best_values,
+            "evaluations": opt.evaluations,
+        }))
+        return opt
+
+    def ensemble(self, module):
+        """``--ensemble N``: bag of differently-seeded runs."""
+        from veles.ensemble import Ensemble
+
+        def factory(name):
+            self.workflow = None
+            module.run(self.load, lambda **kw: None)  # build only
+            return self.workflow
+
+        ens = Ensemble(factory, n_models=self.args.ensemble,
+                       base_seed=self.args.seed or 1000,
+                       device=self.args.device or "numpy")
+        ens.train()
+        report = ens.evaluate_classification()
+        print(json.dumps(report))
+        if self.args.result_file:
+            with open(self.args.result_file, "w") as f:
+                json.dump(report, f, indent=2)
+        return ens
+
     def run(self):
         # Import the workflow module FIRST: its module-level defaults
         # land in root before the config file and the CLI dot-path
@@ -152,7 +208,19 @@ class Main:
         if not hasattr(module, "run"):
             raise AttributeError(
                 "%s has no run(load, main)" % self.args.workflow)
-        module.run(self.load, self.main)
+        if self.args.optimize:
+            # inner runs must not spam side effects: no result/export
+            # files, and no per-individual renderer subprocesses or
+            # dashboard port binds
+            self.args.result_file = None
+            self.args.export_inference = None
+            self.args.graphics_dir = None
+            self.args.web_status = None
+            self.optimize(module)
+        elif self.args.ensemble:
+            self.ensemble(module)
+        else:
+            module.run(self.load, self.main)
         return 0
 
 

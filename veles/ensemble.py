@@ -1,0 +1,103 @@
+"""Ensemble training and evaluation.
+
+Re-design of ``veles/ensemble/`` [U] (SURVEY.md §2.7 "Ensemble", L9):
+train N instances of a workflow under different seeds (and optionally
+different config overrides), then aggregate their predictions at eval
+time. The reference ran these as separate velescli invocations writing
+result files; the rebuild trains in-process via the same
+``workflow_factory`` the samples expose, which keeps the fused-XLA
+path and lets callers parallelize instances however they like.
+
+Aggregation is mean-of-outputs (softmax probabilities average into a
+valid categorical; MSE outputs average into the ensemble regression),
+the reference's scheme."""
+
+import numpy
+
+from veles import prng
+from veles.logger import Logger
+
+
+class Ensemble(Logger):
+    """Trains and evaluates a bag of workflow instances."""
+
+    def __init__(self, workflow_factory, n_models=3, base_seed=1000,
+                 device="numpy", name="ensemble"):
+        self.name = name
+        self.workflow_factory = workflow_factory
+        self.n_models = int(n_models)
+        self.base_seed = int(base_seed)
+        self.device = device
+        self.workflows = []
+
+    def train(self):
+        """Train every member (each under its own seed universe)."""
+        for i in range(self.n_models):
+            prng.seed_all(self.base_seed + i)
+            wf = self.workflow_factory("%s_m%d" % (self.name, i))
+            wf.initialize(device=self.device)
+            wf.run()
+            best = getattr(wf.decision, "best_metric", None)
+            self.info("member %d trained: best metric %s", i, best)
+            self.workflows.append(wf)
+        return self.workflows
+
+    # -- aggregation ---------------------------------------------------
+
+    def _member_outputs(self, x):
+        """Forward ``x`` through every member (numpy path on the
+        synced weights); -> list of output arrays."""
+        outs = []
+        for wf in self.workflows:
+            step = getattr(wf, "xla_step", None)
+            if step is not None:
+                step.sync_host()
+            loader = wf.loader
+            loader.minibatch_data.map_invalidate()
+            loader.minibatch_data.mem[...] = x
+            for f in wf.forwards:
+                f.numpy_run()
+            outs.append(numpy.array(
+                wf.forwards[-1].output.map_read().mem))
+        return outs
+
+    def predict(self, x):
+        """Mean of member forward outputs on batch ``x``."""
+        return numpy.mean(self._member_outputs(x), axis=0)
+
+    def evaluate_classification(self):
+        """Ensemble + per-member error rate over the validation class
+        of member 0's loader (all members share the dataset contract)."""
+        from veles.loader.base import CLASS_VALID
+        loader = self.workflows[0].loader
+        data = numpy.asarray(loader.original_data.map_read().mem,
+                             numpy.float32)
+        labels = numpy.asarray(loader.original_labels.map_read().mem)
+        # validation samples live in the class-order layout
+        # [test | valid | train]
+        n_test = loader.class_lengths[0]
+        n_valid = loader.class_lengths[CLASS_VALID]
+        vx = data[n_test:n_test + n_valid]
+        vy = labels[n_test:n_test + n_valid]
+        mb = loader.max_minibatch_size
+        member_preds = [[] for _ in self.workflows]
+        ens_pred = []
+        for lo in range(0, len(vx), mb):
+            chunk = vx[lo:lo + mb]
+            valid = len(chunk)
+            if valid < mb:
+                chunk = numpy.concatenate(
+                    [chunk, numpy.repeat(chunk[-1:], mb - valid,
+                                         axis=0)])
+            outs = self._member_outputs(chunk)
+            for i, out in enumerate(outs):
+                member_preds[i].append(
+                    numpy.argmax(out, axis=-1)[:valid])
+            ens_pred.append(numpy.argmax(
+                numpy.mean(outs, axis=0), axis=-1)[:valid])
+        ens_pred = numpy.concatenate(ens_pred)
+        ens_err = float(numpy.mean(ens_pred != vy))
+        members = [float(numpy.mean(numpy.concatenate(p) != vy))
+                   for p in member_preds]
+        return {"ensemble_error": ens_err, "member_errors": members,
+                "n_valid": int(len(vy))}

@@ -1,0 +1,204 @@
+"""Genetic hyperparameter search over ``Tune`` config leaves.
+
+Re-design of ``veles/genetics/`` [U] (SURVEY.md §2.7 "Genetics", L9):
+config values wrapped in ``Tune(default, min, max)`` define the search
+space; each individual is one full (short) training run; fitness is
+the run's validation metric (lower is better). The reference
+distributed individuals over slaves; the rebuild evaluates them
+sequentially or via any caller-supplied parallel ``map_fn`` (the TPU
+analogue would be one individual per device/slice — plumbing a
+``map_fn`` keeps that open without hardcoding a topology).
+
+The optimizer is deliberately classic (tournament selection, blend
+crossover, gaussian mutation, elitism) and fully seeded: same seed ⇒
+same search trajectory, matching the framework's determinism contract
+(SURVEY.md §4).
+"""
+
+import numpy
+
+from veles.config import Config, Tune
+from veles.logger import Logger
+
+
+def find_tunables(node, prefix=""):
+    """Deep search for Tune leaves through Config nodes AND plain
+    dict/list values (layer specs are dicts inside a list leaf — the
+    reference's Tunes lived there too [U]). Paths are '/'-separated
+    segments; list positions are numeric segments."""
+    if isinstance(node, Config):
+        it = node.items()
+    elif isinstance(node, dict):
+        it = node.items()
+    elif isinstance(node, (list, tuple)):
+        it = enumerate(node)
+    else:
+        return {}
+    out = {}
+    for key, value in it:
+        path = "%s/%s" % (prefix, key) if prefix else str(key)
+        if isinstance(value, Tune):
+            out[path] = value
+        else:
+            out.update(find_tunables(value, path))
+    return out
+
+
+class GeneticOptimizer(Logger):
+    """Minimizes ``evaluate(values)`` over the box defined by
+    ``tunables`` (a ``{path: Tune}`` dict from ``Config.tunables()``).
+
+    ``evaluate`` receives ``{path: value}`` and returns a scalar
+    fitness (lower = better; NaN/inf = failed individual)."""
+
+    def __init__(self, evaluate, tunables, population_size=12,
+                 generations=8, elite=2, tournament=3,
+                 mutation_rate=0.25, mutation_sigma=0.2, seed=1,
+                 map_fn=None, name="genetics"):
+        if not tunables:
+            raise ValueError("nothing to optimize: no Tune leaves")
+        self.name = name
+        self.evaluate = evaluate
+        self.paths = sorted(tunables)
+        self.tunables = tunables
+        self.population_size = int(population_size)
+        self.generations = int(generations)
+        self.elite = int(elite)
+        self.tournament = int(tournament)
+        self.mutation_rate = float(mutation_rate)
+        self.mutation_sigma = float(mutation_sigma)
+        self.map_fn = map_fn or (lambda f, xs: [f(x) for x in xs])
+        self._gen = numpy.random.Generator(numpy.random.PCG64(seed))
+        #: (fitness, values) per generation champion
+        self.history = []
+        self.best_values = None
+        self.best_fitness = numpy.inf
+        self.evaluations = 0
+
+    # -- genome <-> values --------------------------------------------
+
+    def _decode(self, genome):
+        out = {}
+        for x, path in zip(genome, self.paths):
+            out[path] = self.tunables[path].clip(x)
+        return out
+
+    def _spans(self):
+        lo = numpy.array([self.tunables[p].min_value
+                          for p in self.paths], float)
+        hi = numpy.array([self.tunables[p].max_value
+                          for p in self.paths], float)
+        return lo, hi
+
+    # -- operators -----------------------------------------------------
+
+    def _initial_population(self):
+        lo, hi = self._spans()
+        pop = self._gen.uniform(lo, hi,
+                                (self.population_size, len(lo)))
+        # seed the defaults as individual 0 — the search must never be
+        # worse than the hand-tuned config
+        pop[0] = [float(self.tunables[p].default) for p in self.paths]
+        return pop
+
+    def _select(self, fitness):
+        idx = self._gen.integers(0, len(fitness), self.tournament)
+        return idx[numpy.argmin(fitness[idx])]
+
+    def _crossover(self, a, b):
+        # BLX-style blend: child uniform in the (slightly widened)
+        # interval spanned by the parents
+        lo = numpy.minimum(a, b)
+        hi = numpy.maximum(a, b)
+        span = hi - lo
+        return self._gen.uniform(lo - 0.1 * span, hi + 0.1 * span)
+
+    def _mutate(self, genome):
+        lo, hi = self._spans()
+        mask = self._gen.random(len(genome)) < self.mutation_rate
+        noise = self._gen.normal(0.0, self.mutation_sigma,
+                                 len(genome)) * (hi - lo)
+        return numpy.where(mask, genome + noise, genome)
+
+    # -- the search ----------------------------------------------------
+
+    def _fitness_of(self, pop):
+        vals = [self._decode(g) for g in pop]
+        out = numpy.asarray(self.map_fn(self._safe_eval, vals), float)
+        self.evaluations += len(vals)
+        return numpy.where(numpy.isfinite(out), out, numpy.inf)
+
+    def _safe_eval(self, values):
+        try:
+            return float(self.evaluate(values))
+        except Exception as exc:
+            self.warning("individual failed (%s): %r", exc, values)
+            return numpy.inf
+
+    def run(self):
+        pop = self._initial_population()
+        fitness = self._fitness_of(pop)
+        for gen in range(self.generations):
+            order = numpy.argsort(fitness)
+            pop, fitness = pop[order], fitness[order]
+            if fitness[0] < self.best_fitness:
+                self.best_fitness = float(fitness[0])
+                self.best_values = self._decode(pop[0])
+            self.history.append(
+                (float(fitness[0]), self._decode(pop[0])))
+            self.info("generation %d: best %.6g %r", gen,
+                      fitness[0], self.history[-1][1])
+            children = list(pop[:self.elite])
+            while len(children) < self.population_size:
+                a = pop[self._select(fitness)]
+                b = pop[self._select(fitness)]
+                children.append(self._mutate(self._crossover(a, b)))
+            pop = numpy.asarray(children)
+            # elites keep their known fitness; only newcomers pay a run
+            new_fit = self._fitness_of(pop[self.elite:])
+            fitness = numpy.concatenate([fitness[:self.elite], new_fit])
+        order = numpy.argsort(fitness)
+        if fitness[order[0]] < self.best_fitness:
+            self.best_fitness = float(fitness[order[0]])
+            self.best_values = self._decode(pop[order[0]])
+        return self.best_values, self.best_fitness
+
+
+def apply_values(config_root, values):
+    """Write ``{path: value}`` into the tree; paths use the
+    '/'-segment syntax of :func:`find_tunables`."""
+    for path, value in values.items():
+        node = config_root
+        segs = path.split("/")
+        for seg in segs[:-1]:
+            if isinstance(node, Config):
+                node = node.raw(seg)
+            elif isinstance(node, (list, tuple)):
+                node = node[int(seg)]
+            else:
+                node = node[seg]
+        last = segs[-1]
+        if isinstance(node, Config):
+            setattr(node, last, value)
+        elif isinstance(node, list):
+            node[int(last)] = value
+        else:
+            node[last] = value
+
+
+def optimize_config(config_root, run_one, **kwargs):
+    """Convenience driver for ``--optimize``: search every Tune under
+    ``config_root``; ``run_one()`` trains with the CURRENT config and
+    returns the validation metric. Returns the optimizer (best values
+    applied to the config on exit)."""
+    tunables = find_tunables(config_root)
+
+    def evaluate(values):
+        apply_values(config_root, values)
+        return run_one()
+
+    opt = GeneticOptimizer(evaluate, tunables, **kwargs)
+    best_values, best_fitness = opt.run()
+    if best_values is not None:
+        apply_values(config_root, best_values)
+    return opt

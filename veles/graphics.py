@@ -102,17 +102,28 @@ class GraphicsServer(Logger):
                  "--connect", str(self.port), "--out", out_dir],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
+    #: max seconds publish() may spend inside the kernel send buffer;
+    #: past this the renderer is declared too slow and LOSES THE FEED
+    #: (a timed-out sendall leaves a half frame on the wire, so the
+    #: connection cannot be kept)
+    send_timeout = 5.0
+
     def _accept(self):
         try:
             conn, _ = self._listener.accept()
+            conn.settimeout(self.send_timeout)
             with self._lock:
                 self._conn = conn
         except OSError:
             pass  # listener closed before anyone connected
 
     def publish(self, meta, arrays):
-        """Fire-and-forget: serialize + send; drop the frame if no
-        renderer is attached or the pipe broke."""
+        """Fire-and-forget: drop the frame if no renderer is attached,
+        the pipe broke, or the renderer is too slow to keep up — the
+        training loop must never stall on plotting."""
+        if self._conn is None:      # don't even serialize for nobody
+            self._dropped += 1
+            return False
         blob = pack_payload(meta, arrays)
         with self._lock:
             conn = self._conn
@@ -122,9 +133,13 @@ class GraphicsServer(Logger):
             try:
                 send_frame(conn, blob)
                 return True
-            except OSError:
+            except (OSError, socket.timeout):
                 self._dropped += 1
                 self._conn = None
+                conn.close()
+                self.warning(
+                    "renderer lost (%d frame(s) dropped so far)",
+                    self._dropped)
                 return False
 
     def close(self, wait=True):

@@ -15,6 +15,7 @@ there is no background reporting thread on the training side — the
 dashboard costs nothing between page loads (off the hot path,
 SURVEY.md §5.8)."""
 
+import html
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -36,7 +37,10 @@ _PAGE = """<!DOCTYPE html>
 
 
 def _row(cells, tag="td"):
-    return "<tr>" + "".join("<%s>%s</%s>" % (tag, c, tag)
+    # escape everything: /update accepts JSON from remote launchers,
+    # so names/values are untrusted page content
+    return "<tr>" + "".join("<%s>%s</%s>" % (tag, html.escape(str(c)),
+                                             tag)
                             for c in cells) + "</tr>"
 
 

@@ -63,11 +63,18 @@ class ImageSaver(Unit):
         return -1
 
     def run(self):
+        try:
+            self._run()
+        finally:
+            # epoch_ended is true ON an epoch's final serve: roll the
+            # directory/limit over only after that serve was filed
+            if bool(self.workflow.loader.epoch_ended):
+                self._epoch += 1
+                self._saved_this_epoch = 0
+
+    def _run(self):
         wf = self.workflow
         loader, ev = wf.loader, wf.evaluator
-        if bool(loader.epoch_ended):
-            self._epoch += 1
-            self._saved_this_epoch = 0
         if self.out_dir is None \
                 or self._saved_this_epoch >= self.limit_per_epoch:
             return

@@ -88,10 +88,13 @@ class Weights2D(PlotterBase):
         if getattr(u, "weights", None) is None or not u.weights:
             return None
         w = numpy.asarray(u.weights.map_read().mem, numpy.float32)
-        if getattr(u, "weights_transposed", False):
+        # want (units, fan_in) rows: conv stores (n_kernels, fan_in)
+        # already; dense stores (fan_in, neurons) unless transposed
+        if hasattr(u, "n_kernels") or getattr(
+                u, "weights_transposed", False):
             tiles = w
         else:
-            tiles = w.T                       # (neurons, fan_in)
+            tiles = w.T
         tiles = tiles[:self.limit]
         n, fan_in = tiles.shape
         # choose a near-square patch: conv kernels know their shape,

@@ -22,6 +22,7 @@ accelerated body runs as ONE compiled step (see
     start → repeater → loader → xla_step → decision → repeater
 """
 
+from veles.config import Tune
 from veles.units import Repeater
 from veles.znicz_tpu.decision import DecisionGD, DecisionMSE
 from veles.znicz_tpu.nn_units import (
@@ -39,6 +40,15 @@ def normalize_layers(layers):
             layer = {"type": kind, "->": {"output_sample_shape": layer}}
         out.append(dict(layer))
     return out
+
+
+def _resolved(spec):
+    """Layer-spec kwargs with Tune leaves collapsed to their defaults
+    (layer dicts are plain python, so Config's read-time Tune
+    resolution doesn't reach them; the genetic optimizer rewrites the
+    same leaves with concrete values)."""
+    return {k: (v.default if isinstance(v, Tune) else v)
+            for k, v in spec.items()}
 
 
 class StandardWorkflowBase(NNWorkflow):
@@ -76,7 +86,7 @@ class StandardWorkflowBase(NNWorkflow):
         prev_unit, prev_attr = src, src_attr
         for spec in self.layers_config:
             cls = forward_by_name(spec["type"])
-            kwargs = dict(spec.get("->", {}))
+            kwargs = _resolved(spec.get("->", {}))
             # an int output_shape_source names an earlier layer by
             # index (autoencoders pin deconv/depooling output sizes to
             # the mirrored forward's INPUT shape, reference-style [U])
@@ -127,7 +137,8 @@ class StandardWorkflowBase(NNWorkflow):
             fwd = self.forwards[i]
             spec = self.layers_config[i]
             cls = gradient_unit_for(type(fwd))
-            gd = cls(self, need_err_input=(i > 0), **spec.get("<-", {}))
+            gd = cls(self, need_err_input=(i > 0),
+                     **_resolved(spec.get("<-", {})))
             gd.setup_forward(fwd)
             if i == len(self.forwards) - 1:
                 gd.link_attrs(self.evaluator, "err_output")
@@ -166,6 +177,7 @@ class StandardWorkflowBase(NNWorkflow):
         rb = NNRollback(self, name="rollback", **cfg)
         rb.link_from(self.decision)
         self.rollback = rb
+        self._end_point_last()
         return rb
 
     def link_snapshotter(self, **cfg):
@@ -204,6 +216,7 @@ class StandardWorkflowBase(NNWorkflow):
             u.link_from(self.decision)
             u.gate_skip = ~self.decision.epoch_ended
         self.plotters = units
+        self._end_point_last()
         return units
 
     def link_image_saver(self, out_dir, **cfg):
@@ -215,12 +228,24 @@ class StandardWorkflowBase(NNWorkflow):
                            **cfg)
         saver.link_from(self.decision)
         self.image_saver = saver
+        self._end_point_last()
         return saver
 
     def link_end_point(self):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
         return self.end_point
+
+    def _end_point_last(self):
+        """Observers linked AFTER construction (plotters, image saver,
+        rollback) land after end_point in decision.links_to, so on the
+        FINAL serve the scheduler would reach end_point and stop before
+        running them. Re-linking moves end_point back to the end of the
+        signal order (links_to is ordered)."""
+        ep = self.end_point
+        if self.decision is not None and self.decision in ep.links_from:
+            ep.unlink_from(self.decision)
+            ep.link_from(self.decision)
 
     def create_workflow(self):
         self.link_repeater()
