@@ -1,0 +1,125 @@
+"""LM sharding from config alone (VERDICT item: ring/SP reachable
+without touching units) + Megatron-style TP over the model axis.
+Runs on the 8-device virtual CPU mesh (conftest)."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _restore_lm_config():
+    import veles.znicz_tpu.models.transformer_lm  # noqa: defaults
+    saved_loader = root.lm.loader.to_dict()
+    saved_epochs = root.lm.decision.get("max_epochs")
+    yield
+    root.lm.loader.update(saved_loader)
+    root.lm.decision.max_epochs = saved_epochs
+
+
+def _run_lm(name, parallel=None, max_epochs=3):
+    prng.seed_all(777)
+    from veles.znicz_tpu.models import transformer_lm
+    saved = root.lm.parallel.to_dict()
+    root.lm.loader.update({"minibatch_size": 32, "n_train": 256,
+                           "n_valid": 64})
+    root.lm.decision.max_epochs = max_epochs
+    root.lm.parallel.update(parallel or
+                            {"seq": 1, "model": 1, "data": 1})
+    try:
+        wf = transformer_lm.create_workflow(name=name)
+        wf.initialize(device="cpu")
+        wf.run()
+    finally:
+        root.lm.parallel.update(saved)
+    return wf
+
+
+@pytest.fixture(scope="module")
+def dense_wf():
+    return _run_lm("LMDense")
+
+
+def _history(wf):
+    return [h["validation"]["metric"] for h in wf.decision.history]
+
+
+def test_lm_dense_learns(dense_wf):
+    hist = _history(dense_wf)
+    assert hist[-1] < hist[0], hist
+
+
+def test_lm_ring_from_config(dense_wf):
+    """root.lm.parallel.seq=8 routes attention through the ppermute
+    ring; same seeds => same training trajectory as dense attention
+    (ring softmax is numerically exact up to fp reassociation)."""
+    wf = _run_lm("LMRing", {"seq": 8})
+    from veles.znicz_tpu.ops.attention import MultiHeadAttention
+    mha = [f for f in wf.forwards
+           if isinstance(f, MultiHeadAttention)]
+    assert mha and all(f.seq_mesh is not None for f in mha), \
+        "config did not engage the ring path"
+    ring, dense = _history(wf), _history(dense_wf)
+    assert ring[-1] < ring[0]
+    for a, b in zip(ring, dense):
+        assert abs(a - b) < 0.05, (ring, dense)
+
+
+def test_lm_tensor_parallel_from_config(dense_wf):
+    """root.lm.parallel.model=4 shards qkv/up column-wise and out/down
+    row-wise; GSPMD inserts the collectives. Same math => same
+    trajectory as the unsharded run."""
+    wf = _run_lm("LMTP", {"model": 4})
+    step = wf.xla_step
+    assert step.param_sharding_map, "TP sharding map not installed"
+    # params are REALLY sharded on the mesh
+    import jax
+    from veles.znicz_tpu.ops.attention import TransformerFFN
+    ffn = next(f for f in wf.forwards if isinstance(f, TransformerFFN))
+    leaf = step.params[ffn.name]["weights"]
+    assert len(leaf.sharding.device_set) == 4
+    spec = leaf.sharding.spec
+    assert tuple(spec) == (None, "model"), spec
+    tp, dense = _history(wf), _history(dense_wf)
+    assert tp[-1] < tp[0]
+    for a, b in zip(tp, dense):
+        assert abs(a - b) < 0.05, (tp, dense)
+
+
+def test_lm_dp_plus_tp(dense_wf):
+    """2-way data x 4-way model on one mesh."""
+    wf = _run_lm("LMDPTP", {"data": 2, "model": 4})
+    step = wf.xla_step
+    assert step.batch_sharding is not None
+    assert step.param_sharding_map
+    hist, dense = _history(wf), _history(dense_wf)
+    assert hist[-1] < hist[0]
+    for a, b in zip(hist, dense):
+        assert abs(a - b) < 0.05, (hist, dense)
+
+
+def test_lm_sp_plus_dp(dense_wf):
+    """2-way data x 4-way seq on ONE composed mesh: the ring shards
+    the sequence while the batch shards over data."""
+    wf = _run_lm("LMSPDP", {"data": 2, "seq": 4})
+    from veles.znicz_tpu.ops.attention import MultiHeadAttention
+    mha = next(f for f in wf.forwards
+               if isinstance(f, MultiHeadAttention))
+    assert mha.seq_mesh is not None
+    assert mha.seq_batch_axis == "data"
+    assert dict(mha.seq_mesh.shape) == {"data": 2, "seq": 4}
+    hist, dense = _history(wf), _history(dense_wf)
+    assert hist[-1] < hist[0]
+    for a, b in zip(hist, dense):
+        assert abs(a - b) < 0.05, (hist, dense)
+
+
+def test_tp_grad_sync_accounting(dense_wf):
+    """grad_sync_bytes still reports the full trainable payload."""
+    from veles.znicz_tpu import parallel
+    import jax
+    host = jax.tree_util.tree_map(
+        lambda a: numpy.asarray(a), dense_wf.xla_step.params)
+    assert parallel.grad_sync_bytes(host) > 0

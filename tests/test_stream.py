@@ -10,6 +10,20 @@ import veles.prng as prng
 from veles.config import root
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _restore_mnist_config():
+    """These tests shrink root.mnist.loader; other modules rely on the
+    sample defaults, so restore after the module. Module-scoped and
+    autouse so it wraps (runs before) the module-scoped build
+    fixtures that do the mutation."""
+    import veles.znicz_tpu.models.mnist  # noqa: ensure defaults exist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    yield
+    root.mnist.loader.update(
+        {k: v for k, v in saved.items() if v is not None})
+
+
 def _mnist_arrays():
     from veles.znicz_tpu.models import datasets
     tx, ty, vx, vy = datasets.load_mnist(n_train=400, n_valid=100)

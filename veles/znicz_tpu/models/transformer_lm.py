@@ -26,6 +26,12 @@ root.lm.update({
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
               "weights_decay": 0.0},
     "decision": {"max_epochs": 8, "fail_iterations": 50},
+    # sharding axes (SURVEY.md §5.7/§5.8): seq > 1 routes attention
+    # through the ppermute ring (sequence parallelism); model > 1
+    # shards the transformer matmuls Megatron-style via GSPMD; data
+    # > 1 shards the batch. All from config alone — e.g.
+    #   velescli ... root.lm.parallel.seq=8
+    "parallel": {"seq": 1, "model": 1, "data": 1},
 })
 
 
@@ -93,9 +99,53 @@ def lm_evaluator_factory(wf, last):
     return ev
 
 
+class TransformerLMWorkflow(StandardWorkflow):
+    """StandardWorkflow + config-driven sharding: after initialize,
+    ``root.lm.parallel`` picks ring attention (seq), Megatron TP
+    (model) and/or batch DP (data) — no code required in user
+    configs."""
+
+    def initialize(self, device=None, **kwargs):
+        out = super().initialize(device=device, **kwargs)
+        self._setup_parallel()
+        return out
+
+    def _setup_parallel(self):
+        if self.xla_step is None:       # numpy oracle backend
+            return
+        cfg = root.lm.get("parallel")
+        spec = cfg.to_dict() if hasattr(cfg, "to_dict") else \
+            dict(cfg or {})
+        seq = int(spec.get("seq", 1))
+        model = int(spec.get("model", 1))
+        data = int(spec.get("data", 1))
+        if max(seq, model, data) <= 1:
+            return
+        from veles.znicz_tpu import parallel
+        # ONE composed mesh over every requested axis: all shardings
+        # must agree on device assignment or jit rejects the step
+        axes = {}
+        if data > 1:
+            axes["data"] = data
+        if seq > 1:
+            axes["seq"] = seq
+        if model > 1:
+            axes["model"] = model
+        mesh = parallel.make_mesh(axes)
+        if seq > 1:
+            parallel.setup_sequence_parallel(
+                self, mesh, batch_axis="data" if data > 1 else None)
+        if data > 1:
+            parallel.setup_data_parallel(self, mesh, refresh=False)
+        if model > 1:
+            # skips attention units already owned by the ring path
+            parallel.setup_tensor_parallel(self, mesh, refresh=False)
+        self.xla_step.refresh_device()
+
+
 def create_workflow(name="TransformerLM", **kwargs):
     cfg = root.lm
-    return StandardWorkflow(
+    return TransformerLMWorkflow(
         None, name=name,
         layers=build_layers(),
         loader_factory=lambda wf: PeriodicLMLoader(
@@ -107,7 +157,7 @@ def create_workflow(name="TransformerLM", **kwargs):
 
 
 def run(load, main):
-    load(StandardWorkflow,
+    load(TransformerLMWorkflow,
          layers=build_layers(),
          loader_factory=lambda wf: PeriodicLMLoader(
              wf, name="loader",

@@ -320,6 +320,8 @@ class MultiHeadAttention(Forward):
         #: materialising the (B,H,S,S) score matrix
         self.seq_mesh = None
         self.seq_axis = "seq"
+        #: extra batch-dim sharding axis on a composed SPxDP mesh
+        self.seq_batch_axis = None
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -420,7 +422,7 @@ class MultiHeadAttention(Forward):
         v = self._split(qkv[..., 2 * d:])
         out_heads, lse = ring.ring_self_attention(
             q, k, v, self.seq_mesh, axis=self.seq_axis,
-            causal=self.causal)
+            causal=self.causal, batch_axis=self.seq_batch_axis)
         merged = self._merge(out_heads)
         y = merged @ p["weights_out"]
         if self.include_bias:
@@ -527,7 +529,8 @@ class GDMultiHeadAttention(GradientDescentBase):
         dctx = f._split(dmerged)
         dq, dk, dv = ring.ring_self_attention_bwd(
             q, k, v, out_heads, lse, dctx, f.seq_mesh,
-            axis=f.seq_axis, causal=f.causal)
+            axis=f.seq_axis, causal=f.causal,
+            batch_axis=f.seq_batch_axis)
         dqkv = xp.concatenate(
             [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
         gw = x.reshape(-1, d).T @ dqkv.reshape(-1, 3 * d)

@@ -64,17 +64,110 @@ def grad_sync_bytes(params):
                    for l in leaves))
 
 
-def setup_data_parallel(workflow, mesh=None):
+def setup_data_parallel(workflow, mesh=None, axis="data",
+                        refresh=True):
     """Configure an initialized XLA workflow for DP over ``mesh``:
-    batch tensors sharded over 'data', params/state replicated."""
+    batch tensors sharded over ``axis``, params/state replicated
+    (clears any earlier TP sharding map — pass ``refresh=False`` when
+    composing with :func:`setup_tensor_parallel`, which re-places)."""
     if mesh is None:
         mesh = make_mesh()
     step = workflow.xla_step
     if step is None:
         raise ValueError("workflow has no xla_step (numpy backend?)")
     step.sync_host()  # device values are the truth mid-run
-    step.batch_sharding = batch_sharding(mesh)
+    step.batch_sharding = batch_sharding(mesh, axis)
     step.param_sharding = replicated(mesh)
+    step.param_sharding_map = {}
     workflow.device.mesh = mesh
-    step.refresh_device()
+    if refresh:
+        step.refresh_device()
+    return mesh
+
+
+def setup_sequence_parallel(workflow, mesh, axis="seq",
+                            batch_axis=None):
+    """Route every attention unit through the ring path (SP): K/V
+    blocks stream around ``axis`` via ``ppermute`` instead of
+    materialising (B,H,S,S) scores — see ``parallel/ring.py``. Call
+    after ``initialize`` and before the first step (the choice bakes
+    into the trace). The axis size must divide the sequence length.
+    ``batch_axis`` names the mesh axis the batch dim is sharded over
+    when composing SP with DP on one mesh."""
+    from veles.znicz_tpu.ops.attention import MultiHeadAttention
+    n = mesh.shape[axis]
+    touched = 0
+    for fwd in workflow.forwards:
+        if isinstance(fwd, MultiHeadAttention):
+            s = fwd.input.shape[1]
+            if s % n:
+                raise ValueError(
+                    "%s axis size %d does not divide sequence "
+                    "length %d" % (axis, n, s))
+            fwd.seq_mesh = mesh
+            fwd.seq_axis = axis
+            fwd.seq_batch_axis = batch_axis
+            touched += 1
+    if not touched:
+        raise ValueError("no attention units to sequence-parallelize")
+    return mesh
+
+
+def setup_tensor_parallel(workflow, mesh, axis="model", refresh=True):
+    """Megatron-style TP for the transformer units, the GSPMD way: no
+    hand-written collectives — the qkv/up projections are
+    column-sharded over ``axis``, the out/down projections row-sharded,
+    and XLA's auto-partitioner inserts the all-reduces where the
+    row-sharded contractions need them (SURVEY.md §7 design stance:
+    'let XLA insert collectives'). Momentum state shards like its
+    parameter so optimizer memory scales down with TP too."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from veles.znicz_tpu.ops.attention import (
+        MultiHeadAttention, TransformerFFN)
+    step = workflow.xla_step
+    if step is None:
+        raise ValueError("workflow has no xla_step (numpy backend?)")
+    n = mesh.shape[axis]
+    col = NamedSharding(mesh, P(None, axis))   # (D, k·D) split outputs
+    row = NamedSharding(mesh, P(axis, None))   # (H, D) split inputs
+    vec = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    smap = {}
+    touched = 0
+    for i, fwd in enumerate(workflow.forwards):
+        gd = workflow.gds[i] if i < len(workflow.gds) else None
+
+        def put(key, sh, vel_key=None):
+            smap[(fwd.name, key)] = sh
+            if gd is not None and vel_key:
+                smap[(gd.name, vel_key)] = sh
+        if isinstance(fwd, MultiHeadAttention):
+            if (fwd.heads % n) or fwd.seq_mesh is not None:
+                continue   # head split impossible / ring owns attention
+            put("weights", col, "vel_weights")
+            put("bias", vec, "vel_bias")
+            put("weights_out", row, "vel_weights_out")
+            put("bias_out", rep, "vel_bias_out")
+            touched += 1
+        elif isinstance(fwd, TransformerFFN):
+            if fwd.hidden and fwd.hidden % n:
+                continue
+            put("weights", col, "vel_weights")
+            put("bias", vec, "vel_bias")
+            put("weights2", row, "vel_weights2")
+            put("bias2", rep, "vel_bias2")
+            touched += 1
+    if not touched:
+        raise ValueError("no TP-shardable units found")
+    step.sync_host()
+    step.param_sharding_map = smap
+    if step.param_sharding is None:
+        step.param_sharding = replicated(mesh)
+    if step.batch_sharding is None:
+        # same mesh, batch replicated: keeps every step input committed
+        # to one device set so jit never sees mixed placements
+        step.batch_sharding = replicated(mesh)
+    workflow.device.mesh = mesh
+    if refresh:
+        step.refresh_device()
     return mesh

@@ -132,16 +132,19 @@ def ring_attention_bwd(q, k, v, out, lse, dout, axis_name, causal,
     return dq, dk, dv
 
 
-def ring_self_attention(q, k, v, mesh, axis="seq", causal=True):
+def ring_self_attention(q, k, v, mesh, axis="seq", causal=True,
+                        batch_axis=None):
     """Dense-equivalent attention with the sequence sharded over
     ``axis``. q/k/v: (B, H, S, dh) global arrays. Returns (out, lse)
-    global arrays (out sharded like q)."""
+    global arrays (out sharded like q). On a composed mesh,
+    ``batch_axis`` additionally shards the batch dim (SP x DP) —
+    attention is per-sample, so each data-group rings independently."""
     from jax.sharding import PartitionSpec as P
     shard_map = _shard_map()
 
     n_dev = mesh.shape[axis]
-    spec = P(None, None, axis, None)
-    lspec = P(None, None, axis)
+    spec = P(batch_axis, None, axis, None)
+    lspec = P(batch_axis, None, axis)
 
     fn = shard_map(
         functools.partial(ring_attention_fwd, axis_name=axis,
@@ -152,14 +155,14 @@ def ring_self_attention(q, k, v, mesh, axis="seq", causal=True):
 
 
 def ring_self_attention_bwd(q, k, v, out, lse, dout, mesh, axis="seq",
-                            causal=True):
+                            causal=True, batch_axis=None):
     import functools as ft
     from jax.sharding import PartitionSpec as P
     shard_map = _shard_map()
 
     n_dev = mesh.shape[axis]
-    spec = P(None, None, axis, None)
-    lspec = P(None, None, axis)
+    spec = P(batch_axis, None, axis, None)
+    lspec = P(batch_axis, None, axis)
     fn = shard_map(
         ft.partial(ring_attention_bwd, axis_name=axis, causal=causal,
                    n_dev=n_dev),

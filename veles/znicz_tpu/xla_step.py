@@ -49,6 +49,11 @@ class XLAStep(Unit):
         self.batch_sharding = None
         #: sharding for params/state (replicated under DP)
         self.param_sharding = None
+        #: per-leaf override map {(unit_name, key): NamedSharding} —
+        #: tensor parallelism (parallel.setup_tensor_parallel) shards
+        #: individual weight matrices; unmapped leaves fall back to
+        #: param_sharding
+        self.param_sharding_map = {}
 
     # -- assembly ------------------------------------------------------
 
@@ -67,10 +72,8 @@ class XLAStep(Unit):
         super().initialize(**kwargs)
         self.device = device or getattr(self.workflow, "device", None)
         self.compiler = StepCompiler(self.train_units, self.device)
-        self.params = _device_tree(self.compiler.gather_params(),
-                                   self.param_sharding)
-        self.state = _device_tree(self.compiler.gather_state(),
-                                  self.param_sharding)
+        self.params = self._place_tree(self.compiler.gather_params())
+        self.state = self._place_tree(self.compiler.gather_state())
         from veles import prng
         self.base_key = prng.get("xla_step").jax_key()
         self._batch_spec = self._build_batch_spec()
@@ -167,6 +170,30 @@ class XLAStep(Unit):
                     v, self.batch_sharding if v.ndim else None)
                 for k, v in batch.items()}
         return batch
+
+    def _batch_axis(self):
+        """Mesh axis the minibatch dim shards over, or None when the
+        batch sharding is replicated (TP-only mesh)."""
+        spec = self.batch_sharding.spec
+        return spec[0] if len(spec) else None
+
+    def _pad_batch_dim(self, arr, dim):
+        """Pad ``dim`` (the within-minibatch dim) to a multiple of the
+        batch axis size by repeating the last row — `valids` masking
+        zeroes the pad rows' loss/gradient contribution."""
+        from veles.memory import roundup
+        axis = self._batch_axis()
+        if axis is None:
+            return arr
+        n_dev = self.batch_sharding.mesh.shape[axis]
+        mb = arr.shape[dim]
+        mb_pad = roundup(mb, n_dev)
+        if mb_pad == mb:
+            return arr
+        last = [slice(None)] * arr.ndim
+        last[dim] = slice(-1, None)
+        pad = numpy.repeat(arr[tuple(last)], mb_pad - mb, axis=dim)
+        return numpy.concatenate([arr, pad], axis=dim)
 
     def _gather_hyper(self):
         # custom trainers (Kohonen/RBM) bake their schedules into the
@@ -291,21 +318,12 @@ class XLAStep(Unit):
             if self.batch_sharding is not None:
                 # shard the within-minibatch (batch) dim over the data
                 # axis: on-device gathers execute shard-local and DP
-                # falls out of XLA auto-partitioning
+                # falls out of XLA auto-partitioning. An empty spec
+                # (TP-only mesh) replicates instead.
                 from jax.sharding import NamedSharding, PartitionSpec
-                from veles.memory import roundup
                 mesh = self.batch_sharding.mesh
-                axis = self.batch_sharding.spec[0]
-                n_dev = mesh.shape[axis]
-                mb = idx_stack.shape[2]
-                mb_pad = roundup(mb, n_dev)
-                if mb_pad != mb:
-                    # pad rows repeat the last index; `valids` masking
-                    # already zeroes their loss/gradient contribution
-                    pad = numpy.repeat(idx_stack[:, :, -1:],
-                                       mb_pad - mb, axis=2)
-                    idx_stack = numpy.concatenate([idx_stack, pad],
-                                                  axis=2)
+                axis = self._batch_axis()
+                idx_stack = self._pad_batch_dim(idx_stack, 2)
                 idx_stack = jax.device_put(idx_stack, NamedSharding(
                     mesh, PartitionSpec(None, None, axis)))
                 vl = jax.device_put(vl, NamedSharding(
@@ -390,19 +408,13 @@ class XLAStep(Unit):
             self._last_put = list(out.values())
             return out
         from jax.sharding import NamedSharding, PartitionSpec
-        from veles.memory import roundup
         mesh = self.batch_sharding.mesh
-        axis = self.batch_sharding.spec[0]
-        n_dev = mesh.shape[axis]
+        axis = self._batch_axis()
         out = {}
         for k, v in stacked.items():
-            mb = v.shape[1]
-            mb_pad = roundup(mb, n_dev)
-            if mb_pad != mb:
-                pad = numpy.repeat(v[:, -1:], mb_pad - mb, axis=1)
-                v = numpy.concatenate([v, pad], axis=1)
-            out[k] = jax.device_put(v, NamedSharding(
-                mesh, PartitionSpec(None, axis)))
+            out[k] = jax.device_put(
+                self._pad_batch_dim(v, 1),
+                NamedSharding(mesh, PartitionSpec(None, axis)))
         self._last_put = list(out.values())
         return out
 
@@ -576,14 +588,26 @@ class XLAStep(Unit):
                         and arr:
                     arr.map_read()
 
+    def _place_tree(self, tree):
+        """device_put a {unit: {key: array}} tree honouring the
+        per-leaf TP sharding map, default param_sharding otherwise."""
+        import jax
+        if not self.param_sharding_map:
+            return _device_tree(tree, self.param_sharding)
+        return {
+            uname: {
+                key: jax.device_put(
+                    arr, self.param_sharding_map.get(
+                        (uname, key), self.param_sharding))
+                for key, arr in sub.items()}
+            for uname, sub in tree.items()}
+
     def refresh_device(self):
         """Re-upload params/state after host-side mutation (snapshot
         resume, master weight push). For a mid-run sharding change call
         sync_host() first — host Arrays are the source of truth here."""
-        self.params = _device_tree(self.compiler.gather_params(),
-                                   self.param_sharding)
-        self.state = _device_tree(self.compiler.gather_state(),
-                                  self.param_sharding)
+        self.params = self._place_tree(self.compiler.gather_params())
+        self.state = self._place_tree(self.compiler.gather_state())
 
 
 def _drain_pending(pending, outs_per_cls, keep):
