@@ -1,0 +1,206 @@
+"""Pluggable normalizer family (SURVEY.md §2.3) + weight diversity
+diagnostics (§2.4)."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+from veles.normalization import NORMALIZERS, factory
+
+
+@pytest.fixture
+def data(rng):
+    return rng.normal(3.0, 2.0, (50, 12)).astype(numpy.float32)
+
+
+def test_registry_names():
+    assert {"none", "linear", "range_linear", "mean_disp",
+            "pointwise", "external_mean"} <= set(NORMALIZERS)
+
+
+def test_linear_global_range(data):
+    n = factory("linear")
+    n.analyze(data[:25])
+    n.analyze(data[25:])       # streaming accumulation
+    out = n.normalize(data)
+    assert out.min() == pytest.approx(-1.0, abs=1e-6)
+    assert out.max() == pytest.approx(1.0, abs=1e-6)
+
+
+def test_range_linear_fixed():
+    n = factory("range_linear", source_range=(0, 255))
+    out = n.normalize(numpy.array([0.0, 127.5, 255.0]))
+    numpy.testing.assert_allclose(out, [-1.0, 0.0, 1.0], atol=1e-6)
+
+
+def test_mean_disp_per_feature(data):
+    n = factory("mean_disp")
+    n.analyze(data)
+    out = n.normalize(data)
+    numpy.testing.assert_allclose(out.mean(axis=0),
+                                  numpy.zeros(12), atol=1e-4)
+    # centered on the MEAN, scaled by half the range: |out| < 2
+    assert numpy.abs(out).max() <= 2.0 + 1e-5
+    mean, rdisp = n.mean_rdisp(data.shape[1:])
+    numpy.testing.assert_allclose((data - mean) * rdisp, out,
+                                  atol=1e-5)
+
+
+def test_pointwise_constant_feature(data):
+    data[:, 0] = 7.0           # constant feature must not blow up
+    n = factory("pointwise")
+    n.analyze(data)
+    out = n.normalize(data)
+    assert numpy.all(out[:, 0] == 0.0)
+    assert out[:, 1:].min() == pytest.approx(-1.0, abs=1e-6)
+    assert out[:, 1:].max() == pytest.approx(1.0, abs=1e-6)
+
+
+def test_external_mean():
+    mean = numpy.full(4, 10.0, numpy.float32)
+    n = factory("external_mean", mean=mean, scale=0.5)
+    out = n.normalize(numpy.full((2, 4), 12.0))
+    numpy.testing.assert_allclose(out, 1.0)
+
+
+def test_state_roundtrip(data):
+    n = factory("mean_disp")
+    n.analyze(data)
+    n.normalize(data)
+    n2 = factory("mean_disp")
+    n2.set_state(n.state())
+    numpy.testing.assert_array_equal(n2.normalize(data),
+                                     n.normalize(data))
+
+
+def test_affine_probe_matches(data):
+    """Base mean_rdisp derives (mean, rdisp) for any affine member."""
+    n = factory("linear")
+    n.analyze(data)
+    mean, rdisp = n.mean_rdisp(data.shape[1:])
+    numpy.testing.assert_allclose((data - mean) * rdisp,
+                                  n.normalize(data), atol=1e-4)
+
+
+def test_unknown_type_raises():
+    with pytest.raises(KeyError, match="unknown normalization_type"):
+        factory("bogus")
+
+
+# -- loader integration -----------------------------------------------
+
+
+def test_fullbatch_loader_normalizes():
+    prng.seed_all(606)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.mnist.loader.update(
+        {"n_train": 200, "n_valid": 80, "minibatch_size": 40})
+    root.mnist.decision.max_epochs = 2
+    try:
+        wf_raw = mnist.create_workflow(name="NormOff")
+        wf_raw.initialize(device="numpy")
+        wf = mnist.StandardWorkflow(
+            None, name="NormOn", layers=root.mnist.layers,
+            loader_factory=lambda w: mnist.MnistLoader(
+                w, name="loader",
+                minibatch_size=root.mnist.loader.minibatch_size,
+                normalization_type="mean_disp"),
+            decision_config=root.mnist.decision.to_dict())
+        wf.initialize(device="numpy")
+        d = wf.loader.original_data.mem
+        train0 = wf.loader.class_offset(2)
+        # train rows are centered; raw data was not
+        assert abs(d[train0:].mean()) < 0.05
+        assert abs(wf_raw.loader.original_data.mem.mean()) > 0.05
+        wf.run()
+        hist = [h["validation"]["metric"]
+                for h in wf.decision.history]
+        assert hist[-1] <= hist[0]
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = 5
+
+
+def test_externally_assigned_data_is_normalized(rng):
+    """Originals set BEFORE initialize (the documented FullBatchLoader
+    pattern) must be normalized too."""
+    from veles.loader.fullbatch import FullBatchLoader
+    from veles.workflow import Workflow
+    wf = Workflow(None, name="ExtNorm")
+    ld = FullBatchLoader(wf, name="loader", minibatch_size=10,
+                         normalization_type="linear")
+    data = rng.uniform(0, 255, (30, 8)).astype(numpy.float32)
+    ld.original_data.mem = data.copy()
+    ld.class_lengths = [0, 10, 20]
+    ld.initialize()
+    d = ld.original_data.mem
+    # stats fit on TRAIN rows only: those map exactly into [-1, 1];
+    # eval rows may poke slightly past
+    train = d[10:]
+    assert train.min() == pytest.approx(-1.0, abs=1e-5)
+    assert train.max() == pytest.approx(1.0, abs=1e-5)
+    assert d.min() >= -1.2 and d.max() <= 1.2
+    # idempotent on re-initialize (snapshot resume path)
+    ld.initialize()
+    numpy.testing.assert_array_equal(ld.original_data.mem, d)
+
+
+def test_streaming_loader_rejects_normalizer(rng):
+    """Loaders without the hook must fail loudly, not silently train
+    on raw data."""
+    from veles.loader.stream import ArrayStreamLoader
+    from veles.workflow import Workflow
+    wf = Workflow(None, name="StreamNorm")
+    ld = ArrayStreamLoader(wf, name="loader", minibatch_size=10,
+                           normalization_type="mean_disp")
+    ld.data = rng.uniform(0, 1, (30, 8)).astype(numpy.float32)
+    ld.labels = numpy.zeros(30, numpy.int32)
+    ld.class_lengths = [0, 10, 20]
+    with pytest.raises(NotImplementedError, match="normalization"):
+        ld.initialize()
+
+
+# -- diversity --------------------------------------------------------
+
+
+def test_diversity_stats_flags_duplicates():
+    from veles.znicz_tpu.diversity import diversity_stats
+    rng = numpy.random.default_rng(4)
+    w = rng.normal(0, 1, (6, 20)).astype(numpy.float32)
+    w[3] = w[0] * 2.0          # duplicate direction
+    w[5] = 0.0                 # dead filter
+    stats = diversity_stats(w)
+    assert stats["n_units"] == 6
+    assert stats["similar_pairs"] >= 1
+    assert stats["dead_units"] == 1
+    assert stats["max_abs_similarity"] >= 0.99
+
+
+def test_weight_diversity_unit(tmp_path):
+    prng.seed_all(707)
+    from veles.znicz_tpu.diversity import WeightDiversity
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.mnist.loader.update(
+        {"n_train": 200, "n_valid": 80, "minibatch_size": 40})
+    root.mnist.decision.max_epochs = 2
+    try:
+        wf = mnist.create_workflow(name="DivWF")
+        div = WeightDiversity(wf, name="diversity",
+                              out_dir=str(tmp_path))
+        div.link_from(wf.decision)
+        div.gate_skip = ~wf.decision.epoch_ended
+        wf._end_point_last()
+        wf.initialize(device="cpu")
+        wf.run()
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = 5
+    assert div.stats is not None and len(div.history) == 2
+    assert div.stats["n_units"] == 100
+    import os
+    assert os.path.exists(str(tmp_path / "diversity.png"))

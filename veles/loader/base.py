@@ -41,11 +41,18 @@ class Loader(Unit, IDistributable):
     supports_streaming = False
 
     def __init__(self, workflow, minibatch_size=100, shuffle=True,
-                 prng_key="loader", **kwargs):
+                 prng_key="loader", normalization_type=None,
+                 normalization_parameters=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.max_minibatch_size = int(minibatch_size)
         self.shuffle_enabled = bool(shuffle)
         self.prng = prng.get(prng_key)
+        #: pluggable input normalizer (SURVEY.md §2.3 "Normalizers");
+        #: fitted on TRAIN data, applied per loader subclass
+        from veles.normalization import factory
+        self.normalizer = factory(normalization_type,
+                                  **(normalization_parameters or {}))
+        self._normalization_applied = False
 
         #: samples per class: [test, valid, train]
         self.class_lengths = [0, 0, 0]
@@ -109,12 +116,28 @@ class Loader(Unit, IDistributable):
 
     # -- lifecycle -----------------------------------------------------
 
+    def apply_normalization(self):
+        """Fit + apply ``self.normalizer`` (subclass hook). The base
+        FAILS LOUDLY when a normalizer was configured on a loader that
+        has no implementation — a silently-dropped normalization_type
+        would train on raw data without warning."""
+        from veles.normalization import NoneNormalizer
+        if not isinstance(self.normalizer, NoneNormalizer):
+            raise NotImplementedError(
+                "%s does not implement pluggable normalization "
+                "(normalization_type=%r); use a full-batch loader or "
+                "normalize in load_data/fill_minibatch"
+                % (type(self).__name__, self.normalizer.NAME))
+
     def initialize(self, **kwargs):
         super().initialize(**kwargs)
         if self.total_samples == 0:
             self.load_data()
         if self.total_samples == 0:
             raise ValueError("%s loaded an empty dataset" % self.name)
+        if not self._normalization_applied:   # idempotent on resume
+            self.apply_normalization()
+            self._normalization_applied = True
         self.create_minibatch_data()
         if not self.minibatch_indices:
             self.minibatch_indices.reset(

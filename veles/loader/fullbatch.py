@@ -27,6 +27,31 @@ class FullBatchLoader(Loader):
         #: dtype the minibatch is served in (normalized float input)
         self.serve_dtype = numpy.float32
 
+    def apply_normalization(self):
+        """Fit the normalizer on the TRAIN rows (the loader layout is
+        [test | valid | train]) and transform the resident data in
+        place — eval data never leaks into the statistics. Targets are
+        re-pointed only when they ALIAS the data buffer (autoencoders);
+        separate regression targets have their own feature space, so
+        input statistics must not touch them."""
+        from veles.normalization import NoneNormalizer
+        if isinstance(self.normalizer, NoneNormalizer):
+            return
+        data = self.original_data.mem
+        train0 = self.class_offset(2)
+        if train0 >= len(data):
+            self.warning(
+                "no train samples: %s normalization skipped (restore "
+                "fitted statistics from a checkpoint for inference)",
+                self.normalizer.NAME)
+            return
+        aliased = self.original_targets \
+            and self.original_targets.mem is data
+        self.normalizer.analyze(data[train0:])
+        self.original_data.mem = self.normalizer.normalize(data)
+        if aliased:
+            self.original_targets.mem = self.original_data.mem
+
     def load_data(self):
         """Default: originals were assigned externally before
         initialize(); subclasses override to actually read a dataset."""

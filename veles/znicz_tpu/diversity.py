@@ -1,0 +1,79 @@
+"""Weight diversity diagnostics.
+
+Re-design of znicz ``diversity.py`` [U] (SURVEY.md §2.4 "Weight
+diagnostics": similarity/diversity stats of learned filters). Filters
+that converge to near-duplicates waste capacity; these helpers measure
+pairwise cosine similarity of a layer's weight rows and flag
+degenerate pairs, and :class:`WeightDiversity` runs the analysis once
+per epoch as a graph unit (publishing the similarity matrix through
+the plotting pipeline when one is attached)."""
+
+import numpy
+
+from veles.znicz_tpu.nn_plotting_units import PlotterBase
+
+
+def similarity_matrix(weights):
+    """Pairwise cosine similarity of weight ROWS (units × fan_in)."""
+    w = numpy.asarray(weights, numpy.float32)
+    w = w.reshape(len(w), -1)
+    norms = numpy.linalg.norm(w, axis=1, keepdims=True)
+    wn = w / numpy.where(norms == 0, 1.0, norms)
+    return wn @ wn.T
+
+
+def diversity_stats(weights, threshold=0.98, sim=None):
+    """Summary dict: mean/max |off-diagonal similarity|, the number of
+    near-duplicate pairs (|cos| >= threshold) and the count of dead
+    (all-zero) filters. Pass a precomputed ``sim`` matrix to avoid
+    recomputing it."""
+    w = numpy.asarray(weights, numpy.float32).reshape(
+        len(weights), -1)
+    if sim is None:
+        sim = similarity_matrix(w)
+    n = len(sim)
+    off = numpy.abs(sim[~numpy.eye(n, dtype=bool)])
+    dupes = int((numpy.abs(numpy.triu(sim, 1)) >= threshold).sum())
+    dead = int((numpy.linalg.norm(w, axis=1) == 0).sum())
+    return {
+        "n_units": n,
+        "mean_abs_similarity": float(off.mean()) if n > 1 else 0.0,
+        "max_abs_similarity": float(off.max()) if n > 1 else 0.0,
+        "similar_pairs": dupes,
+        "dead_units": dead,
+    }
+
+
+class WeightDiversity(PlotterBase):
+    """Per-epoch diversity analysis of one forward unit's weights
+    (default: the first layer — where filter collapse is visible).
+    ``stats`` holds the latest summary; the similarity matrix renders
+    through the graphics pipeline like any plot unit."""
+
+    def __init__(self, workflow, unit=None, threshold=0.98, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.unit = unit
+        self.threshold = float(threshold)
+        self.stats = None
+        self.history = []
+
+    def make_payload(self):
+        u = self.unit or self.workflow.forwards[0]
+        if getattr(u, "weights", None) is None or not u.weights:
+            return None
+        w = numpy.asarray(u.weights.map_read().mem, numpy.float32)
+        # want rows = units: dense stores (fan_in, neurons) untransposed
+        if not hasattr(u, "n_kernels") and not getattr(
+                u, "weights_transposed", False):
+            w = w.T
+        sim = similarity_matrix(w)
+        self.stats = diversity_stats(w, self.threshold, sim=sim)
+        self.history.append(self.stats)
+        if self.stats["similar_pairs"]:
+            self.warning(
+                "%s: %d near-duplicate filter pair(s), max |cos|=%.3f",
+                u.name, self.stats["similar_pairs"],
+                self.stats["max_abs_similarity"])
+        meta = {"kind": "image", "cmap": "coolwarm",
+                "title": "%s filter cosine similarity" % u.name}
+        return meta, {"image": sim}
