@@ -105,7 +105,9 @@ def test_label_colors_learnable(image_tree):
 
 def test_alexnet_sample_trains_scaled_down():
     """The AlexNet sample (full layer stack, reduced geometry) trains
-    through the synthetic streaming loader on both backends' XLA path."""
+    through the synthetic DEVICE-RESIDENT bank loader (scan fast path
+    with the on-device crop/mirror/normalize transform); the streaming
+    path stays covered by the file-loader test above."""
     from veles.znicz_tpu.models import imagenet
 
     prng.seed_all(13)
@@ -117,7 +119,7 @@ def test_alexnet_sample_trains_scaled_down():
     try:
         wf = imagenet.create_workflow(name="AlexTiny")
         wf.initialize(device="cpu")
-        assert wf.xla_step.stream_mode
+        assert wf.xla_step.scan_mode
         wf.run()
     finally:
         root.imagenet.loader.update(saved)

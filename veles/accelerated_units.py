@@ -317,9 +317,13 @@ class StepCompiler:
     # or host sync; the dataset stays device-resident and minibatches
     # are gathered by index on device) -------------------------------
 
-    def build_epoch_scan(self, batch_spec, segments):
+    def build_epoch_scan(self, batch_spec, segments, transform=None):
         """Return ``chunk(params, state, full, idxs, valids, hyper,
         key0, offsets) -> (params, state, {seg: stacked_outputs})``.
+
+        ``transform``: the loader's ``xla_batch_transform`` applied on
+        DEVICE to each gathered minibatch (uint8 bank -> cropped
+        normalized float etc.); None = identity.
 
         ``segments``: list of ``(seg_key, train_flag, units)`` — one
         per loader class served each epoch, in serving order. ``full``:
@@ -343,6 +347,8 @@ class StepCompiler:
 
         segments = [(k, t, list(us)) for k, t, us in segments]
         spec = dict(batch_spec)
+        if transform is None:
+            transform = lambda name, t: t
 
         def chunk_fn(params, state, full, idxs, valids, hyper, key0,
                      offsets):
@@ -364,7 +370,8 @@ class StepCompiler:
                                 if name == "batch_size":
                                     ctx.set(unit, attr, valid)
                                 else:
-                                    ctx.set(unit, attr, full[name][idx])
+                                    ctx.set(unit, attr, transform(
+                                        name, full[name][idx]))
                         ctx = self.trace_step(
                             params, state, hyper,
                             jax.random.fold_in(_key, i), _train, _units,
@@ -386,7 +393,7 @@ class StepCompiler:
         donate = (0, 1) if self.donate else ()
         return jax.jit(chunk_fn, donate_argnums=donate)
 
-    def compile_epoch_scan(self, batch_spec, segments):
+    def compile_epoch_scan(self, batch_spec, segments, transform=None):
         key = ("epoch",
                tuple(sorted((name, unit.name, attr)
                             for name, (unit, attr) in batch_spec.items())),
@@ -394,7 +401,7 @@ class StepCompiler:
                      for k, t, us in segments))
         if key not in self._compiled:
             self._compiled[key] = self.build_epoch_scan(
-                batch_spec, segments)
+                batch_spec, segments, transform)
         return self._compiled[key]
 
     # window-scan compilation (the STREAMING fast path: the dataset

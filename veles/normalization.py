@@ -63,12 +63,15 @@ class NormalizerBase:
     def state(self):
         # EVERYTHING, including accumulator attributes: a checkpoint
         # between analyze() and the first normalize() must restore the
-        # in-flight statistics too
-        return dict(vars(self))
+        # in-flight statistics. Arrays are COPIED — the in-place
+        # accumulators must not mutate an already-captured state.
+        return {k: (v.copy() if isinstance(v, numpy.ndarray) else v)
+                for k, v in vars(self).items()}
 
     def set_state(self, state):
         for k, v in state.items():
-            setattr(self, k, v)
+            setattr(self, k,
+                    v.copy() if isinstance(v, numpy.ndarray) else v)
 
     # -- device-path export -------------------------------------------
 
@@ -152,6 +155,9 @@ class MeanDispNormalizer(NormalizerBase):
             numpy.minimum(self._min, b.min(axis=0), out=self._min)
             numpy.maximum(self._max, b.max(axis=0), out=self._max)
         self._count += len(b)
+        # new data invalidates the fitted transform: re-fit lazily so
+        # streaming accumulation keeps the documented semantics
+        self.mean = None
 
     def _fit(self):
         if self._count == 0:

@@ -58,14 +58,11 @@ class WeightDiversity(PlotterBase):
         self.history = []
 
     def make_payload(self):
+        from veles.znicz_tpu.nn_plotting_units import weight_rows
         u = self.unit or self.workflow.forwards[0]
         if getattr(u, "weights", None) is None or not u.weights:
             return None
-        w = numpy.asarray(u.weights.map_read().mem, numpy.float32)
-        # want rows = units: dense stores (fan_in, neurons) untransposed
-        if not hasattr(u, "n_kernels") and not getattr(
-                u, "weights_transposed", False):
-            w = w.T
+        w = weight_rows(u)
         sim = similarity_matrix(w)
         self.stats = diversity_stats(w, self.threshold, sim=sim)
         self.history.append(self.stats)

@@ -20,7 +20,8 @@ import os
 import numpy
 
 from veles.config import root
-from veles.loader.image import AutoLabelFileImageLoader, ImageLoaderBase
+from veles.loader.fullbatch import FullBatchLoader
+from veles.loader.image import AutoLabelFileImageLoader
 from veles.znicz_tpu.standard_workflow import StandardWorkflow
 
 
@@ -74,81 +75,115 @@ root.imagenet.update({
 })
 
 
-class SyntheticImageLoader(ImageLoaderBase):
-    """Deterministic on-the-fly image corpus: per-class low-frequency
-    prototypes + per-index seeded noise, generated at decode time (the
-    synthetic analogue of JPEG decode cost). Pure per index — safe for
-    thread-pool decoding and bitwise reproducible."""
-
-    window_vectorized = True    # materialize_samples is one numpy call
+class SyntheticImageLoader(FullBatchLoader):
+    """Deterministic synthetic image corpus as a DEVICE-RESIDENT uint8
+    bank (per-class low-frequency prototypes + per-index noise,
+    pre-rendered at scale size). The bank ships to the device ONCE;
+    every epoch then runs through the class-scan fast path with
+    center-crop + mirror-half + normalization fused INTO the compiled
+    step (``xla_batch_transform``), so steady-state throughput measures
+    the TPU, not the host link — on this dev tunnel the real h2d
+    bandwidth is ~20 MB/s, which would cap any per-epoch image
+    streaming at ~130 img/s regardless of compute. A real ImageNet
+    tree still streams via AutoLabelFileImageLoader (it cannot be
+    device-resident), see ``make_loader``."""
 
     def __init__(self, workflow, n_classes=16, n_train=2048,
-                 n_valid=256, seed=0xA1E7, **kwargs):
+                 n_valid=256, seed=0xA1E7, scale=(256, 256),
+                 crop=(227, 227), normalize_mean=0.5,
+                 normalize_std=0.5, **kwargs):
+        kwargs.pop("mirror", None)   # make_loader passes streaming kw
         super().__init__(workflow, **kwargs)
         self.n_classes = int(n_classes)
         self._n_train = int(n_train)
         self._n_valid = int(n_valid)
         self._seed = int(seed)
-        self._protos = None
+        self.scale = tuple(scale)
+        self.crop = tuple(crop)
+        self.normalize_mean = float(normalize_mean)
+        self.normalize_std = float(normalize_std)
+        self.serve_dtype = numpy.uint8   # the bank ships as bytes
 
     def load_data(self):
         self.class_lengths = [0, self._n_valid, self._n_train]
-        gen = numpy.random.Generator(
-            numpy.random.PCG64(self._seed))
-        h, w = self.scale if self.scale else self.crop
+        n = self._n_valid + self._n_train
+        gen = numpy.random.Generator(numpy.random.PCG64(self._seed))
+        h, w = self.scale
+        c = 3
         # low-res prototypes upsampled: distinguishable classes
-        small = gen.uniform(0, 255, (self.n_classes, 8, 8,
-                                     self.channels))
+        small = gen.uniform(0, 255, (self.n_classes, 8, 8, c))
         reps = (h + 7) // 8, (w + 7) // 8
-        self._protos = numpy.kron(
+        protos = numpy.kron(
             small, numpy.ones((1, reps[0], reps[1], 1)))[
             :, :h, :w, :].astype(numpy.int16)
+        bank = numpy.empty((n, h, w, c), numpy.uint8)
+        th, tw = (h + 3) // 4, (w + 3) // 4
+        labels = numpy.arange(n) % self.n_classes
+        for lo in range(0, n, 256):       # cap transient int16 memory
+            hi = min(lo + 256, n)
+            noise = gen.integers(-48, 48, (hi - lo, th, tw, c),
+                                 dtype=numpy.int16)
+            noise = numpy.tile(noise, (1, 4, 4, 1))[:, :h, :w, :]
+            numpy.clip(protos[labels[lo:hi]] + noise, 0, 255,
+                       out=noise)
+            bank[lo:hi] = noise
+        self.original_data.mem = bank
+        self.original_labels.mem = labels.astype(numpy.int32)
 
     def label_of(self, index):
         return index % self.n_classes
 
-    def decode_image(self, index):
-        # per-image path (numpy-oracle fill / tests); the streamed path
-        # uses the vectorized materialize_samples below
-        gen = numpy.random.Generator(
-            numpy.random.PCG64(self._seed ^ (index * 2654435761)))
-        proto = self._protos[self.label_of(index)]
-        h, w, c = proto.shape
-        tile = gen.integers(-48, 48, ((h + 3) // 4, (w + 3) // 4, c),
-                            dtype=numpy.int16)
-        noise = numpy.tile(tile, (4, 4, 1))[:h, :w, :]
-        return numpy.clip(proto + noise, 0, 255).astype(numpy.uint8)
+    def apply_normalization(self):
+        # the uint8 bank must stay uint8: crop/normalize is fused into
+        # the step (_augment); a pluggable normalizer would corrupt it
+        from veles.normalization import NoneNormalizer
+        if not isinstance(self.normalizer, NoneNormalizer):
+            raise NotImplementedError(
+                "%s normalizes on device (_augment); "
+                "normalization_type is not supported here"
+                % type(self).__name__)
 
-    def materialize_samples(self, indices):
-        """Vectorized whole-minibatch generation (one RNG stream per
-        minibatch, one tile/clip per batch): the per-image python loop
-        is GIL-bound at ~1.3ms/image, which would throttle the whole
-        TPU pipeline to < 1k img/s. Real JPEG decoding releases the
-        GIL inside libjpeg; the stand-in must not be slower than it."""
-        indices = numpy.asarray(indices)
-        train = bool(self.train_phase)
-        gen = numpy.random.Generator(numpy.random.PCG64(
-            (self._seed ^ (int(indices[0]) * 2654435761)
-             ^ (self.epoch_number * 0x85EBCA6B))
-            & 0xFFFFFFFFFFFFFFFF))
-        ch, cw = self.crop if self.crop else self.scale
-        c = self.channels
-        labels = (indices % self.n_classes).astype(numpy.int32)
-        ph, pw = self._protos.shape[1:3]
-        if train:
-            y = int(gen.integers(0, ph - ch + 1))
-            x = int(gen.integers(0, pw - cw + 1))
-        else:
-            y, x = (ph - ch) // 2, (pw - cw) // 2
-        base = self._protos[labels, y:y + ch, x:x + cw, :]
-        th, tw = (ch + 3) // 4, (cw + 3) // 4
-        noise = gen.integers(-48, 48, (len(indices), th, tw, c),
-                             dtype=numpy.int16)
-        noise = numpy.tile(noise, (1, 4, 4, 1))[:, :ch, :cw, :]
-        data = numpy.clip(base + noise, 0, 255).astype(numpy.uint8)
-        if train:
-            data[::2] = data[::2, :, ::-1]      # mirror half the batch
-        return {"data": data, "labels": labels}
+    # -- shared crop/mirror/normalize (device + oracle) ----------------
+
+    def _crop_origin(self):
+        ph, pw = self.scale
+        ch, cw = self.crop
+        return (ph - ch) // 2, (pw - cw) // 2
+
+    def _augment(self, xp, batch):
+        """uint8 (mb, H, W, C) -> float32 (mb, ch, cw, C): center
+        crop, mirror every other row, normalize. One formula for the
+        traced path and the numpy oracle."""
+        y, x = self._crop_origin()
+        ch, cw = self.crop
+        data = batch[:, y:y + ch, x:x + cw, :]
+        flipped = data[:, :, ::-1, :]
+        mask = (xp.arange(data.shape[0]) % 2 == 0)
+        data = xp.where(mask[:, None, None, None], flipped, data)
+        std = max(self.normalize_std, 1e-6)
+        return ((data.astype(xp.float32) / 255.0
+                 - self.normalize_mean) / std)
+
+    def xla_batch_transform(self, name, tensor):
+        if name != "data":
+            return tensor
+        import jax.numpy as jnp
+        return self._augment(jnp, tensor)
+
+    def create_minibatch_data(self):
+        ch, cw = self.crop
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size, ch, cw, 3), numpy.float32))
+        self.minibatch_labels.reset(numpy.zeros(
+            (self.max_minibatch_size,), numpy.int32))
+
+    def fill_minibatch(self):
+        idx = self.minibatch_indices.mem
+        self.minibatch_data.map_invalidate()
+        self.minibatch_data.mem[...] = self._augment(
+            numpy, self.original_data.mem[idx])
+        self.minibatch_labels.map_invalidate()
+        self.minibatch_labels.mem[...] = self.original_labels.mem[idx]
 
 
 def make_loader(wf):

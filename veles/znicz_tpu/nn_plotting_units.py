@@ -22,6 +22,18 @@ TRIAGE = {CLASS_TEST: "test", CLASS_VALID: "validation",
           CLASS_TRAIN: "train"}
 
 
+def weight_rows(unit):
+    """The unit's weights as (units, fan_in) rows — THE one place that
+    knows the layout convention: conv stores (n_kernels, fan_in)
+    already; dense stores (fan_in, neurons) unless
+    weights_transposed (ops/all2all.py)."""
+    w = numpy.asarray(unit.weights.map_read().mem, numpy.float32)
+    if hasattr(unit, "n_kernels") or getattr(
+            unit, "weights_transposed", False):
+        return w
+    return w.T
+
+
 class PlotterBase(Unit):
     """Publishes a payload once per epoch (gate on epoch_ended is set
     by the linker, mirroring the reference's rate-gating by decision)."""
@@ -87,15 +99,7 @@ class Weights2D(PlotterBase):
         u = self.unit or self.workflow.forwards[0]
         if getattr(u, "weights", None) is None or not u.weights:
             return None
-        w = numpy.asarray(u.weights.map_read().mem, numpy.float32)
-        # want (units, fan_in) rows: conv stores (n_kernels, fan_in)
-        # already; dense stores (fan_in, neurons) unless transposed
-        if hasattr(u, "n_kernels") or getattr(
-                u, "weights_transposed", False):
-            tiles = w
-        else:
-            tiles = w.T
-        tiles = tiles[:self.limit]
+        tiles = weight_rows(u)[:self.limit]
         n, fan_in = tiles.shape
         # choose a near-square patch: conv kernels know their shape,
         # dense layers get the best rectangle

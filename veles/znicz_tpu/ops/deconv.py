@@ -144,18 +144,26 @@ class GDDeconv(GradientDescentBase):
                 preferred_element_type=jnp.float32)
             ctx.set(self, "err_input", ei)
         sy, sx = f.sliding
-        ry = (err.shape[1] + top + bottom - f.ky) % sy
-        rx = (err.shape[2] + left + right - f.kx) % sx
-        gw = jax.lax.conv_general_dilated(
-            err.transpose(3, 1, 2, 0).astype(cd),
-            x.transpose(1, 2, 0, 3).astype(cd),
-            window_strides=(1, 1),
-            padding=((top, bottom - ry), (left, right - rx)),
-            rhs_dilation=(sy, sx),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)  # (C, ky, kx, K)
-        grad_w = gw.transpose(3, 1, 2, 0) \
-            .reshape(f.n_kernels, f.ky * f.kx * c)
+        if sy == 1 and sx == 1:
+            gw = jax.lax.conv_general_dilated(
+                err.transpose(3, 1, 2, 0).astype(cd),
+                x.transpose(1, 2, 0, 3).astype(cd),
+                window_strides=(1, 1),
+                padding=((top, bottom), (left, right)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32)  # (C, ky, kx, K)
+            grad_w = gw.transpose(3, 1, 2, 0) \
+                .reshape(f.n_kernels, f.ky * f.kx * c)
+        else:
+            # strided: rhs-dilated grad convs fall off the TPU fast
+            # path (see gd_conv.py) — use the oracle's im2col GEMM
+            cols = CM.im2col(jnp, err.astype(cd), f.ky, f.kx,
+                             f.sliding, f.padding)
+            grad_w = jax.lax.dot_general(
+                x.reshape(-1, f.n_kernels).astype(cd),
+                cols.reshape(-1, cols.shape[-1]),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
         self.update_weights_xla(ctx, grad_w, None)
 
 
