@@ -103,6 +103,34 @@ def test_label_colors_learnable(image_tree):
     assert hist[-1] < 0.5, hist   # 3 classes, random = 0.67
 
 
+def test_synthetic_bank_eval_not_mirrored():
+    """Eval minibatches must see the true pixels — mirroring is a
+    TRAIN-only augmentation in both the oracle and device formulas."""
+    from veles.workflow import Workflow
+    from veles.znicz_tpu.models.imagenet import SyntheticImageLoader
+    prng.seed_all(77)
+    wf = Workflow(None, name="BankWF")
+    ld = SyntheticImageLoader(wf, name="loader", n_classes=4,
+                              n_train=24, n_valid=8, scale=(40, 40),
+                              crop=(32, 32), minibatch_size=8)
+    ld.initialize()
+    bank = ld.original_data.mem
+    y, x = ld._crop_origin()
+    expect = ((bank[:8, y:y + 32, x:x + 32, :].astype(numpy.float32)
+               / 255.0 - 0.5) / 0.5)
+    got = ld._augment(numpy, bank[:8], train=False)
+    numpy.testing.assert_array_equal(got, expect)
+    trained = ld._augment(numpy, bank[:8], train=True)
+    assert not numpy.array_equal(trained, expect)  # mirror applied
+    # host fill in eval phase serves the un-mirrored crop
+    ld.train_phase << False
+    ld.minibatch_indices.mem[...] = numpy.arange(8)
+    ld.minibatch_size = 8
+    ld.fill_minibatch()
+    numpy.testing.assert_array_equal(
+        ld.minibatch_data.mem, expect)
+
+
 def test_alexnet_sample_trains_scaled_down():
     """The AlexNet sample (full layer stack, reduced geometry) trains
     through the synthetic DEVICE-RESIDENT bank loader (scan fast path

@@ -1,0 +1,127 @@
+"""Interactive shell unit + forge client (SURVEY.md §2.7 rows 6-7)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_shell_commands_run_per_epoch():
+    prng.seed_all(808)
+    from veles.interaction import Shell
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.mnist.loader.update(
+        {"n_train": 200, "n_valid": 80, "minibatch_size": 40})
+    root.mnist.decision.max_epochs = 2
+    try:
+        wf = mnist.create_workflow(name="ShellWF")
+        sh = Shell(wf, name="shell", commands=[
+            "wf.shell_probe = wf.decision.epoch_number",
+            "assert loader is wf.loader",
+        ])
+        sh.link_from(wf.decision)
+        sh.gate_skip = ~wf.decision.epoch_ended
+        wf._end_point_last()
+        wf.initialize(device="numpy")
+        wf.run()
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = 5
+    assert sh.activations == 2
+    assert all(exc is None for _, exc in sh.results)
+    # decision had already rolled the epoch counter when the shell ran
+    assert wf.shell_probe == 2
+
+
+def test_shell_stop_ends_run():
+    prng.seed_all(809)
+    from veles.interaction import Shell
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.mnist.loader.update(
+        {"n_train": 200, "n_valid": 80, "minibatch_size": 40})
+    root.mnist.decision.max_epochs = 50
+    try:
+        wf = mnist.create_workflow(name="ShellStop")
+        sh = Shell(wf, name="shell", commands=["stop()"])
+        sh.link_from(wf.decision)
+        sh.gate_skip = ~wf.decision.epoch_ended
+        wf._end_point_last()
+        wf.initialize(device="numpy")
+        wf.run()
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = 5
+    # stopped after the first epoch, far short of max_epochs
+    assert len(wf.decision.history) <= 2
+
+
+# -- forge ------------------------------------------------------------
+
+
+def test_forge_roundtrip(tmp_path):
+    from veles import forge_client as forge
+    store = str(tmp_path / "store")
+    art = tmp_path / "weights.npy"
+    numpy.save(art, numpy.arange(6.0))
+    pkg = forge.upload("mlp", [str(art)], store=store, version="1",
+                       description="test model")
+    assert os.path.exists(pkg)
+    pkgs = forge.list_packages(store)
+    assert [m["name"] for m in pkgs] == ["mlp"]
+    dest = str(tmp_path / "out")
+    meta = forge.fetch("mlp", dest, store=store)
+    assert meta["version"] == "1"
+    got = numpy.load(os.path.join(dest, "weights.npy"))
+    numpy.testing.assert_array_equal(got, numpy.arange(6.0))
+
+
+def test_forge_versions_and_missing(tmp_path):
+    from veles import forge_client as forge
+    store = str(tmp_path / "store")
+    art = tmp_path / "a.npy"
+    numpy.save(art, numpy.zeros(2))
+    forge.upload("m", [str(art)], store=store, version="1")
+    numpy.save(art, numpy.ones(2))
+    forge.upload("m", [str(art)], store=store, version="2")
+    dest = str(tmp_path / "o")
+    meta = forge.fetch("m", dest, store=store)    # newest wins
+    assert meta["version"] == "2"
+    numpy.testing.assert_array_equal(
+        numpy.load(os.path.join(dest, "a.npy")), numpy.ones(2))
+    with pytest.raises(FileNotFoundError):
+        forge.fetch("nope", dest, store=store)
+
+
+def test_forge_cli(tmp_path):
+    store = str(tmp_path / "store")
+    art = str(tmp_path / "w.npy")
+    numpy.save(art, numpy.arange(3.0))
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "veles.forge_client", "--store", store,
+         "upload", "demo", art, "--version", "7"],
+        capture_output=True, text=True, env=env, check=True)
+    assert r.stdout.strip().endswith("demo-7.forge.tar.gz")
+    r = subprocess.run(
+        [sys.executable, "-m", "veles.forge_client", "--store", store,
+         "list"], capture_output=True, text=True, env=env, check=True)
+    assert "demo" in r.stdout
+    dest = str(tmp_path / "fetched")
+    r = subprocess.run(
+        [sys.executable, "-m", "veles.forge_client", "--store", store,
+         "fetch", "demo", dest], capture_output=True, text=True,
+        env=env, check=True)
+    assert json.loads(r.stdout)["version"] == "7"
+    assert os.path.exists(os.path.join(dest, "w.npy"))

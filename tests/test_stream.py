@@ -111,7 +111,7 @@ def test_stream_uint8_transform():
     data_u8 = numpy.clip(data * 255.0, 0, 255).astype(numpy.uint8)
 
     class U8Loader(ArrayStreamLoader):
-        def xla_batch_transform(self, name, tensor):
+        def xla_batch_transform(self, name, tensor, train=False):
             if name == "data":
                 import jax.numpy as jnp
                 return tensor.astype(jnp.float32) / 255.0

@@ -225,6 +225,18 @@ def _resolve_link(unit, attr):
         return unit, attr
 
 
+def _transform_key(transform):
+    """Stable memo-key component for a loader's xla_batch_transform:
+    bound methods are re-created per attribute access, so key on the
+    owner's identity + function, not on the method object."""
+    if transform is None:
+        return None
+    owner = getattr(transform, "__self__", None)
+    func = getattr(transform, "__func__", transform)
+    return (id(owner) if owner is not None else id(transform),
+            getattr(func, "__qualname__", repr(func)))
+
+
 class StepCompiler:
     """Trace an ordered list of accelerated units into one jitted step.
 
@@ -348,7 +360,7 @@ class StepCompiler:
         segments = [(k, t, list(us)) for k, t, us in segments]
         spec = dict(batch_spec)
         if transform is None:
-            transform = lambda name, t: t
+            transform = lambda name, t, train=False: t
 
         def chunk_fn(params, state, full, idxs, valids, hyper, key0,
                      offsets):
@@ -371,7 +383,8 @@ class StepCompiler:
                                     ctx.set(unit, attr, valid)
                                 else:
                                     ctx.set(unit, attr, transform(
-                                        name, full[name][idx]))
+                                        name, full[name][idx],
+                                        train=_train))
                         ctx = self.trace_step(
                             params, state, hyper,
                             jax.random.fold_in(_key, i), _train, _units,
@@ -398,7 +411,8 @@ class StepCompiler:
                tuple(sorted((name, unit.name, attr)
                             for name, (unit, attr) in batch_spec.items())),
                tuple((k, t, tuple(u.name for u in us))
-                     for k, t, us in segments))
+                     for k, t, us in segments),
+               _transform_key(transform))
         if key not in self._compiled:
             self._compiled[key] = self.build_epoch_scan(
                 batch_spec, segments, transform)
@@ -435,7 +449,8 @@ class StepCompiler:
                             ctx.set(unit, attr, valid)
                         elif name in batch:
                             ctx.set(unit, attr,
-                                    transform(name, batch[name]))
+                                    transform(name, batch[name],
+                                              train=train))
                 ctx = self.trace_step(
                     params, state, hyper, jax.random.fold_in(key0, i),
                     train, units, bind)
@@ -454,7 +469,8 @@ class StepCompiler:
         key = ("window",
                tuple(sorted((name, unit.name, attr)
                             for name, (unit, attr) in batch_spec.items())),
-               train, tuple(u.name for u in units))
+               train, tuple(u.name for u in units),
+               _transform_key(transform))
         if key not in self._compiled:
             self._compiled[key] = self.build_window_scan(
                 batch_spec, train, units, transform)

@@ -1,0 +1,145 @@
+"""Forge client — package, publish and fetch trained models.
+
+Re-design of ``veles/forge_client.py`` [U] (SURVEY.md §2.7 "Forge
+client": the VelesForge model-zoo fetch/publish client). The rebuild
+keeps the package format and verbs but targets a STORE that is a
+directory path (local disk / network mount) — the honest equivalent in
+a zero-egress environment; an HTTP store would slot in behind the same
+``upload``/``fetch``/``list_packages`` verbs.
+
+A package is ``<name>-<version>.forge.tar.gz`` containing:
+
+    metadata.json   — name, version, workflow, description, files
+    checkpoint.npz / contents.json / *.npy / config snippets — the
+        artifacts the caller listed (checkpoints, C++ inference
+        archives, configs)
+
+CLI:  python -m veles.forge_client {upload,fetch,list} ...
+"""
+
+import argparse
+import json
+import os
+import sys
+import tarfile
+import time
+
+
+def _store_dir(store=None):
+    from veles.config import root
+    store = store or root.common.dirs.get("forge") or os.path.join(
+        root.common.dirs.get("cache", "/tmp"), "forge")
+    os.makedirs(store, exist_ok=True)
+    return store
+
+
+def _package_path(store, name, version):
+    return os.path.join(store, "%s-%s.forge.tar.gz" % (name, version))
+
+
+def upload(name, files, store=None, version=None, workflow=None,
+           description=""):
+    """Package ``files`` (paths, or (arcname, path) pairs) into the
+    store; returns the package path."""
+    store = _store_dir(store)
+    version = version or time.strftime("%Y%m%d%H%M%S")
+    entries = []
+    for f in files:
+        arc, path = f if isinstance(f, tuple) else (
+            os.path.basename(f), f)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        entries.append((arc, path))
+    meta = {
+        "name": name, "version": str(version),
+        "workflow": workflow or name, "description": description,
+        "files": [arc for arc, _ in entries],
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    out = _package_path(store, name, version)
+    with tarfile.open(out, "w:gz") as tar:
+        metaf = os.path.join(store, ".metadata.json.tmp")
+        with open(metaf, "w") as f:
+            json.dump(meta, f, indent=1)
+        tar.add(metaf, arcname="metadata.json")
+        os.unlink(metaf)
+        for arc, path in entries:
+            tar.add(path, arcname=arc)
+    return out
+
+
+def list_packages(store=None):
+    """[{name, version, workflow, description, package}] sorted by
+    name then version."""
+    store = _store_dir(store)
+    out = []
+    for fname in sorted(os.listdir(store)):
+        if not fname.endswith(".forge.tar.gz"):
+            continue
+        path = os.path.join(store, fname)
+        try:
+            with tarfile.open(path, "r:gz") as tar:
+                meta = json.load(tar.extractfile("metadata.json"))
+        except (KeyError, tarfile.TarError, json.JSONDecodeError):
+            continue
+        meta["package"] = path
+        out.append(meta)
+    return out
+
+
+def fetch(name, dest, store=None, version=None):
+    """Extract the newest (or given) version of ``name`` into ``dest``;
+    returns the metadata dict."""
+    store = _store_dir(store)
+    candidates = [m for m in list_packages(store) if m["name"] == name
+                  and (version is None or m["version"] == str(version))]
+    if not candidates:
+        raise FileNotFoundError(
+            "no package %r%s in %s" % (
+                name, "" if version is None else " v%s" % version,
+                store))
+    meta = max(candidates, key=lambda m: m["version"])
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(meta["package"], "r:gz") as tar:
+        # the 'data' filter refuses path traversal, links outside the
+        # dest, device nodes etc. from untrusted archives
+        tar.extractall(dest, filter="data")
+    return meta
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="veles.forge_client",
+                                description=__doc__)
+    p.add_argument("--store", default=None,
+                   help="store directory (default root.common.dirs"
+                        ".forge or <cache>/forge)")
+    sub = p.add_subparsers(dest="verb", required=True)
+    up = sub.add_parser("upload")
+    up.add_argument("name")
+    up.add_argument("files", nargs="+")
+    up.add_argument("--version", default=None)
+    up.add_argument("--description", default="")
+    fe = sub.add_parser("fetch")
+    fe.add_argument("name")
+    fe.add_argument("dest")
+    fe.add_argument("--version", default=None)
+    sub.add_parser("list")
+    args = p.parse_args(argv)
+    if args.verb == "upload":
+        path = upload(args.name, args.files, store=args.store,
+                      version=args.version,
+                      description=args.description)
+        print(path)
+    elif args.verb == "fetch":
+        meta = fetch(args.name, args.dest, store=args.store,
+                     version=args.version)
+        print(json.dumps(meta))
+    else:
+        for m in list_packages(args.store):
+            print("%-24s %-16s %s" % (m["name"], m["version"],
+                                      m["description"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

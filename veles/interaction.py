@@ -1,0 +1,67 @@
+"""Interactive shell unit — poke a live workflow between epochs.
+
+Re-design of ``veles/interaction.py`` [U] (SURVEY.md §2.7 "Interactive
+shell": "embedded IPython unit to poke a live workflow"). The rebuild
+uses the stdlib ``code.InteractiveConsole`` (no IPython dependency)
+and is gated like any unit — link it after the Decision with
+``gate_skip = ~decision.epoch_ended`` and training pauses at each
+epoch end with the workflow in scope:
+
+    >>> wf.decision.history[-1]
+    >>> wf.forwards[0].weights.mem.std()
+    >>> stop()          # ask the workflow to stop
+    >>> (Ctrl-D)        # resume training
+
+Headless runs are first-class: with no TTY the unit is a no-op unless
+``commands`` (a list of python statements, run once per activation) is
+given — which is also what makes it testable."""
+
+import code
+import sys
+
+from veles.units import Unit
+
+
+class Shell(Unit):
+    def __init__(self, workflow, commands=None, banner=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        #: statements to execute instead of prompting (headless mode)
+        self.commands = list(commands or [])
+        self.banner = banner
+        #: collected (command, exception-or-None) results, for tests
+        #: and post-run inspection
+        self.results = []
+        self.activations = 0
+
+    def _namespace(self):
+        import numpy
+        ns = {
+            "wf": self.workflow,
+            "workflow": self.workflow,
+            "numpy": numpy,
+            "stop": self.workflow.stop,
+        }
+        for u in getattr(self.workflow, "_units", ()):
+            name = u.name.replace(" ", "_")
+            if name.isidentifier():
+                ns.setdefault(name, u)
+        return ns
+
+    def run(self):
+        self.activations += 1
+        ns = self._namespace()
+        if self.commands:
+            console = code.InteractiveConsole(ns)
+            for cmd in self.commands:
+                try:
+                    console.runsource(cmd, symbol="exec")
+                    self.results.append((cmd, None))
+                except Exception as exc:   # never kill training
+                    self.results.append((cmd, exc))
+            return
+        if not sys.stdin.isatty():
+            return                         # headless: no-op
+        banner = self.banner or (
+            "veles shell — workflow %r in scope as `wf`; Ctrl-D "
+            "resumes training, stop() ends the run" % self.workflow.name)
+        code.interact(banner=banner, local=ns, exitmsg="resuming")

@@ -243,10 +243,12 @@ class Loader(Unit, IDistributable):
         raise NotImplementedError(
             "%s does not support streaming" % self.name)
 
-    def xla_batch_transform(self, name, tensor):
+    def xla_batch_transform(self, name, tensor, train=False):
         """Traced per-minibatch transform applied on DEVICE to streamed
         batch tensors (e.g. uint8 -> normalized float, so the host→
-        device link carries bytes, not floats). Default: identity."""
+        device link carries bytes, not floats). ``train`` distinguishes
+        phase-dependent augmentation (mirroring etc. must never touch
+        eval minibatches). Default: identity."""
         return tensor
 
     def run(self):

@@ -145,7 +145,7 @@ class ImageLoaderBase(StreamLoader):
             labels[i] = self.label_of(int(idx))
         return {"data": data, "labels": labels}
 
-    def xla_batch_transform(self, name, tensor):
+    def xla_batch_transform(self, name, tensor, train=False):
         if name != "data":
             return tensor
         import jax.numpy as jnp

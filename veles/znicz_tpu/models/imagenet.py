@@ -9,10 +9,10 @@ softmax classifier.
 Data: a real ImageNet directory tree (``<base>/<wnid or class>/*.jpg``)
 streamed through :class:`veles.loader.image.AutoLabelFileImageLoader`
 when ``root.imagenet.loader.base_dir`` exists; otherwise a
-deterministic synthetic stand-in (class-prototype images generated on
-the fly, per-index seeded — zero egress environment) with the same
-shapes and the same streaming pipeline, so the throughput measurement
-exercises decode→augment→ship→compute end to end either way.
+deterministic synthetic stand-in pre-rendered into a device-resident
+uint8 bank (zero-egress environment; see SyntheticImageLoader's
+docstring for why streaming is hopeless over this dev tunnel), with
+crop/mirror/normalize fused into the compiled step either way.
 """
 
 import os
@@ -150,25 +150,27 @@ class SyntheticImageLoader(FullBatchLoader):
         ch, cw = self.crop
         return (ph - ch) // 2, (pw - cw) // 2
 
-    def _augment(self, xp, batch):
+    def _augment(self, xp, batch, train):
         """uint8 (mb, H, W, C) -> float32 (mb, ch, cw, C): center
-        crop, mirror every other row, normalize. One formula for the
-        traced path and the numpy oracle."""
+        crop, mirror every other row (TRAIN only — eval must see the
+        true pixels), normalize. One formula for the traced path and
+        the numpy oracle."""
         y, x = self._crop_origin()
         ch, cw = self.crop
         data = batch[:, y:y + ch, x:x + cw, :]
-        flipped = data[:, :, ::-1, :]
-        mask = (xp.arange(data.shape[0]) % 2 == 0)
-        data = xp.where(mask[:, None, None, None], flipped, data)
+        if train:
+            flipped = data[:, :, ::-1, :]
+            mask = (xp.arange(data.shape[0]) % 2 == 0)
+            data = xp.where(mask[:, None, None, None], flipped, data)
         std = max(self.normalize_std, 1e-6)
         return ((data.astype(xp.float32) / 255.0
                  - self.normalize_mean) / std)
 
-    def xla_batch_transform(self, name, tensor):
+    def xla_batch_transform(self, name, tensor, train=False):
         if name != "data":
             return tensor
         import jax.numpy as jnp
-        return self._augment(jnp, tensor)
+        return self._augment(jnp, tensor, train)
 
     def create_minibatch_data(self):
         ch, cw = self.crop
@@ -181,7 +183,8 @@ class SyntheticImageLoader(FullBatchLoader):
         idx = self.minibatch_indices.mem
         self.minibatch_data.map_invalidate()
         self.minibatch_data.mem[...] = self._augment(
-            numpy, self.original_data.mem[idx])
+            numpy, self.original_data.mem[idx],
+            train=bool(self.train_phase))
         self.minibatch_labels.map_invalidate()
         self.minibatch_labels.mem[...] = self.original_labels.mem[idx]
 
