@@ -46,6 +46,27 @@ def test_ae_max_err_tracked(numpy_wf):
     assert 0 <= ev.max_err_idx < numpy_wf.loader.max_minibatch_size
 
 
+def test_video_ae_reconstruction():
+    """VideoAE (SURVEY.md §2.8 row 6): frame AE on held-out clips,
+    both backends agree."""
+    prng.seed_all(21)
+    from veles.znicz_tpu.models import video_ae
+    root.video_ae.loader.n_clips = 12
+    root.video_ae.decision.max_epochs = 3
+    wf = video_ae.create_workflow(name="VAENumpy")
+    wf.initialize(device="numpy")
+    wf.run()
+    hist = [h["validation"]["metric"] for h in wf.decision.history]
+    assert hist[-1] < hist[0], hist
+    prng.seed_all(21)
+    wf2 = video_ae.create_workflow(name="VAEXLA")
+    wf2.initialize(device="cpu")
+    wf2.run()
+    h2 = [h["validation"]["metric"] for h in wf2.decision.history]
+    assert abs(h2[-1] - hist[-1]) < max(0.15 * hist[-1], 1e-3), \
+        (hist, h2)
+
+
 # -- evaluator parity: confusion matrix + max-error on the traced path
 
 

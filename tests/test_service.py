@@ -139,6 +139,53 @@ def test_master_slave_protocol():
     assert not numpy.allclose(w0, w1)
 
 
+def test_single_slave_matches_standalone():
+    """Delta-shipping makes one-slave distributed training EXACTLY
+    sequential SGD: master hands weights + a minibatch job, slave
+    trains it, ships the delta, master applies it verbatim — the final
+    weights equal a standalone run over the same minibatch order
+    (shuffling disabled: the master deliberately shuffles with a
+    separate PRNG stream, so bitwise parity needs a fixed order)."""
+    from veles.server import MasterServer
+    from veles.client import SlaveClient
+
+    from veles.loader.base import CLASS_TRAIN
+
+    def unshuffled(name, **kw):
+        wf = make_wf(name, **kw)
+        wf.loader.shuffle_enabled = False
+        wf.loader._start_epoch(first=True)   # regenerate the order
+        return wf
+
+    # reference: plain sequential SGD over exactly 2 epochs of serves.
+    # (wf.run() is NOT the reference here: its decision gates off the
+    # final minibatch's GD update once `complete` fires — a stop-logic
+    # artifact the master/slave protocol doesn't replicate.)
+    ref = unshuffled("StandaloneRef", max_epochs=2)
+    loader = ref.loader
+    for _ in range(2 * ref.loader.effective_batches_per_epoch):
+        loader.run()
+        for u in ref.forwards:
+            u.run()
+        ref.evaluator.run()
+        if loader.minibatch_class == CLASS_TRAIN:
+            for gd in reversed(ref.gds):
+                gd.run()
+    w_ref = numpy.array(ref.forwards[0].weights.map_read().mem)
+
+    master_wf = unshuffled("Master1", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2)
+    server.start_background()
+    addr = "127.0.0.1:%d" % server.bound_address[1]
+    slave = unshuffled("Slave1")
+    slave.is_slave = True
+    SlaveClient(slave, addr, name="s1").run_forever()
+    assert server.done.is_set()
+    w_master = master_wf.forwards[0].weights.map_read().mem
+    numpy.testing.assert_allclose(w_master, w_ref, atol=1e-6)
+
+
 def test_drop_slave_requeues():
     from veles.loader.base import CLASS_TRAIN
     wf = make_wf("DropWf")

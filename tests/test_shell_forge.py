@@ -92,16 +92,43 @@ def test_forge_versions_and_missing(tmp_path):
     store = str(tmp_path / "store")
     art = tmp_path / "a.npy"
     numpy.save(art, numpy.zeros(2))
-    forge.upload("m", [str(art)], store=store, version="1")
+    forge.upload("m", [str(art)], store=store, version="9")
     numpy.save(art, numpy.ones(2))
-    forge.upload("m", [str(art)], store=store, version="2")
+    forge.upload("m", [str(art)], store=store, version="10")
     dest = str(tmp_path / "o")
-    meta = forge.fetch("m", dest, store=store)    # newest wins
-    assert meta["version"] == "2"
+    meta = forge.fetch("m", dest, store=store)
+    # NUMERIC newest wins: 10 > 9 (not lexicographic)
+    assert meta["version"] == "10"
     numpy.testing.assert_array_equal(
         numpy.load(os.path.join(dest, "a.npy")), numpy.ones(2))
     with pytest.raises(FileNotFoundError):
         forge.fetch("nope", dest, store=store)
+
+
+def test_forge_rejects_unsafe_names(tmp_path):
+    from veles import forge_client as forge
+    art = tmp_path / "a.npy"
+    numpy.save(art, numpy.zeros(1))
+    store = str(tmp_path / "store")
+    for bad in ("../escape", "a/b", ".hidden"):
+        with pytest.raises(ValueError, match="invalid package name"):
+            forge.upload(bad, [str(art)], store=store, version="1")
+    with pytest.raises(ValueError, match="invalid version"):
+        forge.upload("ok", [str(art)], store=store, version="1/2")
+
+
+def test_shell_records_failures():
+    """Failing commands are captured, not swallowed (and never kill
+    training)."""
+    from veles.interaction import Shell
+    from veles.workflow import Workflow
+    wf = Workflow(None, name="ShErr")
+    sh = Shell(wf, name="shell",
+               commands=["x = 1", "raise ValueError('boom')", "y = x"])
+    sh.run()
+    assert sh.results[0][1] is None
+    assert isinstance(sh.results[1][1], ValueError)
+    assert sh.results[2][1] is None   # later commands still ran
 
 
 def test_forge_cli(tmp_path):

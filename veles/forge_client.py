@@ -18,11 +18,33 @@ CLI:  python -m veles.forge_client {upload,fetch,list} ...
 """
 
 import argparse
+import io
 import json
 import os
+import re
 import sys
 import tarfile
 import time
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def _check_token(value, what):
+    """Names/versions become file-path components: keep them to a safe
+    charset so CLI arguments can never escape the store directory."""
+    value = str(value)
+    if not _NAME_RE.match(value) or value.startswith("."):
+        raise ValueError(
+            "invalid %s %r: use letters, digits, '.', '_', '-'"
+            % (what, value))
+    return value
+
+
+def _version_key(version):
+    """Numeric-aware ordering: '10' > '9'; timestamps and dotted
+    versions compare piecewise."""
+    return tuple((0, int(p)) if p.isdigit() else (1, p)
+                 for p in str(version).split("."))
 
 
 def _store_dir(store=None):
@@ -42,7 +64,9 @@ def upload(name, files, store=None, version=None, workflow=None,
     """Package ``files`` (paths, or (arcname, path) pairs) into the
     store; returns the package path."""
     store = _store_dir(store)
-    version = version or time.strftime("%Y%m%d%H%M%S")
+    name = _check_token(name, "package name")
+    version = _check_token(
+        version or time.strftime("%Y%m%d%H%M%S"), "version")
     entries = []
     for f in files:
         arc, path = f if isinstance(f, tuple) else (
@@ -51,18 +75,18 @@ def upload(name, files, store=None, version=None, workflow=None,
             raise FileNotFoundError(path)
         entries.append((arc, path))
     meta = {
-        "name": name, "version": str(version),
+        "name": name, "version": version,
         "workflow": workflow or name, "description": description,
         "files": [arc for arc, _ in entries],
         "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     out = _package_path(store, name, version)
     with tarfile.open(out, "w:gz") as tar:
-        metaf = os.path.join(store, ".metadata.json.tmp")
-        with open(metaf, "w") as f:
-            json.dump(meta, f, indent=1)
-        tar.add(metaf, arcname="metadata.json")
-        os.unlink(metaf)
+        blob = json.dumps(meta, indent=1).encode()
+        info = tarfile.TarInfo("metadata.json")
+        info.size = len(blob)
+        info.mtime = int(time.time())
+        tar.addfile(info, io.BytesIO(blob))   # no shared temp file
         for arc, path in entries:
             tar.add(path, arcname=arc)
     return out
@@ -98,7 +122,7 @@ def fetch(name, dest, store=None, version=None):
             "no package %r%s in %s" % (
                 name, "" if version is None else " v%s" % version,
                 store))
-    meta = max(candidates, key=lambda m: m["version"])
+    meta = max(candidates, key=lambda m: _version_key(m["version"]))
     os.makedirs(dest, exist_ok=True)
     with tarfile.open(meta["package"], "r:gz") as tar:
         # the 'data' filter refuses path traversal, links outside the

@@ -51,13 +51,16 @@ class Shell(Unit):
         self.activations += 1
         ns = self._namespace()
         if self.commands:
-            console = code.InteractiveConsole(ns)
+            # exec directly (InteractiveConsole swallows exceptions
+            # internally, which would break the results contract)
             for cmd in self.commands:
                 try:
-                    console.runsource(cmd, symbol="exec")
+                    exec(compile(cmd, "<shell>", "exec"), ns, ns)
                     self.results.append((cmd, None))
                 except Exception as exc:   # never kill training
                     self.results.append((cmd, exc))
+                    self.warning("shell command %r failed: %s",
+                                 cmd, exc)
             return
         if not sys.stdin.isatty():
             return                         # headless: no-op

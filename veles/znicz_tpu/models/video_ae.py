@@ -1,0 +1,95 @@
+"""VideoAE sample: frame autoencoder over a synthetic moving-pattern
+video corpus.
+
+Rebuild of reference ``samples/VideoAE`` [U] (SURVEY.md §2.8 row 6
+"MnistAE / VideoAE — deconv autoencoders"): the same conv → pool →
+depool → deconv reconstruction stack as MnistAE, applied to frames of
+a deterministic synthetic "video" (a gaussian blob orbiting per clip;
+zero-egress stand-in for the reference's video decode). Frames of a
+clip share structure, so a model that reconstructs them well has
+learned the blob basis — validation MSE is measured on held-out clips.
+"""
+
+import numpy
+
+from veles.config import root
+from veles.loader.fullbatch import FullBatchLoader
+from veles.znicz_tpu.standard_workflow import StandardWorkflow
+
+root.video_ae.update({
+    "loader": {"minibatch_size": 50, "n_clips": 40,
+               "frames_per_clip": 16, "frame_size": 24,
+               "valid_ratio": 0.2},
+    "layers": [
+        {"type": "conv_tanh",
+         "->": {"n_kernels": 8, "kx": 5, "ky": 5},
+         "<-": {"learning_rate": 0.002, "gradient_moment": 0.5}},
+        {"type": "avg_pooling", "->": {"kx": 2, "ky": 2}},
+        {"type": "depooling", "->": {"output_shape_source": 1}},
+        # see mnist_ae: deconv's spatial-sum gradient needs a tiny lr
+        {"type": "deconv",
+         "->": {"n_kernels": 8, "kx": 5, "ky": 5,
+                "output_shape_source": 0},
+         "<-": {"learning_rate": 2e-5, "gradient_moment": 0.5}},
+    ],
+    "decision": {"max_epochs": 5, "fail_iterations": 20},
+})
+
+
+class VideoFramesLoader(FullBatchLoader):
+    """Synthetic clips: per-clip random orbit of a gaussian blob;
+    validation holds out whole CLIPS (frame-level held-out eval would
+    leak the clip's appearance)."""
+
+    def load_data(self):
+        cfg = root.video_ae.loader
+        n_clips = cfg.get("n_clips", 40)
+        fpc = cfg.get("frames_per_clip", 16)
+        size = cfg.get("frame_size", 24)
+        gen = numpy.random.Generator(numpy.random.PCG64(0x51DE0))
+        yy, xx = numpy.mgrid[0:size, 0:size]
+        frames = numpy.empty((n_clips, fpc, size, size, 1),
+                             numpy.float32)
+        for c in range(n_clips):
+            cx, cy = gen.uniform(size * 0.3, size * 0.7, 2)
+            radius = gen.uniform(size * 0.1, size * 0.25)
+            phase = gen.uniform(0, 2 * numpy.pi)
+            sigma = gen.uniform(1.5, 3.0)
+            for f in range(fpc):
+                a = phase + 2 * numpy.pi * f / fpc
+                bx = cx + radius * numpy.cos(a)
+                by = cy + radius * numpy.sin(a)
+                frames[c, f, :, :, 0] = numpy.exp(
+                    -((xx - bx) ** 2 + (yy - by) ** 2)
+                    / (2 * sigma ** 2))
+        n_valid_clips = max(1, int(n_clips * cfg.get("valid_ratio",
+                                                     0.2)))
+        valid = frames[:n_valid_clips].reshape(-1, size, size, 1)
+        train = frames[n_valid_clips:].reshape(-1, size, size, 1)
+        data = numpy.concatenate([valid, train])
+        self.original_data.mem = data
+        self.original_targets.mem = data
+        self.class_lengths = [0, len(valid), len(train)]
+
+
+def create_workflow(name="VideoAEWorkflow"):
+    cfg = root.video_ae
+    return StandardWorkflow(
+        None, name=name,
+        layers=cfg.layers,
+        loader_factory=lambda wf: VideoFramesLoader(
+            wf, name="loader",
+            minibatch_size=cfg.loader.minibatch_size),
+        decision_config=cfg.decision.to_dict(),
+    )
+
+
+def run(load, main):
+    """Reference sample entry shape [U]: velescli calls this."""
+    load(StandardWorkflow,
+         layers=root.video_ae.layers,
+         loader_factory=lambda wf: VideoFramesLoader(
+             wf, name="loader",
+             minibatch_size=root.video_ae.loader.minibatch_size),
+         decision_config=root.video_ae.decision.to_dict())
+    main()

@@ -414,21 +414,41 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         if "bias" in data and f.bias:
             f.bias.map_write()
             f.bias.mem[...] = data["bias"]
+        # remember the basis the master handed us: updates ship as
+        # DELTAS against it (same bytes on the wire as full weights,
+        # but the master can apply each slave's training verbatim —
+        # a single-slave run reproduces standalone training exactly,
+        # and concurrent slaves' contributions ADD instead of each
+        # dragging the canonical weights halfway to its own copy)
+        self._master_basis = {
+            k: numpy.array(v) for k, v in data.items()}
 
     def generate_data_for_master(self):
-        return self.generate_data_for_slave()
+        basis = getattr(self, "_master_basis", None)
+        if basis is None:
+            return self.generate_data_for_slave()
+        current = self.generate_data_for_slave()
+        return {"d" + k: current[k] - basis[k] for k in current}
 
     def apply_data_from_slave(self, data, slave=None):
-        """Asynchronous parameter averaging (reference semantics [U]):
-        master's canonical weights move halfway toward the slave's."""
+        """Merge one slave's training into the canonical weights.
+
+        Delta payloads (``dweights``/``dbias``) apply additively scaled
+        by ``slave_merge_scale`` (default 1.0). Absolute payloads fall
+        back to the reference's halfway parameter averaging [U]."""
         if not data:
             return
+        scale = float(getattr(self, "slave_merge_scale", 1.0))
         f = self.forward
-        f.weights.map_write()
-        f.weights.mem[...] = 0.5 * (f.weights.mem + data["weights"])
-        if "bias" in data and f.bias:
-            f.bias.map_write()
-            f.bias.mem[...] = 0.5 * (f.bias.mem + data["bias"])
+        for key, arr in (("weights", f.weights), ("bias", f.bias)):
+            if arr is None or not arr:
+                continue
+            if "d" + key in data:
+                arr.map_write()
+                arr.mem[...] += scale * data["d" + key]
+            elif key in data:
+                arr.map_write()
+                arr.mem[...] = 0.5 * (arr.mem + data[key])
 
 
 class NNWorkflow(AcceleratedWorkflow):
