@@ -1,0 +1,121 @@
+"""Blocked (flash-style) single-chip attention: exact parity with the
+dense path, and usable from the LM config (long-context story,
+parallel/flash.py)."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_blocked_matches_dense_fwd_bwd(causal):
+    import jax
+    import jax.numpy as jnp
+    from veles.znicz_tpu.parallel import flash
+
+    gen = prng.get("flash_test")
+    b, h, s, dh = 2, 3, 64, 8
+    q, k, v = (gen.normal(0, 1, (b, h, s, dh)) for _ in range(3))
+    dout = gen.normal(0, 1, (b, h, s, dh))
+
+    def dense(q, k, v):
+        scale = 1.0 / numpy.sqrt(dh)
+        sc = (q @ jnp.swapaxes(k, -1, -2)) * scale
+        if causal:
+            mask = jnp.triu(jnp.full((s, s), -1e9, jnp.float32), 1)
+            sc = sc + mask
+        p = jax.nn.softmax(sc, axis=-1)
+        return p @ v
+
+    out_d = dense(q, k, v)
+    out_b, lse = flash.blocked_attention_fwd(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        causal=causal, block=16)
+    assert numpy.allclose(numpy.asarray(out_b),
+                          numpy.asarray(out_d), atol=2e-5)
+
+    # backward vs jax.grad of the dense formulation
+    def loss(args):
+        return (dense(*args) * dout).sum()
+    gq, gk, gv = jax.grad(loss)((jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v)))
+    dq, dk, dv = flash.blocked_attention_bwd(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), out_b, lse,
+        jnp.asarray(dout), causal=causal, block=16)
+    for got, want, name in ((dq, gq, "dq"), (dk, gk, "dk"),
+                            (dv, gv, "dv")):
+        assert numpy.allclose(numpy.asarray(got), numpy.asarray(want),
+                              atol=3e-4), name
+
+
+def test_block_must_divide():
+    import jax.numpy as jnp
+    from veles.znicz_tpu.parallel import flash
+    q = jnp.zeros((1, 1, 30, 4))
+    with pytest.raises(ValueError, match="does not divide"):
+        flash.blocked_attention_fwd(q, q, q, block=16)
+
+
+def test_mha_unit_blocked_path_matches_dense():
+    """The attention UNIT with attn_block_size set (fwd + bwd) equals
+    its own dense path."""
+    from veles.znicz_tpu.ops.attention import MultiHeadAttention
+    from tests.test_conv_stack import build, xla_forward, xla_backward
+
+    prng.seed_all(123)
+    wf, feed, fwd, gd, x, err, comp = build(
+        MultiHeadAttention, input_shape=(2, 32, 8), gd_kwargs={},
+        heads=2)
+    params0 = comp.gather_params()
+    state0 = comp.gather_state()
+    y_dense = numpy.asarray(xla_forward(comp, feed, fwd, params0, x))
+    ei_dense, params_dense = xla_backward(
+        comp, feed, fwd, gd, params0, state0, x, err)
+
+    fwd.attn_block_size = 8
+    y_blk = numpy.asarray(xla_forward(comp, feed, fwd, params0, x))
+    ei_blk, params_blk = xla_backward(
+        comp, feed, fwd, gd, params0, state0, x, err)
+    fwd.attn_block_size = None
+
+    assert numpy.allclose(y_blk, y_dense, atol=3e-5)
+    assert numpy.allclose(numpy.asarray(ei_blk),
+                          numpy.asarray(ei_dense), atol=3e-4)
+    for pname in params_dense[fwd.name]:
+        assert numpy.allclose(
+            numpy.asarray(params_blk[fwd.name][pname]),
+            numpy.asarray(params_dense[fwd.name][pname]),
+            atol=3e-4), pname
+
+
+def test_lm_blocked_attention_from_config():
+    """root.lm.model.attn_block engages the blocked path; training
+    trajectory matches dense."""
+    from veles.znicz_tpu.models import transformer_lm
+    from veles.znicz_tpu.ops.attention import MultiHeadAttention
+
+    def run(name, attn_block):
+        prng.seed_all(999)
+        root.lm.loader.update({"minibatch_size": 32, "n_train": 256,
+                               "n_valid": 64})
+        root.lm.decision.max_epochs = 2
+        root.lm.model.attn_block = attn_block
+        try:
+            wf = transformer_lm.create_workflow(name=name)
+            wf.initialize(device="cpu")
+            wf.run()
+        finally:
+            root.lm.model.attn_block = None
+        return wf
+
+    wf_d = run("LMDenseAttn", None)
+    wf_b = run("LMBlockAttn", 8)
+    mha = [f for f in wf_b.forwards
+           if isinstance(f, MultiHeadAttention)]
+    assert mha and all(f.attn_block_size == 8 for f in mha)
+    h_d = [h["validation"]["metric"] for h in wf_d.decision.history]
+    h_b = [h["validation"]["metric"] for h in wf_b.decision.history]
+    for a, b in zip(h_b, h_d):
+        assert abs(a - b) < 0.05, (h_b, h_d)

@@ -22,7 +22,10 @@ from veles.znicz_tpu.standard_workflow import StandardWorkflow
 root.lm.update({
     "loader": {"minibatch_size": 64, "n_train": 2048, "n_valid": 256,
                "seq_len": 32, "vocab": 16, "max_period": 6},
-    "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128},
+    # attn_block: single-chip flash-style blocked attention (exact;
+    # O(S*block) score memory instead of O(S^2)); None = dense
+    "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128,
+              "attn_block": None},
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
               "weights_decay": 0.0},
     "decision": {"max_epochs": 8, "fail_iterations": 50},
@@ -77,7 +80,8 @@ def build_layers():
         layers += [
             {"type": "attention",
              "->": {"heads": m.heads, "causal": True,
-                    "residual": True},
+                    "residual": True,
+                    "attn_block_size": m.get("attn_block")},
              "<-": dict(t)},
             {"type": "layernorm", "<-": dict(t)},
             {"type": "transformer_ffn",

@@ -13,10 +13,12 @@ chain like the rest of the zoo; stacking
 
 yields the classic post-LN transformer block.
 
-Long-context: the single-chip path materialises the (B,H,S,S) score
-matrix; the sequence-parallel ring path
-(``veles.znicz_tpu.parallel.ring``) streams K/V blocks around the
-'seq' mesh axis with ``ppermute`` instead.
+Long-context, three regimes: the default single-chip path
+materialises the (B,H,S,S) score matrix (fastest for short S);
+``attn_block_size`` switches to blocked flash-style attention
+(``parallel/flash.py`` — exact, O(S·block) score memory, single
+chip); a ``seq_mesh`` shards the sequence ACROSS chips via the
+``ppermute`` ring (``parallel/ring.py``).
 """
 
 import numpy
@@ -322,6 +324,11 @@ class MultiHeadAttention(Forward):
         self.seq_axis = "seq"
         #: extra batch-dim sharding axis on a composed SPxDP mesh
         self.seq_batch_axis = None
+        #: single-chip long-context mode: block the K/V sequence so
+        #: the (B,H,S,S) score matrix is never materialised (flash-
+        #: style online softmax, exact — parallel/flash.py). Must
+        #: divide the sequence length. None = dense.
+        self.attn_block_size = kwargs.get("attn_block_size")
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -400,6 +407,9 @@ class MultiHeadAttention(Forward):
         if self.seq_mesh is not None:
             y, cache = self._fwd_ring(jnp, x, p)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
+        elif self.attn_block_size:
+            y, cache = self._fwd_blocked(jnp, x, p)
+            names = ("q", "k", "v", "out_heads", "lse", "merged")
         else:
             y, cache = self._fwd_core(
                 jnp, x, p["weights"], p.get("bias"), p["weights_out"],
@@ -409,26 +419,43 @@ class MultiHeadAttention(Forward):
         for name, t in zip(names, cache):
             ctx.set(self, "cache_" + name, t)
 
-    def _fwd_ring(self, xp, x, p):
-        """Sequence-parallel forward: qkv projection under
-        auto-sharding, attention proper via the ppermute ring."""
-        from veles.znicz_tpu.parallel import ring
-        b, s, d = x.shape
+    def _project_qkv(self, x, p):
+        d = x.shape[-1]
         qkv = x @ p["weights"]
         if self.include_bias:
             qkv = qkv + p["bias"]
-        q = self._split(qkv[..., :d])
-        k = self._split(qkv[..., d:2 * d])
-        v = self._split(qkv[..., 2 * d:])
-        out_heads, lse = ring.ring_self_attention(
-            q, k, v, self.seq_mesh, axis=self.seq_axis,
-            causal=self.causal, batch_axis=self.seq_batch_axis)
-        merged = self._merge(out_heads)
+        return (self._split(qkv[..., :d]),
+                self._split(qkv[..., d:2 * d]),
+                self._split(qkv[..., 2 * d:]))
+
+    def _finish(self, x, merged, p):
         y = merged @ p["weights_out"]
         if self.include_bias:
             y = y + p["bias_out"]
         if self.residual:
             y = y + x
+        return y
+
+    def _fwd_blocked(self, xp, x, p):
+        """Single-chip flash-style forward: O(S·block) score memory."""
+        from veles.znicz_tpu.parallel import flash
+        q, k, v = self._project_qkv(x, p)
+        out_heads, lse = flash.blocked_attention_fwd(
+            q, k, v, causal=self.causal, block=self.attn_block_size)
+        merged = self._merge(out_heads)
+        y = self._finish(x, merged, p)
+        return y, (q, k, v, out_heads, lse, merged)
+
+    def _fwd_ring(self, xp, x, p):
+        """Sequence-parallel forward: qkv projection under
+        auto-sharding, attention proper via the ppermute ring."""
+        from veles.znicz_tpu.parallel import ring
+        q, k, v = self._project_qkv(x, p)
+        out_heads, lse = ring.ring_self_attention(
+            q, k, v, self.seq_mesh, axis=self.seq_axis,
+            causal=self.causal, batch_axis=self.seq_batch_axis)
+        merged = self._merge(out_heads)
+        y = self._finish(x, merged, p)
         return y, (q, k, v, out_heads, lse, merged)
 
 
@@ -514,12 +541,13 @@ class GDMultiHeadAttention(GradientDescentBase):
         arr.mem[...], vel.mem[...] = self.apply_update(
             numpy, arr.mem, vel.mem, grad, lr, moment, l2, l1r)
 
-    def _bwd_ring(self, xp, x, p, ctx, err):
-        """Sequence-parallel backward via the ring (dk/dv circulate a
-        full circle back to their home shards)."""
-        from veles.znicz_tpu.parallel import ring
+    def _bwd_outer(self, xp, x, p, ctx, err, attn_bwd):
+        """Shared backward scaffolding for the cached (out_heads, lse)
+        paths: output projection grads, then ``attn_bwd(q, k, v,
+        out_heads, lse, dctx) -> (dq, dk, dv)``, then the qkv
+        projection grads + residual."""
         f = self.forward
-        b, s, d = x.shape
+        d = x.shape[-1]
         q, k, v, out_heads, lse, merged = (
             ctx.get(f, "cache_" + n)
             for n in ("q", "k", "v", "out_heads", "lse", "merged"))
@@ -527,10 +555,7 @@ class GDMultiHeadAttention(GradientDescentBase):
         gbo = err.reshape(-1, d).sum(axis=0)
         dmerged = err @ p["weights_out"].T
         dctx = f._split(dmerged)
-        dq, dk, dv = ring.ring_self_attention_bwd(
-            q, k, v, out_heads, lse, dctx, f.seq_mesh,
-            axis=f.seq_axis, causal=f.causal,
-            batch_axis=f.seq_batch_axis)
+        dq, dk, dv = attn_bwd(q, k, v, out_heads, lse, dctx)
         dqkv = xp.concatenate(
             [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
         gw = x.reshape(-1, d).T @ dqkv.reshape(-1, 3 * d)
@@ -540,6 +565,27 @@ class GDMultiHeadAttention(GradientDescentBase):
             dx = dx + err
         return dx, gw, gb, gwo, gbo
 
+    def _bwd_ring(self, xp, x, p, ctx, err):
+        """Sequence-parallel backward via the ring (dk/dv circulate a
+        full circle back to their home shards)."""
+        from veles.znicz_tpu.parallel import ring
+        f = self.forward
+        return self._bwd_outer(
+            xp, x, p, ctx, err,
+            lambda q, k, v, o, lse, dctx: ring.ring_self_attention_bwd(
+                q, k, v, o, lse, dctx, f.seq_mesh, axis=f.seq_axis,
+                causal=f.causal, batch_axis=f.seq_batch_axis))
+
+    def _bwd_blocked(self, xp, x, p, ctx, err):
+        """Single-chip flash-style backward (block recomputation)."""
+        from veles.znicz_tpu.parallel import flash
+        f = self.forward
+        return self._bwd_outer(
+            xp, x, p, ctx, err,
+            lambda q, k, v, o, lse, dctx: flash.blocked_attention_bwd(
+                q, k, v, o, lse, dctx, causal=f.causal,
+                block=f.attn_block_size))
+
     def xla_run(self, ctx):
         import jax.numpy as jnp
         f = self.forward
@@ -548,6 +594,9 @@ class GDMultiHeadAttention(GradientDescentBase):
         p = ctx.unit_params(f)
         if f.seq_mesh is not None:
             dx, gw, gb, gwo, gbo = self._bwd_ring(jnp, x, p, ctx, err)
+        elif f.attn_block_size:
+            dx, gw, gb, gwo, gbo = self._bwd_blocked(
+                jnp, x, p, ctx, err)
         else:
             cache = tuple(ctx.get(f, "cache_" + n)
                           for n in ("q", "k", "v", "probs", "merged"))
