@@ -343,6 +343,261 @@ class LRNorm : public Unit {
 
 VELES_REGISTER_UNIT("norm", LRNorm)
 
+// -- transformer units (NEW beyond libZnicz: the LM exports too) ---------
+
+class Embedding : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    table_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    dim_ = spec.at("config").at("dim").AsInt();
+    vocab_ = spec.at("config").at("vocab_size").AsInt();
+    if (table_.rank() != 2 || table_.dim(0) != vocab_ ||
+        table_.dim(1) != dim_)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    if (spec.has("positions") && !spec.get("positions")->is_null()) {
+      positions_ = npy::Load(
+          ResolvePath(dir, spec.at("positions").AsString()));
+      if (positions_.rank() != 2 || positions_.dim(1) != dim_)
+        throw std::runtime_error(
+            name() + ": positions shape mismatch");
+      has_positions_ = true;
+    }
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    // ids arrive as floats (the interchange format is float .npy)
+    int64_t b = in.dim(0), s = in.NumElements() / in.dim(0);
+    if (has_positions_ && s > positions_.dim(0))
+      throw std::runtime_error(
+          name() + ": sequence longer than the exported positions "
+          "table (" + std::to_string(positions_.dim(0)) + ")");
+    out->Reset({b, s, dim_});
+    for (int64_t i = 0; i < b * s; ++i) {
+      int64_t id = static_cast<int64_t>(in.data()[i]);
+      if (id < 0 || id >= vocab_)
+        throw std::runtime_error(name() + ": token id out of range");
+      float* row = out->data() + i * dim_;
+      const float* src = table_.data() + id * dim_;
+      std::copy_n(src, dim_, row);
+      if (has_positions_) {
+        const float* p = positions_.data() + (i % s) * dim_;
+        for (int64_t d = 0; d < dim_; ++d) row[d] += p[d];
+      }
+    }
+  }
+
+ private:
+  Tensor table_, positions_;
+  bool has_positions_ = false;
+  int64_t dim_ = 0, vocab_ = 0;
+};
+
+VELES_REGISTER_UNIT("embedding", Embedding)
+
+class LayerNorm : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    gamma_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    beta_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
+    eps_ = static_cast<float>(spec.at("config").at("eps").AsDouble());
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    int64_t d = in.shape().back();
+    int64_t rows = in.NumElements() / d;
+    if (gamma_.NumElements() != d || beta_.NumElements() != d)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    *out = in;
+    for (int64_t r = 0; r < rows; ++r) {
+      float* x = out->data() + r * d;
+      float mu = 0;
+      for (int64_t i = 0; i < d; ++i) mu += x[i];
+      mu /= d;
+      float var = 0;
+      for (int64_t i = 0; i < d; ++i) var += (x[i] - mu) * (x[i] - mu);
+      var /= d;
+      float rstd = 1.0f / std::sqrt(var + eps_);
+      for (int64_t i = 0; i < d; ++i)
+        x[i] = (x[i] - mu) * rstd * gamma_.data()[i] + beta_.data()[i];
+    }
+  }
+
+ private:
+  Tensor gamma_, beta_;
+  float eps_ = 1e-5f;
+};
+
+VELES_REGISTER_UNIT("layernorm", LayerNorm)
+
+// y = act(x·W + b) over the trailing dim of any leading shape
+class TokenDense : public Unit {
+ public:
+  explicit TokenDense(Act act = Act::kLinear) : act_(act) {}
+
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    weights_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    if (!spec.get("bias")->is_null()) {
+      bias_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
+      has_bias_ = true;
+    }
+    features_ = spec.at("config").at("output_features").AsInt();
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    int64_t d = in.shape().back();
+    int64_t rows = in.NumElements() / d;
+    if (weights_.dim(0) != d || weights_.dim(1) != features_)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    std::vector<int64_t> oshape(in.shape());
+    oshape.back() = features_;
+    out->Reset(oshape);
+    Gemm(in.data(), weights_.data(), out->data(), rows, d, features_,
+         false);
+    if (has_bias_) AddBias(out->data(), bias_.data(), rows, features_);
+    ApplyActivation(act_, out->data(), rows, features_);
+  }
+
+ private:
+  Act act_;
+  Tensor weights_, bias_;
+  bool has_bias_ = false;
+  int64_t features_ = 0;
+};
+
+struct TokenDenseLinear : TokenDense {
+  TokenDenseLinear() : TokenDense(Act::kLinear) {}
+};
+struct TokenDenseStrictRelu : TokenDense {
+  TokenDenseStrictRelu() : TokenDense(Act::kStrictRelu) {}
+};
+
+VELES_REGISTER_UNIT("token_dense", TokenDenseLinear)
+VELES_REGISTER_UNIT("token_dense_relu", TokenDenseStrictRelu)
+
+// y = [x +] strict_relu(x·W1+b1)·W2+b2
+class TransformerFFN : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    w1_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    b1_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
+    w2_ = npy::Load(ResolvePath(dir, spec.at("weights2").AsString()));
+    b2_ = npy::Load(ResolvePath(dir, spec.at("bias2").AsString()));
+    hidden_ = spec.at("config").at("hidden").AsInt();
+    residual_ = spec.at("config").at("residual").AsBool();
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    int64_t d = in.shape().back();
+    int64_t rows = in.NumElements() / d;
+    if (w1_.dim(0) != d || w1_.dim(1) != hidden_ ||
+        w2_.dim(0) != hidden_ || w2_.dim(1) != d ||
+        b1_.NumElements() != hidden_ || b2_.NumElements() != d)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    std::vector<float> h(static_cast<size_t>(rows * hidden_));
+    Gemm(in.data(), w1_.data(), h.data(), rows, d, hidden_, false);
+    AddBias(h.data(), b1_.data(), rows, hidden_);
+    ApplyActivation(Act::kStrictRelu, h.data(), rows, hidden_);
+    out->Reset(in.shape());
+    Gemm(h.data(), w2_.data(), out->data(), rows, hidden_, d, false);
+    AddBias(out->data(), b2_.data(), rows, d);
+    if (residual_)
+      for (int64_t i = 0; i < rows * d; ++i)
+        out->data()[i] += in.data()[i];
+  }
+
+ private:
+  Tensor w1_, b1_, w2_, b2_;
+  int64_t hidden_ = 0;
+  bool residual_ = true;
+};
+
+VELES_REGISTER_UNIT("transformer_ffn", TransformerFFN)
+
+// causal/full multi-head self-attention over (B, S, D)
+class MultiHeadAttention : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    w_qkv_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    w_out_ = npy::Load(
+        ResolvePath(dir, spec.at("weights_out").AsString()));
+    const json::Value& cfg = spec.at("config");
+    heads_ = cfg.at("heads").AsInt();
+    causal_ = cfg.at("causal").AsBool();
+    residual_ = cfg.at("residual").AsBool();
+    if (cfg.at("include_bias").AsBool()) {
+      b_qkv_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
+      b_out_ = npy::Load(
+          ResolvePath(dir, spec.at("bias_out").AsString()));
+      has_bias_ = true;
+    }
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    if (in.rank() != 3)
+      throw std::runtime_error(name() + ": attention input must be "
+                               "(B, S, D), got " + in.ShapeString());
+    int64_t b = in.dim(0), s = in.dim(1), d = in.dim(2);
+    int64_t dh = d / heads_;
+    if (d % heads_)
+      throw std::runtime_error(name() + ": dim % heads != 0");
+    if (w_qkv_.dim(0) != d || w_qkv_.dim(1) != 3 * d ||
+        w_out_.dim(0) != d || w_out_.dim(1) != d)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    int64_t rows = b * s;
+    std::vector<float> qkv(static_cast<size_t>(rows * 3 * d));
+    Gemm(in.data(), w_qkv_.data(), qkv.data(), rows, d, 3 * d, false);
+    if (has_bias_) AddBias(qkv.data(), b_qkv_.data(), rows, 3 * d);
+    const float scale = 1.0f / std::sqrt(static_cast<float>(dh));
+    std::vector<float> merged(static_cast<size_t>(rows * d));
+    std::vector<float> scores(static_cast<size_t>(s));
+    // per (batch, head): scores row by row — O(S) score memory
+    for (int64_t bi = 0; bi < b; ++bi) {
+      for (int64_t h = 0; h < heads_; ++h) {
+        for (int64_t i = 0; i < s; ++i) {
+          const float* q = qkv.data() + ((bi * s + i) * 3 + 0) * d
+                           + h * dh;
+          int64_t kmax = causal_ ? i + 1 : s;
+          float mx = -std::numeric_limits<float>::infinity();
+          for (int64_t j = 0; j < kmax; ++j) {
+            const float* k = qkv.data() + ((bi * s + j) * 3 + 1) * d
+                             + h * dh;
+            float sc = 0;
+            for (int64_t e = 0; e < dh; ++e) sc += q[e] * k[e];
+            scores[j] = sc * scale;
+            mx = std::max(mx, scores[j]);
+          }
+          float sum = 0;
+          for (int64_t j = 0; j < kmax; ++j) {
+            scores[j] = std::exp(scores[j] - mx);
+            sum += scores[j];
+          }
+          float* dst = merged.data() + (bi * s + i) * d + h * dh;
+          std::fill_n(dst, dh, 0.0f);
+          for (int64_t j = 0; j < kmax; ++j) {
+            const float p = scores[j] / sum;
+            const float* v = qkv.data() + ((bi * s + j) * 3 + 2) * d
+                             + h * dh;
+            for (int64_t e = 0; e < dh; ++e) dst[e] += p * v[e];
+          }
+        }
+      }
+    }
+    out->Reset({b, s, d});
+    Gemm(merged.data(), w_out_.data(), out->data(), rows, d, d, false);
+    if (has_bias_) AddBias(out->data(), b_out_.data(), rows, d);
+    if (residual_)
+      for (int64_t i = 0; i < rows * d; ++i)
+        out->data()[i] += in.data()[i];
+  }
+
+ private:
+  Tensor w_qkv_, b_qkv_, w_out_, b_out_;
+  bool has_bias_ = false, causal_ = true, residual_ = true;
+  int64_t heads_ = 1;
+};
+
+VELES_REGISTER_UNIT("attention", MultiHeadAttention)
+
 // -- pass-through + standalone activations -------------------------------
 
 class Identity : public Unit {

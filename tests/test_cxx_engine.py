@@ -148,6 +148,40 @@ def test_conv_net_matches_oracle(engine, tmp_path):
     numpy.testing.assert_allclose(got, expected, atol=1e-4)
 
 
+def test_transformer_lm_matches_oracle(engine, tmp_path):
+    """The whole LM stack (embedding + attention + layernorm + FFN +
+    token_dense) runs forward in C++ and matches the numpy oracle."""
+    prng.seed_all(66)
+    from veles.znicz_tpu.models import transformer_lm
+    saved = root.lm.loader.to_dict()
+    saved_model = root.lm.model.to_dict()
+    root.lm.loader.update({"minibatch_size": 16, "n_train": 64,
+                           "n_valid": 32, "seq_len": 12})
+    root.lm.model.update({"dim": 16, "heads": 4, "layers": 1,
+                          "ffn_hidden": 32})
+    root.lm.decision.max_epochs = 1
+    try:
+        wf = transformer_lm.create_workflow(name="CxxLM")
+        wf.initialize(device="numpy")
+        wf.run()
+    finally:
+        root.lm.loader.update(saved)
+        root.lm.model.update(saved_model)
+        root.lm.decision.max_epochs = 8
+    archive = os.path.join(tmp_path, "lm_archive")
+    wf.export_inference(archive)
+    ids = numpy.array(wf.loader.minibatch_data.map_read().mem,
+                      numpy.int32)
+    wf.loader.minibatch_data.map_invalidate()
+    wf.loader.minibatch_data.mem[...] = ids
+    for f in wf.forwards:
+        f.numpy_run()
+    expected = numpy.array(wf.forwards[-1].output.map_read().mem)
+    got = _run_infer(engine, archive, ids, str(tmp_path))
+    assert got.shape == expected.shape
+    numpy.testing.assert_allclose(got, expected, atol=1e-4)
+
+
 def test_export_rejects_unsupported(tmp_path):
     """Units with no C++ counterpart must fail loudly, not silently
     skip (archive/runtime drift protection)."""

@@ -98,6 +98,8 @@ def test_lm_blocked_attention_from_config():
 
     def run(name, attn_block):
         prng.seed_all(999)
+        saved_loader = root.lm.loader.to_dict()
+        saved_epochs = root.lm.decision.get("max_epochs")
         root.lm.loader.update({"minibatch_size": 32, "n_train": 256,
                                "n_valid": 64})
         root.lm.decision.max_epochs = 2
@@ -108,6 +110,8 @@ def test_lm_blocked_attention_from_config():
             wf.run()
         finally:
             root.lm.model.attn_block = None
+            root.lm.loader.update(saved_loader)
+            root.lm.decision.max_epochs = saved_epochs
         return wf
 
     wf_d = run("LMDenseAttn", None)

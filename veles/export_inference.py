@@ -29,6 +29,8 @@ ENGINE_TYPES = frozenset({
     "max_pooling", "avg_pooling", "norm", "dropout",
     "activation_tanh", "activation_relu", "activation_str",
     "activation_sigmoid",
+    "embedding", "layernorm", "token_dense", "token_dense_relu",
+    "transformer_ffn", "attention",
 })
 
 
@@ -37,12 +39,14 @@ def _npy_name(unit, param):
 
 
 def _export_weighted(unit, path, spec):
-    w = numpy.asarray(unit.weights.map_read().mem, numpy.float32)
+    w = numpy.ascontiguousarray(unit.weights.map_read().mem,
+                                numpy.float32)
     fname = _npy_name(unit, "weights")
     numpy.save(os.path.join(path, fname), w)
     spec["weights"] = fname
     if unit.include_bias and unit.bias:
-        b = numpy.asarray(unit.bias.map_read().mem, numpy.float32)
+        b = numpy.ascontiguousarray(unit.bias.map_read().mem,
+                                    numpy.float32)
         fname = _npy_name(unit, "bias")
         numpy.save(os.path.join(path, fname), b)
         spec["bias"] = fname
@@ -50,10 +54,29 @@ def _export_weighted(unit, path, spec):
         spec["bias"] = None
 
 
+def _save_extra(unit, path, spec, attr, required=True):
+    """Export a non-standard parameter Array as its own .npy."""
+    arr = getattr(unit, attr, None)
+    if arr is None or not arr:
+        if required:
+            raise ValueError("%s: missing %s" % (unit.name, attr))
+        spec[attr] = None
+        return
+    fname = _npy_name(unit, attr)
+    numpy.save(os.path.join(path, fname),
+               numpy.ascontiguousarray(arr.map_read().mem,
+                                       numpy.float32))
+    spec[attr] = fname
+
+
 def _unit_spec(unit, path):
     """Serialize one forward unit; raises on unsupported types."""
     from veles.znicz_tpu.ops.all2all import All2AllBase
+    from veles.znicz_tpu.ops.attention import (
+        MultiHeadAttention, TokenDenseBase, TransformerFFN)
     from veles.znicz_tpu.ops.conv import ConvBase
+    from veles.znicz_tpu.ops.embedding import EmbeddingForward
+    from veles.znicz_tpu.ops.layernorm import LayerNormForward
     from veles.znicz_tpu.ops.pooling import (
         PoolingBase, StochasticPooling)
     from veles.znicz_tpu.ops.normalization import LRNormalizerForward
@@ -94,6 +117,37 @@ def _unit_spec(unit, path):
             "alpha": float(unit.alpha), "beta": float(unit.beta),
             "n": int(unit.n), "k": float(unit.k),
         })
+    elif isinstance(unit, EmbeddingForward):
+        spec["config"].update({"vocab_size": int(unit.vocab_size),
+                               "dim": int(unit.dim)})
+        _export_weighted(unit, path, spec)
+        if unit._positions is not None:
+            fname = _npy_name(unit, "positions")
+            numpy.save(os.path.join(path, fname),
+                       numpy.ascontiguousarray(
+                           unit._positions, numpy.float32))
+            spec["positions"] = fname
+    elif isinstance(unit, LayerNormForward):
+        spec["config"]["eps"] = float(unit.eps)
+        _export_weighted(unit, path, spec)
+    elif isinstance(unit, MultiHeadAttention):
+        spec["config"].update({
+            "heads": int(unit.heads), "causal": bool(unit.causal),
+            "residual": bool(unit.residual),
+            "include_bias": bool(unit.include_bias)})
+        _export_weighted(unit, path, spec)
+        _save_extra(unit, path, spec, "weights_out")
+        _save_extra(unit, path, spec, "bias_out",
+                    required=unit.include_bias)
+    elif isinstance(unit, TransformerFFN):
+        spec["config"].update({"hidden": int(unit.hidden),
+                               "residual": bool(unit.residual)})
+        _export_weighted(unit, path, spec)
+        _save_extra(unit, path, spec, "weights2")
+        _save_extra(unit, path, spec, "bias2")
+    elif isinstance(unit, TokenDenseBase):
+        spec["config"]["output_features"] = int(unit.output_features)
+        _export_weighted(unit, path, spec)
     elif isinstance(unit, (DropoutForward, ActivationForward)):
         pass  # config-free (dropout is identity at inference)
     else:
