@@ -144,6 +144,30 @@ def xla_cifar_images_per_sec(measure_chunks=1):
     return images / dt
 
 
+def lm_tokens_per_sec(measure_chunks=1):
+    """Transformer-LM training throughput (tokens/sec) on the XLA
+    device — the north star's NEW config (BASELINE config #5)."""
+    import veles.prng as prng
+    from veles.loader.base import CLASS_TRAIN
+    prng.seed_all(99)
+    from veles.config import root
+    from veles.znicz_tpu.models import transformer_lm
+    root.lm.loader.update({"minibatch_size": 64, "n_train": 2048,
+                           "n_valid": 256, "seq_len": 128})
+    root.lm.decision.max_epochs = 1024
+    wf = transformer_lm.create_workflow(name="BenchLM")
+    wf.initialize(device="xla")
+    loader, step = wf.loader, wf.xla_step
+    step.epochs_per_dispatch = 8
+    seq = root.lm.loader.seq_len
+    tokens, dt = _timed_chunks(
+        loader, step,
+        lambda ld: int(ld.minibatch_size) * seq
+        if ld.minibatch_class == CLASS_TRAIN else 0,
+        measure_chunks)
+    return tokens / dt
+
+
 def main():
     base = numpy_steps_per_sec()
     fast, grad_bytes = xla_mnist_bench()
@@ -164,6 +188,11 @@ def main():
         pass
     except Exception as exc:
         extra["alexnet_images_per_sec_error"] = str(exc)[:200]
+    try:
+        extra["lm_train_tokens_per_sec"] = round(
+            lm_tokens_per_sec(), 1)
+    except Exception as exc:
+        extra["lm_tokens_per_sec_error"] = str(exc)[:200]
     print(json.dumps({
         "metric": "mnist_train_steps_per_sec",
         "value": round(fast, 2),
