@@ -120,52 +120,52 @@ def _grad_sync_bytes(step):
     return parallel.grad_sync_bytes(host)
 
 
-def xla_cifar_images_per_sec(measure_chunks=1):
-    """Conv-stack throughput (images/sec) on the XLA device."""
-    import jax
+def _xla_throughput(create_workflow, cfg, count, epochs_per_dispatch,
+                    name, measure_chunks=1):
+    """Shared build-and-time scaffold: seed, size the dataset via the
+    sample's config section, init on the XLA device, time whole
+    dispatch chunks; -> count units per second."""
     import veles.prng as prng
-    from veles.loader.base import CLASS_TRAIN
     prng.seed_all(99)
-    from veles.config import root
-    from veles.znicz_tpu.models import cifar10
-    root.cifar.loader.minibatch_size = 100
-    root.cifar.loader.n_train = 2000
-    root.cifar.loader.n_valid = 400
-    root.cifar.decision.max_epochs = 1024
-    wf = cifar10.create_workflow(name="BenchCifar")
+    cfg.decision.max_epochs = 1024
+    wf = create_workflow(name=name)
     wf.initialize(device="xla")
     loader, step = wf.loader, wf.xla_step
-    step.epochs_per_dispatch = 16
-    images, dt = _timed_chunks(
-        loader, step,
+    step.epochs_per_dispatch = epochs_per_dispatch
+    total, dt = _timed_chunks(loader, step, count, measure_chunks)
+    return total / dt
+
+
+def xla_cifar_images_per_sec(measure_chunks=1):
+    """Conv-stack throughput (images/sec) on the XLA device."""
+    from veles.loader.base import CLASS_TRAIN
+    from veles.config import root
+    from veles.znicz_tpu.models import cifar10
+    root.cifar.loader.update({"minibatch_size": 100, "n_train": 2000,
+                              "n_valid": 400})
+    return _xla_throughput(
+        cifar10.create_workflow, root.cifar,
         lambda ld: int(ld.minibatch_size)
         if ld.minibatch_class == CLASS_TRAIN else 0,
-        measure_chunks)
-    return images / dt
+        epochs_per_dispatch=16, name="BenchCifar",
+        measure_chunks=measure_chunks)
 
 
 def lm_tokens_per_sec(measure_chunks=1):
     """Transformer-LM training throughput (tokens/sec) on the XLA
     device — the north star's NEW config (BASELINE config #5)."""
-    import veles.prng as prng
     from veles.loader.base import CLASS_TRAIN
-    prng.seed_all(99)
     from veles.config import root
     from veles.znicz_tpu.models import transformer_lm
     root.lm.loader.update({"minibatch_size": 64, "n_train": 2048,
                            "n_valid": 256, "seq_len": 128})
-    root.lm.decision.max_epochs = 1024
-    wf = transformer_lm.create_workflow(name="BenchLM")
-    wf.initialize(device="xla")
-    loader, step = wf.loader, wf.xla_step
-    step.epochs_per_dispatch = 8
     seq = root.lm.loader.seq_len
-    tokens, dt = _timed_chunks(
-        loader, step,
+    return _xla_throughput(
+        transformer_lm.create_workflow, root.lm,
         lambda ld: int(ld.minibatch_size) * seq
         if ld.minibatch_class == CLASS_TRAIN else 0,
-        measure_chunks)
-    return tokens / dt
+        epochs_per_dispatch=8, name="BenchLM",
+        measure_chunks=measure_chunks)
 
 
 def main():
@@ -192,7 +192,7 @@ def main():
         extra["lm_train_tokens_per_sec"] = round(
             lm_tokens_per_sec(), 1)
     except Exception as exc:
-        extra["lm_tokens_per_sec_error"] = str(exc)[:200]
+        extra["lm_train_tokens_per_sec_error"] = str(exc)[:200]
     print(json.dumps({
         "metric": "mnist_train_steps_per_sec",
         "value": round(fast, 2),

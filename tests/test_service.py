@@ -186,6 +186,37 @@ def test_single_slave_matches_standalone():
     numpy.testing.assert_allclose(w_master, w_ref, atol=1e-6)
 
 
+def test_wire_protocol_carries_all_params():
+    """The master↔slave link must ship EVERY forward parameter —
+    attention's weights_out / FFN's weights2 included, not just
+    weights/bias."""
+    from veles.znicz_tpu.ops.attention import MultiHeadAttention
+    from tests.test_conv_stack import build
+
+    prng.seed_all(77)
+    wf, feed, fwd, gd, x, err, comp = build(
+        MultiHeadAttention, input_shape=(2, 8, 8), gd_kwargs={},
+        heads=2)
+    payload = gd.generate_data_for_slave()
+    assert set(payload) >= {"weights", "weights_out"}
+
+    # slave side: apply master weights, "train" (mutate), ship deltas
+    gd.apply_data_from_master(payload)
+    fwd.weights_out.map_write()
+    fwd.weights_out.mem[...] += 0.25
+    update = gd.generate_data_for_master()
+    assert "dweights_out" in update
+    numpy.testing.assert_allclose(update["dweights_out"], 0.25,
+                                  atol=1e-6)
+    numpy.testing.assert_allclose(update["dweights"], 0.0, atol=1e-6)
+
+    # master side: deltas apply verbatim
+    before = numpy.array(fwd.weights_out.mem)
+    gd.apply_data_from_slave(update)
+    numpy.testing.assert_allclose(
+        fwd.weights_out.mem, before + 0.25, atol=1e-6)
+
+
 def test_drop_slave_requeues():
     from veles.loader.base import CLASS_TRAIN
     wf = make_wf("DropWf")

@@ -398,22 +398,34 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
 
     # IDistributable compat layer (SURVEY.md §2.2) ---------------------
 
-    def generate_data_for_slave(self, slave=None):
+    def _wire_params(self):
+        """(name, Array) pairs the master↔slave link carries: EVERY
+        parameter the forward declares (attention/FFN units have more
+        than weights/bias)."""
         f = self.forward
-        out = {"weights": numpy.array(f.weights.map_read().mem)}
-        if f.include_bias and f.bias:
-            out["bias"] = numpy.array(f.bias.map_read().mem)
+        out = []
+        for name in getattr(f, "PARAMS", ("weights", "bias")):
+            arr = getattr(f, name, None)
+            if arr is not None and arr:
+                out.append((name, arr))
         return out
+
+    def generate_data_for_slave(self, slave=None):
+        return {name: numpy.array(arr.map_read().mem)
+                for name, arr in self._wire_params()}
 
     def apply_data_from_master(self, data):
         if not data:
             return
-        f = self.forward
-        f.weights.map_write()
-        f.weights.mem[...] = data["weights"]
-        if "bias" in data and f.bias:
-            f.bias.map_write()
-            f.bias.mem[...] = data["bias"]
+        for name, arr in self._wire_params():
+            if name not in data:
+                # fail loudly: silently skipping a declared parameter
+                # would let it diverge across slaves with no error
+                raise KeyError(
+                    "%s: master payload missing %r (version skew?)"
+                    % (self.name, name))
+            arr.map_write()
+            arr.mem[...] = data[name]
         # remember the basis the master handed us: updates ship as
         # DELTAS against it (same bytes on the wire as full weights,
         # but the master can apply each slave's training verbatim —
@@ -428,21 +440,21 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         if basis is None:
             return self.generate_data_for_slave()
         current = self.generate_data_for_slave()
+        # apply_data_from_master guarantees the basis covers every
+        # wire param, so a KeyError here is a real protocol bug
         return {"d" + k: current[k] - basis[k] for k in current}
 
     def apply_data_from_slave(self, data, slave=None):
         """Merge one slave's training into the canonical weights.
 
-        Delta payloads (``dweights``/``dbias``) apply additively scaled
-        by ``slave_merge_scale`` (default 1.0). Absolute payloads fall
-        back to the reference's halfway parameter averaging [U]."""
+        Delta payloads (``dweights``/``dbias``/...) apply additively
+        scaled by ``slave_merge_scale`` (default 1.0). Absolute
+        payloads fall back to the reference's halfway parameter
+        averaging [U]."""
         if not data:
             return
         scale = float(getattr(self, "slave_merge_scale", 1.0))
-        f = self.forward
-        for key, arr in (("weights", f.weights), ("bias", f.bias)):
-            if arr is None or not arr:
-                continue
+        for key, arr in self._wire_params():
             if "d" + key in data:
                 arr.map_write()
                 arr.mem[...] += scale * data["d" + key]
