@@ -170,19 +170,22 @@ def test_weights2d_conv_layer(tmp_path):
 def test_kohonen_hits_plotter(tmp_path):
     prng.seed_all(11)
     from veles.znicz_tpu.models import kohonen
-    from veles.znicz_tpu.nn_plotting_units import KohonenHits
+    from veles.znicz_tpu.nn_plotting_units import (
+        KohonenHits, KohonenNeighborMap)
     root.kohonen.decision.max_epochs = 2
     root.kohonen.loader.n_samples = 200
     wf = kohonen.create_workflow(name="SomPlot")
     out = str(tmp_path / "som")
-    hits = KohonenHits(wf, forward=wf.forwards[0], name="som_hits",
-                       out_dir=out)
-    hits.link_from(wf.decision)
-    hits.gate_skip = ~wf.decision.epoch_ended
+    for cls, name in ((KohonenHits, "som_hits"),
+                      (KohonenNeighborMap, "som_umatrix")):
+        u = cls(wf, forward=wf.forwards[0], name=name, out_dir=out)
+        u.link_from(wf.decision)
+        u.gate_skip = ~wf.decision.epoch_ended
     wf.initialize(device="numpy")
     wf.run()
-    png = os.path.join(out, "som_hits.png")
-    assert os.path.exists(png) and os.path.getsize(png) > 500
+    for name in ("som_hits", "som_umatrix"):
+        png = os.path.join(out, name + ".png")
+        assert os.path.exists(png) and os.path.getsize(png) > 500
 
 
 # -- web status -------------------------------------------------------

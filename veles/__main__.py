@@ -54,6 +54,9 @@ def build_argparser():
                    help="write decision history JSON here")
     p.add_argument("--no-stats", action="store_true",
                    help="skip the per-unit timing report")
+    p.add_argument("--dump-unit-sizes", action="store_true",
+                   help="print per-unit buffer footprints after "
+                        "initialize")
     p.add_argument("--graphics-dir", default=None,
                    help="stream plots to a renderer process writing "
                         "PNGs here (also auto-links the standard "
@@ -136,6 +139,8 @@ class Main:
                 and hasattr(self.workflow, "link_plotters"):
             self.workflow.link_plotters(out_dir=args.graphics_dir)
         self.launcher.initialize(self.workflow, **kwargs)
+        if args.dump_unit_sizes:
+            self.workflow.print_unit_sizes(sys.stderr)
         self.launcher.run()
         if args.export_inference:
             self.workflow.export_inference(args.export_inference)

@@ -189,6 +189,25 @@ class Workflow(Unit, Container):
             stream.write("%-32s %10.4f %8d %6.1f%%\n"
                          % (name, t, calls, 100.0 * t / total))
 
+    def print_unit_sizes(self, stream=sys.stderr):
+        """Per-unit Array buffer footprint (the reference's
+        ``--dump-unit-sizes`` [U?]; SURVEY.md §5.1)."""
+        from veles.memory import Array
+        rows = []
+        for u in self._units:
+            # Array.nbytes skips the map-state check: a device-dirty
+            # (UNMAPPED) param Array would make .mem raise here
+            total = sum(value.nbytes for value in vars(u).values()
+                        if isinstance(value, Array) and value)
+            if total:
+                rows.append((total, u.name))
+        rows.sort(reverse=True)
+        stream.write("%-32s %12s\n" % ("unit", "bytes"))
+        for nbytes, name in rows:
+            stream.write("%-32s %12d\n" % (name, nbytes))
+        stream.write("%-32s %12d\n"
+                     % ("TOTAL", sum(r[0] for r in rows)))
+
     def unit_by_name(self, name: str) -> Unit:
         for unit in self._units:
             if unit.name == name:

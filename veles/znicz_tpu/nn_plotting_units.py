@@ -129,6 +129,38 @@ class ConfusionMatrixPlotter(PlotterBase):
         return meta, {"matrix": m.astype(numpy.int32)}
 
 
+class KohonenNeighborMap(PlotterBase):
+    """SOM U-matrix (reference ``KohonenNeighborMap`` [U]): each grid
+    cell colored by the mean distance of its weight vector to its
+    grid neighbors' — ridges of high distance reveal cluster
+    boundaries the map has learned."""
+
+    def __init__(self, workflow, forward=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward = forward
+
+    def make_payload(self):
+        f = self.forward
+        if f is None or not getattr(f, "weights", None) or not f.weights:
+            return None
+        gy, gx = f.grid_shape
+        w = numpy.asarray(f.weights.map_read().mem,
+                          numpy.float32).reshape(gy, gx, -1)
+        umatrix = numpy.zeros((gy, gx), numpy.float32)
+        for y in range(gy):
+            for x in range(gx):
+                dists = []
+                for dy, dx in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+                    ny, nx = y + dy, x + dx
+                    if 0 <= ny < gy and 0 <= nx < gx:
+                        dists.append(numpy.linalg.norm(
+                            w[y, x] - w[ny, nx]))
+                umatrix[y, x] = numpy.mean(dists)
+        meta = {"kind": "image", "title": "SOM U-matrix",
+                "cmap": "bone"}
+        return meta, {"image": umatrix}
+
+
 class KohonenHits(PlotterBase):
     """SOM BMU hit-count map (reference ``KohonenHits`` [U]): how many
     dataset samples map to each grid cell, computed host-side from the

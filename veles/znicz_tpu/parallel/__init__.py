@@ -21,6 +21,27 @@ for the retained job-queue compat layer.
 import numpy
 
 
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Join a multi-host mesh: thin wrapper over
+    ``jax.distributed.initialize`` (SURVEY.md §5.8 "TPU-native
+    equivalent"). After it returns, ``jax.devices()`` spans every
+    host's chips and ``make_mesh`` lays axes across them — the SPMD
+    analogue of the reference's master/slave topology, with DCN
+    carrying the inter-host legs of the collectives. On Cloud TPU
+    pods all three arguments auto-detect (pass nothing)."""
+    import jax
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    jax.distributed.initialize(**kwargs)
+    return jax.process_index(), jax.process_count()
+
+
 def make_mesh(axes=None, devices=None):
     """Build a Mesh. ``axes``: dict name->size (ordered); ``None``
     means one 'data' axis over all visible devices."""
