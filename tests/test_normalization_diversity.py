@@ -1,6 +1,8 @@
 """Pluggable normalizer family (SURVEY.md §2.3) + weight diversity
 diagnostics (§2.4)."""
 
+import os
+
 import numpy
 import pytest
 
@@ -161,6 +163,67 @@ def test_streaming_loader_rejects_normalizer(rng):
     ld.class_lengths = [0, 10, 20]
     with pytest.raises(NotImplementedError, match="normalization"):
         ld.initialize()
+
+
+def test_normalizer_state_rides_checkpoints(tmp_path):
+    """Fitted stats survive snapshot -> restore (the inference-only
+    restore path can then normalize without train data)."""
+    prng.seed_all(909)
+    from veles.snapshotter import load_snapshot
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    saved_epochs = root.mnist.decision.get("max_epochs")
+    root.mnist.loader.update(
+        {"n_train": 200, "n_valid": 80, "minibatch_size": 40})
+    root.mnist.decision.max_epochs = 2
+    try:
+        wf = mnist.StandardWorkflow(
+            None, name="NormSnap", layers=root.mnist.layers,
+            loader_factory=lambda w: mnist.MnistLoader(
+                w, name="loader", minibatch_size=40,
+                normalization_type="mean_disp"),
+            decision_config=root.mnist.decision.to_dict(),
+            snapshotter_config={"directory": str(tmp_path),
+                                "export_inference":
+                                    str(tmp_path / "archive")})
+        wf.initialize(device="numpy")
+        wf.run()
+        mean = wf.loader.normalizer.mean.copy()
+        assert wf.snapshotter.destination
+        # improved snapshots also refreshed the inference archive
+        assert os.path.exists(
+            str(tmp_path / "archive" / "contents.json"))
+
+        state = load_snapshot(wf.snapshotter.destination)
+        wf2 = mnist.create_workflow(name="NormSnap2")
+        wf2.initialize(device="numpy")
+        wf2.restore_state(state)
+        # the checkpoint's mean_disp normalizer replaced the default
+        numpy.testing.assert_allclose(
+            wf2.loader.normalizer.mean, mean, atol=1e-6)
+
+        # inference-only restore: no train rows to re-fit from — the
+        # restored stats must still TRANSFORM the resident data
+        from veles.loader.fullbatch import FullBatchLoader
+        from veles.workflow import Workflow
+        wf3 = Workflow(None, name="InferOnly")
+        ld = FullBatchLoader(wf3, name="loader", minibatch_size=10,
+                             normalization_type="mean_disp")
+        gen = numpy.random.default_rng(5)
+        eval_data = gen.normal(3.0, 2.0, (20, 784)) \
+            .astype(numpy.float32)
+        ld.original_data.mem = eval_data.copy()
+        ld.class_lengths = [0, 20, 0]
+        ld.initialize()              # fit deferred: no train rows
+        ld.set_state(state["loader"])
+        expected = (eval_data - wf.loader.normalizer.mean) \
+            * wf.loader.normalizer.rdisp
+        numpy.testing.assert_allclose(ld.original_data.mem, expected,
+                                      atol=1e-5)
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = saved_epochs
 
 
 # -- diversity --------------------------------------------------------

@@ -42,6 +42,10 @@ def build_argparser():
                    help="master seed for every PRNG")
     p.add_argument("--snapshot", default=None,
                    help="checkpoint file to resume from")
+    p.add_argument("--snapshots", default=None, metavar="DIR",
+                   help="write improved-gated checkpoints to DIR "
+                        "(links a Snapshotter when the workflow has "
+                        "none)")
     p.add_argument("--listen-address", default=None,
                    help="host:port -> run as distribution master")
     p.add_argument("--master-address", default=None,
@@ -138,6 +142,10 @@ class Main:
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):
             self.workflow.link_plotters(out_dir=args.graphics_dir)
+        if args.snapshots and getattr(
+                self.workflow, "snapshotter", None) is None \
+                and hasattr(self.workflow, "link_snapshotter"):
+            self.workflow.link_snapshotter(directory=args.snapshots)
         self.launcher.initialize(self.workflow, **kwargs)
         if args.dump_unit_sizes:
             self.workflow.print_unit_sizes(sys.stderr)

@@ -157,14 +157,17 @@ def _unit_spec(unit, path):
     return spec
 
 
-def export_inference(workflow, path):
+def export_inference(workflow, path, at_valid=False, sync=True):
     """Write the inference archive for ``workflow`` into directory
     ``path`` (created if missing). Device-resident params are synced to
-    host first. Returns the contents.json path."""
+    host first; ``at_valid=True`` exports the epoch-entry view the
+    validation metric was measured on (what an improved-gated snapshot
+    saves). Pass ``sync=False`` when the caller just synced the same
+    view (the snapshotter's export-on-snapshot path)."""
     os.makedirs(path, exist_ok=True)
     step = getattr(workflow, "xla_step", None)
-    if step is not None:
-        step.sync_host()
+    if sync and step is not None:
+        step.sync_host(at_valid=at_valid)
     units = [_unit_spec(u, path) for u in workflow.forwards]
     doc = {
         "format": 1,

@@ -273,12 +273,34 @@ class Loader(Unit, IDistributable):
     # -- checkpoint support (resume restarts the in-flight epoch) ------
 
     def get_state(self):
-        return {"epoch_number": self.epoch_number,
-                "prng_state": dict(self.prng._gen.bit_generator.state)}
+        state = {"epoch_number": self.epoch_number,
+                 "prng_state": dict(self.prng._gen.bit_generator.state)}
+        norm = self.normalizer.state()
+        if norm:
+            # fitted input statistics ride the checkpoint so an
+            # inference-only restore (no train data to re-fit from)
+            # still normalizes identically
+            state["normalizer"] = norm
+        return state
 
     def set_state(self, state):
         self.epoch_number = int(state["epoch_number"])
         self.prng._gen.bit_generator.state = state["prng_state"]
+        norm = state.get("normalizer")
+        if norm:
+            name = norm.get("__name__")
+            if name and name != self.normalizer.NAME:
+                # the checkpoint's normalizer wins over the (possibly
+                # default) loader config — silently grafting fitted
+                # stats onto the wrong class would skip normalization
+                from veles.normalization import from_state
+                self.warning(
+                    "restoring %r normalizer from checkpoint "
+                    "(loader was configured with %r)",
+                    name, self.normalizer.NAME)
+                self.normalizer = from_state(norm)
+            else:
+                self.normalizer.set_state(norm)
         # restart the in-flight epoch (snapshots happen at the valid/
         # train boundary; replaying the epoch's eval classes is cheap)
         self._start_epoch(first=True)

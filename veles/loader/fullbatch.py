@@ -27,13 +27,23 @@ class FullBatchLoader(Loader):
         #: dtype the minibatch is served in (normalized float input)
         self.serve_dtype = numpy.float32
 
+    def _transform_residents(self):
+        """Apply the (fitted) normalizer to the resident data in
+        place. Targets are re-pointed only when they ALIAS the data
+        buffer (autoencoders); separate regression targets have their
+        own feature space, so input statistics must not touch them."""
+        data = self.original_data.mem
+        aliased = self.original_targets \
+            and self.original_targets.mem is data
+        self.original_data.mem = self.normalizer.normalize(data)
+        if aliased:
+            self.original_targets.mem = self.original_data.mem
+        self._data_normalized = True
+
     def apply_normalization(self):
         """Fit the normalizer on the TRAIN rows (the loader layout is
-        [test | valid | train]) and transform the resident data in
-        place — eval data never leaks into the statistics. Targets are
-        re-pointed only when they ALIAS the data buffer (autoencoders);
-        separate regression targets have their own feature space, so
-        input statistics must not touch them."""
+        [test | valid | train]) and transform the resident data —
+        eval data never leaks into the statistics."""
         from veles.normalization import NoneNormalizer
         if isinstance(self.normalizer, NoneNormalizer):
             return
@@ -41,16 +51,22 @@ class FullBatchLoader(Loader):
         train0 = self.class_offset(2)
         if train0 >= len(data):
             self.warning(
-                "no train samples: %s normalization skipped (restore "
+                "no train samples: %s normalization deferred (restore "
                 "fitted statistics from a checkpoint for inference)",
                 self.normalizer.NAME)
             return
-        aliased = self.original_targets \
-            and self.original_targets.mem is data
         self.normalizer.analyze(data[train0:])
-        self.original_data.mem = self.normalizer.normalize(data)
-        if aliased:
-            self.original_targets.mem = self.original_data.mem
+        self._transform_residents()
+
+    def set_state(self, state):
+        super().set_state(state)
+        # inference-only restore: the initialize-time fit was deferred
+        # (no train rows) — the checkpoint's fitted statistics must
+        # now actually transform the resident data
+        from veles.normalization import NoneNormalizer
+        if not getattr(self, "_data_normalized", False) \
+                and not isinstance(self.normalizer, NoneNormalizer):
+            self._transform_residents()
 
     def load_data(self):
         """Default: originals were assigned externally before

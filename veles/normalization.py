@@ -65,13 +65,20 @@ class NormalizerBase:
         # between analyze() and the first normalize() must restore the
         # in-flight statistics. Arrays are COPIED — the in-place
         # accumulators must not mutate an already-captured state.
-        return {k: (v.copy() if isinstance(v, numpy.ndarray) else v)
-                for k, v in vars(self).items()}
+        # __name__ records the registry type so restore can rebuild
+        # the right class even into a differently-configured loader.
+        out = {k: (v.copy() if isinstance(v, numpy.ndarray) else v)
+               for k, v in vars(self).items()}
+        out["__name__"] = self.NAME
+        return out
 
     def set_state(self, state):
         for k, v in state.items():
+            if k == "__name__":
+                continue
             setattr(self, k,
                     v.copy() if isinstance(v, numpy.ndarray) else v)
+
 
     # -- device-path export -------------------------------------------
 
@@ -86,6 +93,14 @@ class NormalizerBase:
         probe1 = self.normalize(one[None])[0]
         rdisp = probe1 - probe0
         return -probe0 / numpy.where(rdisp == 0, 1, rdisp), rdisp
+
+
+def from_state(state):
+    """Rebuild a normalizer purely from its checkpointed state."""
+    cls = NORMALIZERS[state["__name__"]]
+    n = cls.__new__(cls)
+    n.set_state(state)
+    return n
 
 
 @normalizer("none")

@@ -35,7 +35,8 @@ class SnapshotterBase(Unit):
     """Gated checkpoint writer."""
 
     def __init__(self, workflow, prefix="wf", compression="gz",
-                 directory=None, keep=2, **kwargs):
+                 directory=None, keep=2, export_inference=None,
+                 **kwargs):
         super().__init__(workflow, **kwargs)
         if compression not in _OPENERS:
             raise ValueError("compression must be one of %s"
@@ -47,6 +48,11 @@ class SnapshotterBase(Unit):
         self.decision = None
         self.destination = None      # last written path
         self._written = []
+        #: directory to (re)write the C++ inference archive into on
+        #: every improved snapshot — the deployable artifact always
+        #: tracks the best checkpoint (reference export-on-snapshot
+        #: flow, SURVEY.md §3.5)
+        self.export_inference_dir = export_inference
 
     def initialize(self, **kwargs):
         super().initialize(**kwargs)
@@ -85,6 +91,13 @@ class SnapshotterBase(Unit):
                 os.remove(stale)
             except OSError:
                 pass
+        if self.export_inference_dir:
+            from veles.export_inference import export_inference
+            # checkpoint_state() above already synced the at_valid view
+            export_inference(self.workflow, self.export_inference_dir,
+                             at_valid=True, sync=False)
+            self.info("inference archive -> %s",
+                      self.export_inference_dir)
         self.info("snapshot -> %s", path)
         return path
 

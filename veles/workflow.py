@@ -194,11 +194,16 @@ class Workflow(Unit, Container):
         ``--dump-unit-sizes`` [U?]; SURVEY.md §5.1)."""
         from veles.memory import Array
         rows = []
+        seen = set()   # linked Arrays are shared: count each buffer once
         for u in self._units:
-            # Array.nbytes skips the map-state check: a device-dirty
-            # (UNMAPPED) param Array would make .mem raise here
-            total = sum(value.nbytes for value in vars(u).values()
-                        if isinstance(value, Array) and value)
+            total = 0
+            for value in vars(u).values():
+                # Array.nbytes skips the map-state check: a device-
+                # dirty (UNMAPPED) param Array would make .mem raise
+                if isinstance(value, Array) and value \
+                        and id(value) not in seen:
+                    seen.add(id(value))
+                    total += value.nbytes
             if total:
                 rows.append((total, u.name))
         rows.sort(reverse=True)

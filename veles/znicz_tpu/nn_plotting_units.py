@@ -141,7 +141,7 @@ class KohonenNeighborMap(PlotterBase):
 
     def make_payload(self):
         f = self.forward
-        if f is None or not getattr(f, "weights", None) or not f.weights:
+        if f is None or not getattr(f, "weights", None):
             return None
         gy, gx = f.grid_shape
         w = numpy.asarray(f.weights.map_read().mem,
@@ -173,7 +173,7 @@ class KohonenHits(PlotterBase):
 
     def make_payload(self):
         f = self.forward
-        if f is None or not getattr(f, "weights", None) or not f.weights:
+        if f is None or not getattr(f, "weights", None):
             return None
         data = self.workflow.loader.original_data
         x = numpy.asarray(data.map_read().mem, numpy.float32)

@@ -190,6 +190,7 @@ class StandardWorkflowBase(NNWorkflow):
         snap.link_from(self.decision)
         snap.gate_skip = ~self.decision.improved
         self.snapshotter = snap
+        self._end_point_last()   # post-construction linking support
         return snap
 
     def link_plotters(self, out_dir=None, weights=True, confusion=None):
