@@ -186,6 +186,41 @@ def test_single_slave_matches_standalone():
     numpy.testing.assert_allclose(w_master, w_ref, atol=1e-6)
 
 
+def test_xla_slave_trains():
+    """A slave on the FUSED XLA backend: weights pushed by the master
+    re-upload per job (refresh_device), train, sync back, ship deltas."""
+    from veles.server import MasterServer
+
+    from veles.launcher import Launcher
+    from veles.znicz_tpu.standard_workflow import StandardWorkflow
+    from veles.znicz_tpu.models import mnist
+
+    master_wf = make_wf("MasterXla", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2)
+    server.start_background()
+    addr = "127.0.0.1:%d" % server.bound_address[1]
+    w0 = numpy.array(master_wf.forwards[0].weights.map_read().mem)
+
+    # the REAL slave surface: the Launcher flags is_slave before
+    # initialize, which pins the per-step (non-scan) execution mode
+    prng.seed_all(555)
+    slave = StandardWorkflow(
+        None, name="SlaveXla", layers=root.mnist.layers,
+        loader_factory=lambda w: mnist.MnistLoader(
+            w, name="loader", minibatch_size=50),
+        decision_config={"max_epochs": 2})
+    launcher = Launcher(device="cpu", master_address=addr, stats=False)
+    launcher.initialize(slave)
+    assert slave.xla_step is not None \
+        and not slave.xla_step.scan_mode   # slaves stay per-step
+    launcher.run()
+    assert server.done.is_set()
+    w1 = master_wf.forwards[0].weights.map_read().mem
+    assert not numpy.allclose(w0, w1)
+    assert numpy.isfinite(w1).all()
+
+
 def test_wire_protocol_carries_all_params():
     """The master↔slave link must ship EVERY forward parameter —
     attention's weights_out / FFN's weights2 included, not just

@@ -21,6 +21,7 @@ class SlaveClient(Logger):
     def __init__(self, workflow, address, name=None):
         self.name = name or "SlaveClient"
         self.workflow = workflow
+        self._check_mode()
         host, _, port = str(address).rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
         require_secret_for(self.address[0], "slave master")
@@ -36,8 +37,22 @@ class SlaveClient(Logger):
         self.slave_id = slave_id
         return self
 
+    def _check_mode(self):
+        """A slave must serve the indices the MASTER assigns per job;
+        fused whole-epoch dispatch owns its own minibatch order, so a
+        workflow initialized without ``is_slave = True`` (the Launcher
+        sets it before initialize) is rejected LOUDLY. Re-checked per
+        job, since initialize() may happen after construction."""
+        step = getattr(self.workflow, "xla_step", None)
+        if step is not None and (step.scan_mode or step.stream_mode):
+            raise ValueError(
+                "slave workflow was initialized in fused dispatch "
+                "mode; set workflow.is_slave = True before "
+                "initialize()")
+
     def run_one(self):
         """Request + run one job; False when the master says stop."""
+        self._check_mode()
         send_frame(self.sock, ("job", self.slave_id))
         resp = recv_frame(self.sock)
         if resp is None or resp[0] == "bye":

@@ -103,6 +103,11 @@ class Array(Logger):
 
     def map_write(self) -> "Array":
         self.map_read()
+        if self._mem is not None and not self._mem.flags.writeable:
+            # map_read of a device value stores a zero-copy READ-ONLY
+            # view (numpy.asarray of a jax array); writers get their
+            # own buffer
+            self._mem = numpy.array(self._mem)
         self._state = MAPPED_WRITE
         self._devmem = None
         return self
