@@ -116,6 +116,57 @@ def test_lm_sp_plus_dp(dense_wf):
         assert abs(a - b) < 0.05, (hist, dense)
 
 
+def test_dp_snapshot_resume_rollback_combo(tmp_path):
+    """DP mesh x snapshotter x rollback together; resume re-places the
+    params on the mesh."""
+    import jax
+    from tests.test_service import make_wf
+    from veles.snapshotter import load_snapshot
+    from veles.znicz_tpu import parallel
+
+    wf = make_wf("DPSnapT", backend="cpu", snapdir=str(tmp_path))
+    parallel.setup_data_parallel(wf, parallel.make_mesh({"data": 8}))
+    wf.link_rollback(lr_cut=0.5, blowup_factor=50.0)
+    wf.run()
+    assert wf.snapshotter.destination
+
+    state = load_snapshot(wf.snapshotter.destination)
+    wf2 = make_wf("DPSnapT2", backend="cpu", max_epochs=3)
+    parallel.setup_data_parallel(wf2, parallel.make_mesh({"data": 8}))
+    wf2.restore_state(state)
+    wf2.run()
+    assert wf2.decision.epoch_number == 3
+    leaf = jax.tree_util.tree_leaves(wf2.xla_step.params)[0]
+    assert len(leaf.sharding.device_set) == 8
+
+
+def test_tp_snapshot_resume(tmp_path, dense_wf):
+    """TP-sharded LM params checkpoint and restore onto the mesh."""
+    import jax
+    from veles.snapshotter import load_snapshot
+
+    wf = _run_lm("LMTPSnap", {"model": 4})
+    from veles.snapshotter import Snapshotter
+    snap = Snapshotter(wf, name="snap", directory=str(tmp_path))
+    snap.decision = wf.decision
+    path = snap.export_snapshot()
+    state = load_snapshot(path)
+
+    wf2 = _run_lm("LMTPSnap2", {"model": 4}, max_epochs=1)
+    wf2.restore_state(state)
+    step = wf2.xla_step
+    from veles.znicz_tpu.ops.attention import TransformerFFN
+    ffn = next(f for f in wf2.forwards
+               if isinstance(f, TransformerFFN))
+    leaf = step.params[ffn.name]["weights"]
+    # restored AND still TP-sharded over the model axis
+    assert len(leaf.sharding.device_set) == 4
+    assert tuple(leaf.sharding.spec) == (None, "model")
+    numpy.testing.assert_allclose(
+        numpy.asarray(leaf),
+        state["params"][ffn.name]["weights"], atol=1e-6)
+
+
 def test_tp_grad_sync_accounting(dense_wf):
     """grad_sync_bytes still reports the full trainable payload."""
     from veles.znicz_tpu import parallel
