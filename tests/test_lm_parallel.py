@@ -11,12 +11,19 @@ from veles.config import root
 
 @pytest.fixture(autouse=True, scope="module")
 def _restore_lm_config():
+    import veles.znicz_tpu.models.mnist  # noqa: defaults
     import veles.znicz_tpu.models.transformer_lm  # noqa: defaults
     saved_loader = root.lm.loader.to_dict()
     saved_epochs = root.lm.decision.get("max_epochs")
+    # the combo tests borrow test_service.make_wf, which mutates
+    # root.mnist — this module runs BEFORE test_mnist_functional
+    saved_mnist = root.mnist.loader.to_dict()
+    saved_mnist_epochs = root.mnist.decision.get("max_epochs")
     yield
     root.lm.loader.update(saved_loader)
     root.lm.decision.max_epochs = saved_epochs
+    root.mnist.loader.update(saved_mnist)
+    root.mnist.decision.max_epochs = saved_mnist_epochs
 
 
 def _run_lm(name, parallel=None, max_epochs=3):
