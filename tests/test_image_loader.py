@@ -103,6 +103,27 @@ def test_label_colors_learnable(image_tree):
     assert hist[-1] < 0.5, hist   # 3 classes, random = 0.67
 
 
+def test_window_materialization_uses_class_phase(image_tree):
+    """The fused dispatch builds every window of an epoch while the
+    loader's live train_phase still points at the FIRST class served —
+    materialize_window must derive augmentation from the class being
+    built, not the serving gate (regression: train windows silently
+    got eval augmentation)."""
+    from veles.loader.base import CLASS_TRAIN, CLASS_VALID
+    ld = _make_loader(image_tree)
+    ld.train_phase << False          # epoch starts serving VALID
+    rows = numpy.arange(8).reshape(2, 4)
+    a = ld.materialize_window(CLASS_TRAIN, rows)["data"]
+    b = ld.materialize_window(CLASS_TRAIN, rows)["data"]
+    # train windows get random crops/mirrors: same epoch+indices give
+    # the SAME (seeded) augmentation, but it must differ from eval's
+    # deterministic center crop
+    numpy.testing.assert_array_equal(a, b)
+    ev = ld.materialize_window(CLASS_VALID, rows)["data"]
+    assert not numpy.array_equal(a, ev), \
+        "train window materialized with eval augmentation"
+
+
 def test_synthetic_bank_eval_not_mirrored():
     """Eval minibatches must see the true pixels — mirroring is a
     TRAIN-only augmentation in both the oracle and device formulas."""

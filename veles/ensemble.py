@@ -46,19 +46,26 @@ class Ensemble(Logger):
 
     def _member_outputs(self, x):
         """Forward ``x`` through every member (numpy path on the
-        synced weights); -> list of output arrays."""
+        synced weights); -> list of output arrays. Runs in EVAL phase:
+        the last serve of training leaves train_phase True, and
+        dropout/stochastic-pooling must not randomize predictions."""
         outs = []
         for wf in self.workflows:
             step = getattr(wf, "xla_step", None)
             if step is not None:
                 step.sync_host()
             loader = wf.loader
-            loader.minibatch_data.map_invalidate()
-            loader.minibatch_data.mem[...] = x
-            for f in wf.forwards:
-                f.numpy_run()
-            outs.append(numpy.array(
-                wf.forwards[-1].output.map_read().mem))
+            was_train = bool(loader.train_phase)
+            loader.train_phase << False
+            try:
+                loader.minibatch_data.map_invalidate()
+                loader.minibatch_data.mem[...] = x
+                for f in wf.forwards:
+                    f.numpy_run()
+                outs.append(numpy.array(
+                    wf.forwards[-1].output.map_read().mem))
+            finally:
+                loader.train_phase << was_train
         return outs
 
     def predict(self, x):

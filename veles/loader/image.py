@@ -128,8 +128,9 @@ class ImageLoaderBase(StreamLoader):
             arr = arr[:, ::-1]
         return arr
 
-    def materialize_samples(self, indices):
-        train = bool(self.train_phase)
+    def materialize_samples(self, indices, train=None):
+        if train is None:      # per-serve oracle path
+            train = bool(self.train_phase)
         shape = self.sample_shape()
         data = numpy.empty((len(indices),) + shape, numpy.uint8)
         labels = numpy.empty(len(indices), numpy.int32)

@@ -18,7 +18,7 @@ import concurrent.futures
 
 import numpy
 
-from veles.loader.base import Loader
+from veles.loader.base import CLASS_TRAIN, Loader
 
 
 class StreamLoader(Loader):
@@ -51,11 +51,13 @@ class StreamLoader(Loader):
 
     # -- subclass surface ---------------------------------------------
 
-    def materialize_samples(self, indices):
+    def materialize_samples(self, indices, train=None):
         """dict name -> (len(indices), ...) host arrays for the given
-        GLOBAL sample indices (the train/eval distinction, augmentation
-        etc. are up to the subclass via ``self.train_phase`` — windows
-        are materialized per class so the phase is unambiguous)."""
+        GLOBAL sample indices. ``train`` carries the phase of the
+        CLASS being materialized: the fused dispatch builds every
+        window of an epoch up front, so ``self.train_phase`` (the
+        live serving gate) must NOT be consulted there — None means
+        "derive from train_phase" (the per-serve oracle path)."""
         raise NotImplementedError
 
     def sample_spec(self):
@@ -98,13 +100,16 @@ class StreamLoader(Loader):
         """Stack B minibatches: one vectorized call over the whole
         window when the producer is numpy-bound, else decode rows in
         the thread pool (one future per minibatch)."""
+        train = cls == CLASS_TRAIN
         idx_mat = numpy.asarray(idx_mat)
         if self.window_vectorized:
             b, mb = idx_mat.shape
-            flat = self.materialize_samples(idx_mat.reshape(-1))
+            flat = self.materialize_samples(idx_mat.reshape(-1),
+                                            train=train)
             return {name: arr.reshape((b, mb) + arr.shape[1:])
                     for name, arr in flat.items()}
-        futures = [self.pool.submit(self.materialize_samples, row)
+        futures = [self.pool.submit(self.materialize_samples, row,
+                                    train)
                    for row in idx_mat]
         batches = [f.result() for f in futures]
         return {name: numpy.stack([b[name] for b in batches])
@@ -140,7 +145,7 @@ class ArrayStreamLoader(StreamLoader):
                                self._targets.dtype)
         return spec
 
-    def materialize_samples(self, indices):
+    def materialize_samples(self, indices, train=None):
         out = {"data": self._data[indices]}
         if self._labels is not None:
             out["labels"] = self._labels[indices]

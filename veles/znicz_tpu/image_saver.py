@@ -45,11 +45,17 @@ class ImageSaver(Unit):
 
     def _sample(self, loader, mb_pos, global_idx):
         """Sample array by position: the dataset originals when
-        resident (the fused path skips host minibatch fills), else the
-        host minibatch mirror."""
+        resident, a re-materialization for streaming loaders (the
+        fused path skips host minibatch fills, so the host mirror is
+        stale there), else the host minibatch mirror."""
         orig = getattr(loader, "original_data", None)
         if orig is not None and orig:
             return numpy.asarray(orig.map_read().mem[global_idx])
+        if loader.device_gather and hasattr(loader,
+                                            "materialize_samples"):
+            batch = loader.materialize_samples(
+                numpy.asarray([global_idx]), train=False)
+            return numpy.asarray(batch["data"][0])
         return numpy.asarray(
             loader.minibatch_data.map_read().mem[mb_pos])
 
@@ -58,7 +64,9 @@ class ImageSaver(Unit):
         orig = getattr(loader, "original_labels", None)
         if orig is not None and orig:
             return int(orig.map_read().mem[global_idx])
-        if loader.minibatch_labels:
+        if hasattr(loader, "label_of"):        # streaming image tree
+            return int(loader.label_of(int(global_idx)))
+        if loader.minibatch_labels and not loader.device_gather:
             return int(loader.minibatch_labels.map_read().mem[mb_pos])
         return -1
 

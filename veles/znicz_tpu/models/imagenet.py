@@ -189,17 +189,35 @@ class SyntheticImageLoader(FullBatchLoader):
         self.minibatch_labels.mem[...] = self.original_labels.mem[idx]
 
 
+def _real_tree():
+    """(base_dir, n_classes) of a usable real image tree, or (None, 0).
+    ONE definition of both the base-dir fallback and the class-dir
+    criterion (a subdir counts only if it holds image files — exactly
+    AutoLabelFileImageLoader's rule), shared by the loader factory and
+    the softmax-width probe so they can never disagree."""
+    from veles.loader.image import IMAGE_EXTS
+    base = root.imagenet.loader.get("base_dir") or os.path.join(
+        root.common.dirs.datasets, "ImageNet")
+    if not (base and os.path.isdir(base)):
+        return None, 0
+    n = 0
+    for entry in os.listdir(base):
+        sub = os.path.join(base, entry)
+        if os.path.isdir(sub) and any(
+                f.lower().endswith(IMAGE_EXTS)
+                for f in os.listdir(sub)):
+            n += 1
+    return (base, n) if n else (None, 0)
+
+
 def make_loader(wf):
     cfg = root.imagenet.loader
-    base = cfg.get("base_dir") or os.path.join(
-        root.common.dirs.datasets, "ImageNet")
     kwargs = dict(name="loader",
                   minibatch_size=cfg.minibatch_size,
                   scale=tuple(cfg.scale), crop=tuple(cfg.crop),
                   mirror="random")
-    if base and os.path.isdir(base) and any(
-            os.path.isdir(os.path.join(base, d))
-            for d in os.listdir(base)):
+    base, n = _real_tree()
+    if base:
         return AutoLabelFileImageLoader(wf, base_dir=base, **kwargs)
     return SyntheticImageLoader(
         wf, n_classes=cfg.n_classes, n_train=cfg.n_train,
@@ -210,32 +228,27 @@ def n_classes_of(loader):
     return getattr(loader, "n_classes", None) or 1000
 
 
+def _probe_classes():
+    """Softmax width BEFORE the loader exists: a real directory tree
+    determines its own class count; the synthetic stand-in uses the
+    config. Shares make_loader's resolution (see ``_real_tree``)."""
+    base, n = _real_tree()
+    return n if base else root.imagenet.loader.n_classes
+
+
 def create_workflow(name="AlexNetWorkflow", **kwargs):
     cfg = root.imagenet
-    holder = {}
-
-    def factory(wf):
-        holder["loader"] = make_loader(wf)
-        return holder["loader"]
-
-    # the layers list needs n_classes before the loader exists; build
-    # the loader first through a dummy probe of the config
-    probe_classes = cfg.loader.n_classes if not (
-        cfg.loader.get("base_dir")
-        and os.path.isdir(cfg.loader.base_dir)) else None
-
-    layers = alexnet_layers(
-        probe_classes or 1000, lr=cfg.lr)
+    layers = alexnet_layers(_probe_classes(), lr=cfg.lr)
     return StandardWorkflow(
         None, name=name, layers=layers,
-        loader_factory=factory,
+        loader_factory=make_loader,
         decision_config=cfg.decision.to_dict(),
         **kwargs)
 
 
 def run(load, main):
     load(StandardWorkflow,
-         layers=alexnet_layers(root.imagenet.loader.n_classes,
+         layers=alexnet_layers(_probe_classes(),
                                lr=root.imagenet.lr),
          loader_factory=make_loader,
          decision_config=root.imagenet.decision.to_dict())

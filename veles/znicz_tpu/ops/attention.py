@@ -247,12 +247,14 @@ class GDTransformerFFN(GradientDescentBase):
         t = int(self.iteration.map_read().mem) - 1
         self._np_update(f.weights2, self.vel_weights2, gw2,
                         self._scheduled_lr(numpy, self.lr_policy,
-                                           self.learning_rate, t),
+                                           self.learning_rate, t)
+                        * self.lr_scale,
                         self.gradient_moment,
                         self.weights_decay, self.l1_vs_l2)
         self._np_update(f.bias2, self.vel_bias2, gb2,
                         self._scheduled_lr(numpy, self.lr_policy_bias,
-                                           self.learning_rate_bias, t),
+                                           self.learning_rate_bias, t)
+                        * self.lr_scale,
                         self.gradient_moment_bias,
                         self.weights_decay_bias, self.l1_vs_l2_bias)
 
@@ -278,9 +280,10 @@ class GDTransformerFFN(GradientDescentBase):
         st = ctx.unit_state(self)
         # update_weights_xla already advanced the schedule counter
         t = st["iteration"] - 1
-        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t)
+        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t) \
+            * h["lr_scale"]
         lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
-                                  h["lr_bias"], t)
+                                  h["lr_bias"], t) * h["lr_scale"]
         w2, vel2 = p["weights2"], st["vel_weights2"]
         w2, vel2 = self.apply_update(
             jnp, w2, vel2, ctx.pmean(gw2).astype(w2.dtype), lr_w,
@@ -524,14 +527,16 @@ class GDMultiHeadAttention(GradientDescentBase):
         t = int(self.iteration.map_read().mem) - 1
         self._np_update(f.weights_out, self.vel_weights_out, gwo,
                         self._scheduled_lr(numpy, self.lr_policy,
-                                           self.learning_rate, t),
+                                           self.learning_rate, t)
+                        * self.lr_scale,
                         self.gradient_moment,
                         self.weights_decay, self.l1_vs_l2)
         if f.include_bias:
             self._np_update(f.bias_out, self.vel_bias_out, gbo,
                             self._scheduled_lr(
                                 numpy, self.lr_policy_bias,
-                                self.learning_rate_bias, t),
+                                self.learning_rate_bias, t)
+                            * self.lr_scale,
                             self.gradient_moment_bias,
                             self.weights_decay_bias, self.l1_vs_l2_bias)
 
@@ -609,9 +614,10 @@ class GDMultiHeadAttention(GradientDescentBase):
         st = ctx.unit_state(self)
         # update_weights_xla already advanced the schedule counter
         t = st["iteration"] - 1
-        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t)
+        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t) \
+            * h["lr_scale"]
         lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
-                                  h["lr_bias"], t)
+                                  h["lr_bias"], t) * h["lr_scale"]
         w_o, vel = p["weights_out"], st["vel_weights_out"]
         w_o, vel = self.apply_update(
             jnp, w_o, vel, ctx.pmean(gwo).astype(w_o.dtype), lr_w,
