@@ -1,0 +1,166 @@
+"""End-to-end gradient oracle: jax.grad of the ENTIRE composed forward
+(+ loss) must match the hand-written GD chain's effective gradients on
+every parameter of every unit — the strongest form of SURVEY.md §4's
+"jax.grad as a second oracle", applied to whole models rather than
+single units. Catches chain-composition mistakes (mis-linked
+err routing, missing residual terms) that per-unit checks cannot."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+
+
+def _effective_grads(wf, lr=1e-3):
+    """Run ONE compiled train step with lr=lr, momentum/decay 0 on a
+    fixed minibatch; -> (batch, param-grads as (w_before−w_after)/lr)."""
+    import jax
+    from veles.loader.base import CLASS_TRAIN
+    step = wf.xla_step
+    for gd in wf.gds:
+        if gd is not None:
+            gd.learning_rate = lr
+            gd.learning_rate_bias = lr
+            gd.gradient_moment = 0.0
+            gd.gradient_moment_bias = 0.0
+            gd.weights_decay = 0.0
+            gd.weights_decay_bias = 0.0
+    loader = wf.loader
+    # scan/stream modes skip host minibatch fills (device_gather);
+    # this harness feeds the compiled PER-STEP function from the host
+    # arrays, so force real fills — and prove the batch isn't the
+    # stale zeros a silent mis-setup would produce
+    loader.device_gather = False
+    loader.run()
+    while loader.minibatch_class != CLASS_TRAIN:
+        loader.run()
+    batch = step._gather_batch()
+    assert numpy.asarray(batch["data"]).any(), "zero batch: harness bug"
+    fn = step.compiler.compile(step._batch_spec, train=True)
+    import jax.numpy as jnp
+    copy = (lambda t: jax.tree_util.tree_map(jnp.copy, t))
+    before = copy(step.params)
+    params2, _, _ = fn(copy(step.params), copy(step.state), batch,
+                       step._gather_hyper(), jax.random.PRNGKey(7))
+    grads = jax.tree_util.tree_map(
+        lambda a, b: (numpy.asarray(a) - numpy.asarray(b)) / lr,
+        before, params2)
+    return batch, before, grads
+
+
+def _autodiff_grads(wf, batch, params):
+    """jax.grad of the pure composed forward+loss over the same
+    minibatch."""
+    import jax
+    from veles.accelerated_units import FlowContext
+    step = wf.xla_step
+    comp = step.compiler
+    loader = wf.loader
+
+    def loss_fn(p):
+        ctx = FlowContext(comp, dict(p), {}, step._gather_hyper(),
+                          jax.random.PRNGKey(7), True)
+        for name, (unit, attr) in step._batch_spec.items():
+            ctx.set(unit, attr, batch[name])
+        for u in step.eval_units:
+            u.xla_run(ctx)
+        return ctx.outputs["loss"]
+
+    return jax.grad(loss_fn)(params)
+
+
+def _assert_grads_match(wf, atol=2e-3):
+    batch, params, got = _effective_grads(wf)
+    want = _autodiff_grads(wf, batch, params)
+    for uname, sub in want.items():
+        for pname, g_ref in sub.items():
+            g_ref = numpy.asarray(g_ref)
+            g_hat = numpy.asarray(got[uname][pname])
+            scale = max(numpy.abs(g_ref).max(), 1e-3)
+            assert numpy.allclose(g_hat, g_ref, atol=atol * scale), \
+                "%s.%s: max |Δ| %.3g vs scale %.3g" % (
+                    uname, pname,
+                    numpy.abs(g_hat - g_ref).max(), scale)
+
+
+def test_transformer_lm_grads_match_autodiff():
+    """Embedding + attention + layernorm + FFN + token_dense +
+    EvaluatorLM, composed: handwritten chain == jax.grad."""
+    prng.seed_all(4242)
+    from veles.znicz_tpu.models import transformer_lm
+    saved = root.lm.loader.to_dict()
+    root.lm.loader.update({"minibatch_size": 8, "n_train": 32,
+                           "n_valid": 16, "seq_len": 12})
+    saved_model = root.lm.model.to_dict()
+    root.lm.model.update({"dim": 16, "heads": 4, "layers": 2,
+                          "ffn_hidden": 32})
+    try:
+        wf = transformer_lm.create_workflow(name="GradLM")
+        wf.initialize(device="cpu")
+        _assert_grads_match(wf)
+    finally:
+        root.lm.loader.update(saved)
+        root.lm.model.update(saved_model)
+
+
+def test_blocked_attention_lm_grads_match_autodiff():
+    """Same model through the flash-style blocked attention path."""
+    prng.seed_all(4242)
+    from veles.znicz_tpu.models import transformer_lm
+    saved = root.lm.loader.to_dict()
+    root.lm.loader.update({"minibatch_size": 8, "n_train": 32,
+                           "n_valid": 16, "seq_len": 12})
+    saved_model = root.lm.model.to_dict()
+    root.lm.model.update({"dim": 16, "heads": 4, "layers": 1,
+                          "ffn_hidden": 32, "attn_block": 4})
+    try:
+        wf = transformer_lm.create_workflow(name="GradLMBlk")
+        wf.initialize(device="cpu")
+        _assert_grads_match(wf)
+    finally:
+        root.lm.loader.update(saved)
+        root.lm.model.update(saved_model)
+
+
+def test_conv_stack_grads_match_autodiff():
+    """The CIFAR conv/pool/dense/softmax-CE chain == jax.grad."""
+    prng.seed_all(1717)
+    from veles.znicz_tpu.models import cifar10
+    saved = {k: root.cifar.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    root.cifar.loader.update({"n_train": 64, "n_valid": 32,
+                              "minibatch_size": 16})
+    try:
+        wf = cifar10.create_workflow(name="GradCifar")
+        wf.initialize(device="cpu")
+        _assert_grads_match(wf, atol=5e-3)
+    finally:
+        root.cifar.loader.update(saved)
+
+
+def test_alexnet_grads_match_autodiff():
+    """The FULL AlexNet stack — conv(s4) + LRN + overlapping pools +
+    dropout(0: deterministic identity mask, traced RNG path still
+    exercised) + FC — == jax.grad, through the strided im2col weight-
+    grad path too."""
+    prng.seed_all(2929)
+    from veles.znicz_tpu.models import imagenet
+    from veles.znicz_tpu.standard_workflow import StandardWorkflow
+    saved = imagenet.root.imagenet.loader.to_dict()
+    root.imagenet.loader.update({
+        "minibatch_size": 8, "n_train": 32, "n_valid": 16,
+        "n_classes": 4, "scale": (75, 75), "crop": (67, 67)})
+    layers = imagenet.alexnet_layers(4)
+    for layer in layers:
+        if layer["type"] == "dropout":
+            layer["->"]["dropout_ratio"] = 0.0
+    try:
+        wf = StandardWorkflow(
+            None, name="GradAlex", layers=layers,
+            loader_factory=imagenet.make_loader,
+            decision_config={"max_epochs": 1})
+        wf.initialize(device="cpu")
+        _assert_grads_match(wf, atol=5e-3)
+    finally:
+        root.imagenet.loader.update(saved)
