@@ -7,6 +7,7 @@
 #include <cstdint>
 #include <map>
 #include <memory>
+#include <stdexcept>
 #include <string>
 #include <vector>
 
@@ -30,7 +31,12 @@ class Value {
   bool is_null() const { return type == Type::kNull; }
   bool AsBool() const { return bool_v; }
   double AsDouble() const { return num_v; }
-  int64_t AsInt() const { return static_cast<int64_t>(num_v); }
+  int64_t AsInt() const {
+    // out-of-range double->int64 casts are UB; fail loudly instead
+    if (!(num_v >= -9.2e18 && num_v <= 9.2e18))
+      throw std::runtime_error("json: integer out of range");
+    return static_cast<int64_t>(num_v);
+  }
   const std::string& AsString() const { return str_v; }
 
   // object access; throws on missing key

@@ -48,6 +48,16 @@ class Parser {
                              std::to_string(pos_) + ": " + msg);
   }
 
+  // recursion guard: a crafted document of nested brackets must fail,
+  // not overflow the stack
+  struct DepthGuard {
+    explicit DepthGuard(Parser* p) : p_(p) {
+      if (++p_->depth_ > 256) p_->Fail("nesting too deep");
+    }
+    ~DepthGuard() { --p_->depth_; }
+    Parser* p_;
+  };
+
   void SkipWs() {
     while (pos_ < s_.size() && std::isspace(
         static_cast<unsigned char>(s_[pos_]))) ++pos_;
@@ -77,6 +87,7 @@ class Parser {
   }
 
   ValuePtr ParseObject() {
+    DepthGuard guard(this);
     auto v = std::make_shared<Value>();
     v->type = Value::Type::kObject;
     Expect('{');
@@ -97,6 +108,7 @@ class Parser {
   }
 
   ValuePtr ParseArray() {
+    DepthGuard guard(this);
     auto v = std::make_shared<Value>();
     v->type = Value::Type::kArray;
     Expect('[');
@@ -198,6 +210,7 @@ class Parser {
 
   const std::string& s_;
   size_t pos_ = 0;
+  int depth_ = 0;
 };
 
 }  // namespace

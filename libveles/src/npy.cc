@@ -79,9 +79,21 @@ Tensor Load(const std::string& path) {
   if (!f) throw std::runtime_error("cannot open " + path);
   std::string header = ReadHeader(f, path);
   std::string descr = DictValue(header, "descr");
+  if (descr.find('>') != std::string::npos)
+    throw std::runtime_error(
+        path + ": big-endian dtype " + descr + " unsupported");
   if (DictValue(header, "fortran_order").find("True") != std::string::npos)
     throw std::runtime_error(path + ": fortran_order unsupported");
   std::vector<int64_t> shape = ParseShape(DictValue(header, "shape"));
+  // validate BEFORE multiplying: a crafted header must not overflow
+  // the element product (UB) or command a giant allocation
+  constexpr int64_t kMaxElems = int64_t{1} << 34;   // 64 GiB of f32
+  int64_t n_check = 1;
+  for (int64_t d : shape) {
+    if (d < 0 || (d > 0 && n_check > kMaxElems / d))
+      throw std::runtime_error(path + ": unreasonable shape");
+    n_check *= d;
+  }
   Tensor t(shape.empty() ? std::vector<int64_t>{1} : shape);
   int64_t n = t.NumElements();
   if (descr.find("f4") != std::string::npos) {

@@ -52,6 +52,7 @@ void ApplyActivation(Act act, float* y, int64_t rows, int64_t cols) {
         y[i] = 0.5f * (std::tanh(0.5f * y[i]) + 1.0f);
       return;
     case Act::kSoftmax:
+      if (cols == 0) return;     // degenerate width: nothing to do
       for (int64_t r = 0; r < rows; ++r) {
         float* row = y + r * cols;
         float mx = row[0];
@@ -71,6 +72,51 @@ std::string ResolvePath(const std::string& dir, const std::string& rel) {
   return dir.empty() ? rel : dir + "/" + rel;
 }
 
+// -- archive/input validation helpers ---------------------------------
+//
+// contents.json and .npy files may be hand-edited or hostile: every
+// config integer and parameter shape is validated at Configure time,
+// and input shapes at Execute time, so malformed archives fail with a
+// catchable error instead of out-of-bounds access / SIGFPE / UB.
+
+// generous cap on any single config dimension (kernel sizes, strides,
+// pads, head counts...): keeps all derived int64 products far from
+// overflow
+constexpr int64_t kMaxDim = int64_t{1} << 24;
+
+int64_t CheckDim(int64_t v, const std::string& who, const char* what,
+                 int64_t lo = 1) {
+  if (v < lo || v > kMaxDim)
+    throw std::runtime_error(
+        who + ": bad " + what + " (" + std::to_string(v) + ")");
+  return v;
+}
+
+// total-element cap for any buffer a unit derives (matches npy's):
+// products are built with overflow-checked multiplies
+constexpr int64_t kMaxElems = int64_t{1} << 34;
+
+int64_t CheckedMul(int64_t a, int64_t b, const std::string& who) {
+  if (a < 0 || b < 0 || (b > 0 && a > kMaxElems / b))
+    throw std::runtime_error(who + ": size overflow");
+  return a * b;
+}
+
+void CheckVecSize(const Tensor& t, int64_t n, const std::string& who,
+                  const char* what) {
+  if (t.NumElements() != n)
+    throw std::runtime_error(
+        who + ": " + what + " has " + std::to_string(t.NumElements()) +
+        " elements, expected " + std::to_string(n));
+}
+
+void CheckNonEmpty(const Tensor& in, const std::string& who) {
+  if (in.NumElements() <= 0 || in.dim(0) <= 0 ||
+      in.shape().back() <= 0)
+    throw std::runtime_error(
+        who + ": empty input " + in.ShapeString());
+}
+
 // -- dense ------------------------------------------------------------
 
 class All2All : public Unit {
@@ -85,14 +131,15 @@ class All2All : public Unit {
     }
     transposed_ = spec.get("weights_transposed")->AsBool();
     const json::Value& cfg = spec.at("config");
-    neurons_ = cfg.at("neurons").AsInt();
+    neurons_ = CheckDim(cfg.at("neurons").AsInt(), name(), "neurons");
     // dense layers may emit multi-dim samples (e.g. (4,4,8) feeding a
     // conv); default to the flat (neurons,) sample
     out_sample_ = cfg.has("output_sample_shape")
                       ? cfg.at("output_sample_shape").AsIntVector()
                       : std::vector<int64_t>{neurons_};
     int64_t sample_elems = 1;
-    for (int64_t d : out_sample_) sample_elems *= d;
+    for (int64_t d : out_sample_)
+      sample_elems *= CheckDim(d, name(), "output_sample_shape");
     if (sample_elems != neurons_)
       throw std::runtime_error(
           name() + ": output_sample_shape product != neurons");
@@ -100,6 +147,7 @@ class All2All : public Unit {
     int64_t w_neurons = transposed_ ? weights_.dim(0) : weights_.dim(1);
     if (w_neurons != neurons_)
       throw std::runtime_error(name() + ": weight shape mismatch");
+    if (has_bias_) CheckVecSize(bias_, neurons_, name(), "bias");
     fan_in_ = fan_in;
   }
 
@@ -168,13 +216,17 @@ class Conv : public Unit {
       has_bias_ = true;
     }
     const json::Value& cfg = spec.at("config");
-    n_kernels_ = cfg.at("n_kernels").AsInt();
-    ky_ = cfg.at("ky").AsInt();
-    kx_ = cfg.at("kx").AsInt();
+    n_kernels_ = CheckDim(cfg.at("n_kernels").AsInt(), name(),
+                          "n_kernels");
+    ky_ = CheckDim(cfg.at("ky").AsInt(), name(), "ky");
+    kx_ = CheckDim(cfg.at("kx").AsInt(), name(), "kx");
     std::vector<int64_t> s = cfg.at("sliding").AsIntVector();
-    sy_ = s.at(0);
-    sx_ = s.at(1);
+    sy_ = CheckDim(s.at(0), name(), "sliding");
+    sx_ = CheckDim(s.at(1), name(), "sliding");
     pad_ = ReadPadding(cfg);
+    for (int64_t pv : {pad_.top, pad_.bottom, pad_.left, pad_.right})
+      CheckDim(pv, name(), "padding", 0);
+    if (has_bias_) CheckVecSize(bias_, n_kernels_, name(), "bias");
   }
 
   void Execute(const Tensor& in, Tensor* out) const override {
@@ -182,17 +234,23 @@ class Conv : public Unit {
       throw std::runtime_error(name() + ": conv input must be NHWC, got " +
                                in.ShapeString());
     int64_t b = in.dim(0), h = in.dim(1), w = in.dim(2), c = in.dim(3);
-    int64_t kkc = ky_ * kx_ * c;
+    CheckDim(c, name(), "channels");
+    int64_t kkc = CheckedMul(CheckedMul(ky_, kx_, name()), c, name());
     if (weights_.dim(0) != n_kernels_ || weights_.dim(1) != kkc)
       throw std::runtime_error(name() + ": weight shape mismatch");
-    int64_t oy = (h + pad_.top + pad_.bottom - ky_) / sy_ + 1;
-    int64_t ox = (w + pad_.left + pad_.right - kx_) / sx_ + 1;
-    if (oy <= 0 || ox <= 0)
+    CheckNonEmpty(in, name());
+    if (h + pad_.top + pad_.bottom < ky_ ||
+        w + pad_.left + pad_.right < kx_)
       throw std::runtime_error(
           name() + ": input " + in.ShapeString() +
           " smaller than the conv kernel");
+    int64_t oy = (h + pad_.top + pad_.bottom - ky_) / sy_ + 1;
+    int64_t ox = (w + pad_.left + pad_.right - kx_) / sx_ + 1;
     // im2col, patch order (ky, kx, C) — conv_math.im2col
-    std::vector<float> cols(static_cast<size_t>(b * oy * ox * kkc), 0.0f);
+    int64_t cols_elems = CheckedMul(
+        CheckedMul(CheckedMul(b, oy, name()), ox, name()), kkc,
+        name());
+    std::vector<float> cols(static_cast<size_t>(cols_elems), 0.0f);
     for (int64_t bi = 0; bi < b; ++bi) {
       const float* img = in.data() + bi * h * w * c;
       for (int64_t yo = 0; yo < oy; ++yo) {
@@ -249,16 +307,17 @@ class Pooling : public Unit {
 
   void Configure(const json::Value& spec, const std::string&) override {
     const json::Value& cfg = spec.at("config");
-    ky_ = cfg.at("ky").AsInt();
-    kx_ = cfg.at("kx").AsInt();
+    ky_ = CheckDim(cfg.at("ky").AsInt(), name(), "ky");
+    kx_ = CheckDim(cfg.at("kx").AsInt(), name(), "kx");
     std::vector<int64_t> s = cfg.at("sliding").AsIntVector();
-    sy_ = s.at(0);
-    sx_ = s.at(1);
+    sy_ = CheckDim(s.at(0), name(), "sliding");
+    sx_ = CheckDim(s.at(1), name(), "sliding");
   }
 
   void Execute(const Tensor& in, Tensor* out) const override {
     if (in.rank() != 4)
       throw std::runtime_error(name() + ": pooling input must be NHWC");
+    CheckNonEmpty(in, name());
     int64_t b = in.dim(0), h = in.dim(1), w = in.dim(2), c = in.dim(3);
     // ceil semantics: partial bottom/right windows pool too
     int64_t oy = (std::max<int64_t>(h - ky_, 0) + sy_ - 1) / sy_ + 1;
@@ -314,11 +373,12 @@ class LRNorm : public Unit {
     const json::Value& cfg = spec.at("config");
     alpha_ = static_cast<float>(cfg.at("alpha").AsDouble());
     beta_ = static_cast<float>(cfg.at("beta").AsDouble());
-    n_ = cfg.at("n").AsInt();
+    n_ = CheckDim(cfg.at("n").AsInt(), name(), "n");
     k_ = static_cast<float>(cfg.at("k").AsDouble());
   }
 
   void Execute(const Tensor& in, Tensor* out) const override {
+    CheckNonEmpty(in, name());
     int64_t c = in.shape().back();
     int64_t rows = in.NumElements() / c;
     out->Reset(in.shape());
@@ -349,8 +409,10 @@ class Embedding : public Unit {
  public:
   void Configure(const json::Value& spec, const std::string& dir) override {
     table_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
-    dim_ = spec.at("config").at("dim").AsInt();
-    vocab_ = spec.at("config").at("vocab_size").AsInt();
+    dim_ = CheckDim(spec.at("config").at("dim").AsInt(), name(),
+                    "dim");
+    vocab_ = CheckDim(spec.at("config").at("vocab_size").AsInt(),
+                      name(), "vocab_size");
     if (table_.rank() != 2 || table_.dim(0) != vocab_ ||
         table_.dim(1) != dim_)
       throw std::runtime_error(name() + ": weight shape mismatch");
@@ -366,6 +428,7 @@ class Embedding : public Unit {
 
   void Execute(const Tensor& in, Tensor* out) const override {
     // ids arrive as floats (the interchange format is float .npy)
+    CheckNonEmpty(in, name());
     int64_t b = in.dim(0), s = in.NumElements() / in.dim(0);
     if (has_positions_ && s > positions_.dim(0))
       throw std::runtime_error(
@@ -403,6 +466,7 @@ class LayerNorm : public Unit {
   }
 
   void Execute(const Tensor& in, Tensor* out) const override {
+    CheckNonEmpty(in, name());
     int64_t d = in.shape().back();
     int64_t rows = in.NumElements() / d;
     if (gamma_.NumElements() != d || beta_.NumElements() != d)
@@ -440,10 +504,13 @@ class TokenDense : public Unit {
       bias_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
       has_bias_ = true;
     }
-    features_ = spec.at("config").at("output_features").AsInt();
+    features_ = CheckDim(spec.at("config").at("output_features")
+                             .AsInt(), name(), "output_features");
+    if (has_bias_) CheckVecSize(bias_, features_, name(), "bias");
   }
 
   void Execute(const Tensor& in, Tensor* out) const override {
+    CheckNonEmpty(in, name());
     int64_t d = in.shape().back();
     int64_t rows = in.NumElements() / d;
     if (weights_.dim(0) != d || weights_.dim(1) != features_)
@@ -482,11 +549,13 @@ class TransformerFFN : public Unit {
     b1_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
     w2_ = npy::Load(ResolvePath(dir, spec.at("weights2").AsString()));
     b2_ = npy::Load(ResolvePath(dir, spec.at("bias2").AsString()));
-    hidden_ = spec.at("config").at("hidden").AsInt();
+    hidden_ = CheckDim(spec.at("config").at("hidden").AsInt(),
+                       name(), "hidden");
     residual_ = spec.at("config").at("residual").AsBool();
   }
 
   void Execute(const Tensor& in, Tensor* out) const override {
+    CheckNonEmpty(in, name());
     int64_t d = in.shape().back();
     int64_t rows = in.NumElements() / d;
     if (w1_.dim(0) != d || w1_.dim(1) != hidden_ ||
@@ -521,7 +590,7 @@ class MultiHeadAttention : public Unit {
     w_out_ = npy::Load(
         ResolvePath(dir, spec.at("weights_out").AsString()));
     const json::Value& cfg = spec.at("config");
-    heads_ = cfg.at("heads").AsInt();
+    heads_ = CheckDim(cfg.at("heads").AsInt(), name(), "heads");
     causal_ = cfg.at("causal").AsBool();
     residual_ = cfg.at("residual").AsBool();
     if (cfg.at("include_bias").AsBool()) {
@@ -536,6 +605,7 @@ class MultiHeadAttention : public Unit {
     if (in.rank() != 3)
       throw std::runtime_error(name() + ": attention input must be "
                                "(B, S, D), got " + in.ShapeString());
+    CheckNonEmpty(in, name());
     int64_t b = in.dim(0), s = in.dim(1), d = in.dim(2);
     int64_t dh = d / heads_;
     if (d % heads_)
@@ -543,6 +613,10 @@ class MultiHeadAttention : public Unit {
     if (w_qkv_.dim(0) != d || w_qkv_.dim(1) != 3 * d ||
         w_out_.dim(0) != d || w_out_.dim(1) != d)
       throw std::runtime_error(name() + ": weight shape mismatch");
+    if (has_bias_) {
+      CheckVecSize(b_qkv_, 3 * d, name(), "bias");
+      CheckVecSize(b_out_, d, name(), "bias_out");
+    }
     int64_t rows = b * s;
     std::vector<float> qkv(static_cast<size_t>(rows * 3 * d));
     Gemm(in.data(), w_qkv_.data(), qkv.data(), rows, d, 3 * d, false);

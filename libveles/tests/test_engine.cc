@@ -14,6 +14,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <dirent.h>
+#include <fstream>
+#include <sys/stat.h>
 #include <string>
 #include <vector>
 
@@ -107,6 +109,92 @@ void TestNpyRoundTrip(const std::string& tmpdir) {
   for (int i = 0; i < 6; ++i) CHECK_NEAR(u.data()[i], i * 1.5f, 1e-7);
 }
 
+void TestMalformedInputs(const std::string& tmpdir) {
+  // deep-nested json must throw, not blow the stack
+  bool threw = false;
+  try {
+    veles::json::Parse(std::string(100000, '[') +
+                       std::string(100000, ']'));
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+
+  // npy with an overflowing shape header must throw, not wrap
+  {
+    std::string path = tmpdir + "/huge.npy";
+    std::string header =
+        "{'descr': '<f4', 'fortran_order': False, "
+        "'shape': (4294967296, 4294967296), }\n";
+    std::ofstream f(path, std::ios::binary);
+    f.write("\x93NUMPY", 6);
+    char ver[2] = {1, 0};
+    f.write(ver, 2);
+    uint16_t len = static_cast<uint16_t>(header.size());
+    char lenb[2] = {static_cast<char>(len & 0xff),
+                    static_cast<char>(len >> 8)};
+    f.write(lenb, 2);
+    f.write(header.data(), header.size());
+    f.close();
+    threw = false;
+    try {
+      veles::npy::Load(path);
+    } catch (const std::exception&) {
+      threw = true;
+    }
+    CHECK(threw);
+  }
+
+  // big-endian dtype must be rejected, not byte-swapped silently
+  {
+    std::string path = tmpdir + "/be.npy";
+    std::string header =
+        "{'descr': '>f4', 'fortran_order': False, 'shape': (2,), }\n";
+    std::ofstream f(path, std::ios::binary);
+    f.write("\x93NUMPY", 6);
+    char ver[2] = {1, 0};
+    f.write(ver, 2);
+    uint16_t len = static_cast<uint16_t>(header.size());
+    char lenb[2] = {static_cast<char>(len & 0xff),
+                    static_cast<char>(len >> 8)};
+    f.write(lenb, 2);
+    f.write(header.data(), header.size());
+    float vals[2] = {1.0f, 2.0f};
+    f.write(reinterpret_cast<char*>(vals), 8);
+    f.close();
+    threw = false;
+    try {
+      veles::npy::Load(path);
+    } catch (const std::exception&) {
+      threw = true;
+    }
+    CHECK(threw);
+  }
+
+  // archive with a zero stride must raise a catchable error (config
+  // validation), never SIGFPE
+  {
+    std::string dir = tmpdir + "/badarch";
+    ::mkdir(dir.c_str(), 0755);
+    veles::Tensor w({3, 12});
+    veles::npy::Save(dir + "/w.npy", w);
+    std::ofstream f(dir + "/contents.json");
+    f << "{\"format\": 1, \"workflow\": \"bad\", \"units\": ["
+      << "{\"type\": \"conv\", \"name\": \"c\", \"weights\": \"w.npy\","
+      << " \"bias\": null, \"config\": {\"n_kernels\": 3, \"kx\": 2,"
+      << " \"ky\": 2, \"sliding\": [0, 1],"
+      << " \"padding\": [0, 0, 0, 0]}}]}";
+    f.close();
+    threw = false;
+    try {
+      veles::WorkflowLoader::Load(dir);
+    } catch (const std::exception&) {
+      threw = true;
+    }
+    CHECK(threw);
+  }
+}
+
 int RunFixture(const std::string& dir) {
   veles::Workflow wf = veles::WorkflowLoader::Load(dir);
   veles::Tensor in = veles::npy::Load(dir + "/input.npy");
@@ -150,6 +238,7 @@ int main(int argc, char** argv) {
   TestGemm();
   TestJson();
   TestNpyRoundTrip(tmpdir);
+  TestMalformedInputs(tmpdir);
   if (argc > 1) RunFixtures(argv[1]);
   if (g_failures) {
     std::fprintf(stderr, "%d FAILURES\n", g_failures);
