@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/test_engine.dir/tests/test_engine.cc.o"
+  "CMakeFiles/test_engine.dir/tests/test_engine.cc.o.d"
+  "test_engine"
+  "test_engine.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/test_engine.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

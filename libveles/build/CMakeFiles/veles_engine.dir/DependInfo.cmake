@@ -1,0 +1,23 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/libveles/src/json.cc" "CMakeFiles/veles_engine.dir/src/json.cc.o" "gcc" "CMakeFiles/veles_engine.dir/src/json.cc.o.d"
+  "/root/repo/libveles/src/matrix.cc" "CMakeFiles/veles_engine.dir/src/matrix.cc.o" "gcc" "CMakeFiles/veles_engine.dir/src/matrix.cc.o.d"
+  "/root/repo/libveles/src/npy.cc" "CMakeFiles/veles_engine.dir/src/npy.cc.o" "gcc" "CMakeFiles/veles_engine.dir/src/npy.cc.o.d"
+  "/root/repo/libveles/src/units.cc" "CMakeFiles/veles_engine.dir/src/units.cc.o" "gcc" "CMakeFiles/veles_engine.dir/src/units.cc.o.d"
+  "/root/repo/libveles/src/workflow.cc" "CMakeFiles/veles_engine.dir/src/workflow.cc.o" "gcc" "CMakeFiles/veles_engine.dir/src/workflow.cc.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

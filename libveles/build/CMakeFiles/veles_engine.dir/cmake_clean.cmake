@@ -1,0 +1,19 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/veles_engine.dir/src/json.cc.o"
+  "CMakeFiles/veles_engine.dir/src/json.cc.o.d"
+  "CMakeFiles/veles_engine.dir/src/matrix.cc.o"
+  "CMakeFiles/veles_engine.dir/src/matrix.cc.o.d"
+  "CMakeFiles/veles_engine.dir/src/npy.cc.o"
+  "CMakeFiles/veles_engine.dir/src/npy.cc.o.d"
+  "CMakeFiles/veles_engine.dir/src/units.cc.o"
+  "CMakeFiles/veles_engine.dir/src/units.cc.o.d"
+  "CMakeFiles/veles_engine.dir/src/workflow.cc.o"
+  "CMakeFiles/veles_engine.dir/src/workflow.cc.o.d"
+  "libveles_engine.a"
+  "libveles_engine.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/veles_engine.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,3 @@
+file(REMOVE_RECURSE
+  "libveles_engine.a"
+)

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/veles_infer.dir/src/veles_infer.cc.o"
+  "CMakeFiles/veles_infer.dir/src/veles_infer.cc.o.d"
+  "veles_infer"
+  "veles_infer.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/veles_infer.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

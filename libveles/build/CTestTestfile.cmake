@@ -1,0 +1,8 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/libveles
+# Build directory: /root/repo/libveles/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(engine "/root/repo/libveles/build/test_engine")
+set_tests_properties(engine PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/libveles/CMakeLists.txt;37;add_test;/root/repo/libveles/CMakeLists.txt;0;")

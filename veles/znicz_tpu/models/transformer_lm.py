@@ -24,8 +24,12 @@ root.lm.update({
                "seq_len": 32, "vocab": 16, "max_period": 6},
     # attn_block: single-chip flash-style blocked attention (exact;
     # O(S*block) score memory instead of O(S^2)); None = dense
+    # moe_experts > 0 swaps the dense FFN for a top-1-routed MoE FFN
+    # (ops/moe.py) with that many experts per layer; shard them over
+    # chips with root.lm.parallel.expert
     "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128,
-              "attn_block": None},
+              "attn_block": None, "moe_experts": 0,
+              "moe_capacity_factor": 2.0, "moe_aux_weight": 0.01},
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
               "weights_decay": 0.0},
     "decision": {"max_epochs": 8, "fail_iterations": 50},
@@ -34,7 +38,7 @@ root.lm.update({
     # shards the transformer matmuls Megatron-style via GSPMD; data
     # > 1 shards the batch. All from config alone — e.g.
     #   velescli ... root.lm.parallel.seq=8
-    "parallel": {"seq": 1, "model": 1, "data": 1},
+    "parallel": {"seq": 1, "model": 1, "data": 1, "expert": 1},
 })
 
 
@@ -76,6 +80,18 @@ def build_layers():
                "->": {"vocab_size": root.lm.loader.vocab,
                       "dim": m.dim},
                "<-": dict(t)}]
+    if m.get("moe_experts"):
+        ffn_layer = {
+            "type": "moe_ffn",
+            "->": {"experts": m.moe_experts, "hidden": m.ffn_hidden,
+                   "residual": True,
+                   "capacity_factor": m.get("moe_capacity_factor",
+                                            2.0)},
+            "<-": dict(t, aux_weight=m.get("moe_aux_weight", 0.01))}
+    else:
+        ffn_layer = {"type": "transformer_ffn",
+                     "->": {"hidden": m.ffn_hidden, "residual": True},
+                     "<-": dict(t)}
     for _ in range(m.layers):
         layers += [
             {"type": "attention",
@@ -84,9 +100,7 @@ def build_layers():
                     "attn_block_size": m.get("attn_block")},
              "<-": dict(t)},
             {"type": "layernorm", "<-": dict(t)},
-            {"type": "transformer_ffn",
-             "->": {"hidden": m.ffn_hidden, "residual": True},
-             "<-": dict(t)},
+            dict(ffn_layer),
             {"type": "layernorm", "<-": dict(t)},
         ]
     layers.append({"type": "token_dense",
@@ -123,7 +137,8 @@ class TransformerLMWorkflow(StandardWorkflow):
         seq = int(spec.get("seq", 1))
         model = int(spec.get("model", 1))
         data = int(spec.get("data", 1))
-        if max(seq, model, data) <= 1:
+        expert = int(spec.get("expert", 1))
+        if max(seq, model, data, expert) <= 1:
             return
         from veles.znicz_tpu import parallel
         # ONE composed mesh over every requested axis: all shardings
@@ -135,6 +150,8 @@ class TransformerLMWorkflow(StandardWorkflow):
             axes["seq"] = seq
         if model > 1:
             axes["model"] = model
+        if expert > 1:
+            axes["expert"] = expert
         mesh = parallel.make_mesh(axes)
         if seq > 1:
             parallel.setup_sequence_parallel(
@@ -144,6 +161,8 @@ class TransformerLMWorkflow(StandardWorkflow):
         if model > 1:
             # skips attention units already owned by the ring path
             parallel.setup_tensor_parallel(self, mesh, refresh=False)
+        if expert > 1:
+            parallel.setup_expert_parallel(self, mesh, refresh=False)
         self.xla_step.refresh_device()
 
 

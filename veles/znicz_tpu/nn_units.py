@@ -160,9 +160,29 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
     FORWARD = None
     STATE = ("vel_weights", "vel_bias", "acc_weights", "acc_bias",
              "acc_count", "iteration")
+    #: (param_name, bias_like) for forward parameters BEYOND
+    #: weights/bias (attention out-projection, FFN second layer, MoE
+    #: router...). Velocity/accumulator Arrays ``vel_<p>``/``acc_<p>``
+    #: are created automatically and appended to STATE by
+    #: ``__init_subclass__``. ``bias_like`` selects the bias
+    #: hyperparameter set (lr_bias, moment_bias, decay_bias) —
+    #: matching the repo-wide convention that biases are not decayed
+    #: by default.
+    EXTRA_PARAMS = ()
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        derived = [n for p, _ in cls.__dict__.get("EXTRA_PARAMS", ())
+                   for n in ("vel_" + p, "acc_" + p)]
+        if derived:
+            cls.STATE = tuple(cls.STATE) + tuple(
+                n for n in derived if n not in cls.STATE)
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
+        for pname, _ in self.EXTRA_PARAMS:
+            setattr(self, "vel_" + pname, Array())
+            setattr(self, "acc_" + pname, Array())
         self.err_output = None       # linked from the unit after us
         self.err_input = Array()     # produced for the unit before us
         self.forward = None          # paired Forward unit
@@ -243,6 +263,17 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
                 self.acc_count.reset(numpy.zeros((), numpy.int32))
         if not self.iteration:
             self.iteration.reset(numpy.zeros((), numpy.int32))
+        for pname, _ in self.EXTRA_PARAMS:
+            src = getattr(f, pname, None)
+            if src is None or not src:
+                continue
+            vel = getattr(self, "vel_" + pname)
+            if not vel or vel.shape != src.shape:
+                vel.reset(numpy.zeros_like(src.mem))
+            if self.accumulate_gradient > 1:
+                acc = getattr(self, "acc_" + pname)
+                if not acc or acc.shape != src.shape:
+                    acc.reset(numpy.zeros_like(src.mem))
 
     # hyper-parameters (traced scalars; changing them never retraces) --
 
@@ -395,6 +426,85 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             ctx.update_state(self, vel_bias=velb)
             if accb is not None:
                 ctx.update_state(self, acc_bias=accb)
+
+    # extra-parameter updates (EXTRA_PARAMS declarations) --------------
+
+    def _hyper_set(self, bias_like):
+        """(policy, moment, l2, l1_vs_l2) attribute picks for the
+        weight vs bias hyperparameter families."""
+        if bias_like:
+            return (self.lr_policy_bias, self.gradient_moment_bias,
+                    self.weights_decay_bias, self.l1_vs_l2_bias)
+        return (self.lr_policy, self.gradient_moment,
+                self.weights_decay, self.l1_vs_l2)
+
+    def update_extra_numpy(self, grads):
+        """Apply EXTRA_PARAMS updates with the same semantics as
+        ``update_weights_numpy`` — which MUST have run first this step
+        (it advances the iteration/accumulation counters; extras apply
+        in lockstep: ``acc_count == 0`` after the main update iff this
+        step applied). ``grads``: {param_name: grad or None}."""
+        f = self.forward
+        t = int(self.iteration.map_read().mem) - 1
+        accumulating = self.accumulate_gradient > 1
+        apply_now = (not accumulating
+                     or int(self.acc_count.map_read().mem) == 0)
+        for pname, bias_like in self.EXTRA_PARAMS:
+            grad = grads.get(pname)
+            if grad is None:
+                continue
+            policy, moment, l2, l1r = self._hyper_set(bias_like)
+            lr = self._scheduled_lr(
+                numpy, policy,
+                self.learning_rate_bias if bias_like
+                else self.learning_rate, t) * self.lr_scale
+            arr = getattr(f, pname)
+            vel = getattr(self, "vel_" + pname)
+            acc = getattr(self, "acc_" + pname) if accumulating \
+                else None
+            arr.map_write()
+            vel.map_write()
+            acc_mem = acc.map_write().mem if acc is not None else None
+            w, v, a = self._step_param(
+                numpy, arr.mem, vel.mem, acc_mem, grad, apply_now,
+                lr, moment, l2, l1r)
+            arr.mem[...] = w
+            vel.mem[...] = v
+            if a is not None:
+                acc.mem[...] = a
+
+    def update_extra_xla(self, ctx, grads):
+        """Traced twin of :meth:`update_extra_numpy`; call after
+        ``update_weights_xla`` in the same ``xla_run``."""
+        import jax.numpy as jnp
+        f = self.forward
+        h = ctx.hyper[self.name]
+        st = ctx.unit_state(self)
+        t = st["iteration"] - 1   # main update advanced it
+        accumulating = self.accumulate_gradient > 1
+        apply_now = True if not accumulating else st["acc_count"] == 0
+        for pname, bias_like in self.EXTRA_PARAMS:
+            grad = grads.get(pname)
+            if grad is None:
+                continue
+            policy, _, _, _ = self._hyper_set(bias_like)
+            suffix = "_bias" if bias_like else ""
+            lr = self._scheduled_lr(
+                jnp, policy, h["lr_bias" if bias_like else "lr"],
+                t) * h["lr_scale"]
+            moment = h["moment" + suffix]
+            l2 = h["l2" + suffix]
+            l1r = h["l1_vs_l2" + suffix]
+            w = ctx.unit_params(f)[pname]
+            vel = st["vel_" + pname]
+            acc = st.get("acc_" + pname) if accumulating else None
+            w, vel, acc = self._step_param(
+                jnp, w, vel, acc, ctx.pmean(grad).astype(w.dtype),
+                apply_now, lr, moment, l2, l1r)
+            ctx.update_params(f, **{pname: w})
+            ctx.update_state(self, **{"vel_" + pname: vel})
+            if acc is not None:
+                ctx.update_state(self, **{"acc_" + pname: acc})
 
     # IDistributable compat layer (SURVEY.md §2.2) ---------------------
 

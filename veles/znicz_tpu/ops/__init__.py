@@ -54,6 +54,9 @@ from veles.znicz_tpu.ops.attention import (  # noqa: F401
     TransformerFFN, GDTransformerFFN,
     MultiHeadAttention, GDMultiHeadAttention,
 )
+from veles.znicz_tpu.ops.moe import (  # noqa: F401
+    MoEFFN, GDMoEFFN,
+)
 from veles.znicz_tpu.ops.kohonen import (  # noqa: F401
     KohonenForward, KohonenTrainer,
 )

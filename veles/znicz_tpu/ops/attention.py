@@ -202,21 +202,7 @@ class TransformerFFN(Forward):
 
 @gradient_for(TransformerFFN)
 class GDTransformerFFN(GradientDescentBase):
-    STATE = GradientDescentBase.STATE + ("vel_weights2", "vel_bias2")
-
-    def __init__(self, workflow, **kwargs):
-        super().__init__(workflow, **kwargs)
-        self.vel_weights2 = Array()
-        self.vel_bias2 = Array()
-
-    def initialize(self, **kwargs):
-        super().initialize(**kwargs)
-        f = self.forward
-        if f.weights2 and (not self.vel_weights2
-                           or self.vel_weights2.shape
-                           != f.weights2.shape):
-            self.vel_weights2.reset(numpy.zeros_like(f.weights2.mem))
-            self.vel_bias2.reset(numpy.zeros_like(f.bias2.mem))
+    EXTRA_PARAMS = (("weights2", False), ("bias2", True))
 
     def _backward(self, xp, x, w1, w2, hcur, err):
         f = self.forward
@@ -244,25 +230,7 @@ class GDTransformerFFN(GradientDescentBase):
             self.err_input.map_invalidate()
             self.err_input.mem[...] = dx
         self.update_weights_numpy(gw1, gb1)
-        t = int(self.iteration.map_read().mem) - 1
-        self._np_update(f.weights2, self.vel_weights2, gw2,
-                        self._scheduled_lr(numpy, self.lr_policy,
-                                           self.learning_rate, t)
-                        * self.lr_scale,
-                        self.gradient_moment,
-                        self.weights_decay, self.l1_vs_l2)
-        self._np_update(f.bias2, self.vel_bias2, gb2,
-                        self._scheduled_lr(numpy, self.lr_policy_bias,
-                                           self.learning_rate_bias, t)
-                        * self.lr_scale,
-                        self.gradient_moment_bias,
-                        self.weights_decay_bias, self.l1_vs_l2_bias)
-
-    def _np_update(self, arr, vel, grad, lr, moment, l2, l1r):
-        arr.map_write()
-        vel.map_write()
-        arr.mem[...], vel.mem[...] = self.apply_update(
-            numpy, arr.mem, vel.mem, grad, lr, moment, l2, l1r)
+        self.update_extra_numpy({"weights2": gw2, "bias2": gb2})
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
@@ -276,25 +244,7 @@ class GDTransformerFFN(GradientDescentBase):
         if self.need_err_input:
             ctx.set(self, "err_input", dx.astype(jnp.float32))
         self.update_weights_xla(ctx, gw1, gb1)
-        h = ctx.hyper[self.name]
-        st = ctx.unit_state(self)
-        # update_weights_xla already advanced the schedule counter
-        t = st["iteration"] - 1
-        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t) \
-            * h["lr_scale"]
-        lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
-                                  h["lr_bias"], t) * h["lr_scale"]
-        w2, vel2 = p["weights2"], st["vel_weights2"]
-        w2, vel2 = self.apply_update(
-            jnp, w2, vel2, ctx.pmean(gw2).astype(w2.dtype), lr_w,
-            h["moment"], h["l2"], h["l1_vs_l2"])
-        b2, velb2 = p["bias2"], st["vel_bias2"]
-        b2, velb2 = self.apply_update(
-            jnp, b2, velb2, ctx.pmean(gb2).astype(b2.dtype),
-            lr_b, h["moment_bias"], h["l2_bias"],
-            h["l1_vs_l2_bias"])
-        ctx.update_params(f, weights2=w2, bias2=b2)
-        ctx.update_state(self, vel_weights2=vel2, vel_bias2=velb2)
+        self.update_extra_xla(ctx, {"weights2": gw2, "bias2": gb2})
 
 
 # ---------------------------------------------------------------------------
@@ -466,24 +416,7 @@ class MultiHeadAttention(Forward):
 class GDMultiHeadAttention(GradientDescentBase):
     """Hand-written attention backward (verified vs jax.grad)."""
 
-    STATE = GradientDescentBase.STATE + (
-        "vel_weights_out", "vel_bias_out")
-
-    def __init__(self, workflow, **kwargs):
-        super().__init__(workflow, **kwargs)
-        self.vel_weights_out = Array()
-        self.vel_bias_out = Array()
-
-    def initialize(self, **kwargs):
-        super().initialize(**kwargs)
-        f = self.forward
-        if f.weights_out and (
-                not self.vel_weights_out
-                or self.vel_weights_out.shape != f.weights_out.shape):
-            self.vel_weights_out.reset(
-                numpy.zeros_like(f.weights_out.mem))
-        if f.include_bias and f.bias_out and not self.vel_bias_out:
-            self.vel_bias_out.reset(numpy.zeros_like(f.bias_out.mem))
+    EXTRA_PARAMS = (("weights_out", False), ("bias_out", True))
 
     def _bwd_core(self, xp, x, w, wo, cache, err):
         f = self.forward
@@ -524,27 +457,9 @@ class GDMultiHeadAttention(GradientDescentBase):
             self.err_input.map_invalidate()
             self.err_input.mem[...] = dx
         self.update_weights_numpy(gw, gb if f.include_bias else None)
-        t = int(self.iteration.map_read().mem) - 1
-        self._np_update(f.weights_out, self.vel_weights_out, gwo,
-                        self._scheduled_lr(numpy, self.lr_policy,
-                                           self.learning_rate, t)
-                        * self.lr_scale,
-                        self.gradient_moment,
-                        self.weights_decay, self.l1_vs_l2)
-        if f.include_bias:
-            self._np_update(f.bias_out, self.vel_bias_out, gbo,
-                            self._scheduled_lr(
-                                numpy, self.lr_policy_bias,
-                                self.learning_rate_bias, t)
-                            * self.lr_scale,
-                            self.gradient_moment_bias,
-                            self.weights_decay_bias, self.l1_vs_l2_bias)
-
-    def _np_update(self, arr, vel, grad, lr, moment, l2, l1r):
-        arr.map_write()
-        vel.map_write()
-        arr.mem[...], vel.mem[...] = self.apply_update(
-            numpy, arr.mem, vel.mem, grad, lr, moment, l2, l1r)
+        self.update_extra_numpy({
+            "weights_out": gwo,
+            "bias_out": gbo if f.include_bias else None})
 
     def _bwd_outer(self, xp, x, p, ctx, err, attn_bwd):
         """Shared backward scaffolding for the cached (out_heads, lse)
@@ -610,25 +525,6 @@ class GDMultiHeadAttention(GradientDescentBase):
         if self.need_err_input:
             ctx.set(self, "err_input", dx.astype(jnp.float32))
         self.update_weights_xla(ctx, gw, gb if f.include_bias else None)
-        h = ctx.hyper[self.name]
-        st = ctx.unit_state(self)
-        # update_weights_xla already advanced the schedule counter
-        t = st["iteration"] - 1
-        lr_w = self._scheduled_lr(jnp, self.lr_policy, h["lr"], t) \
-            * h["lr_scale"]
-        lr_b = self._scheduled_lr(jnp, self.lr_policy_bias,
-                                  h["lr_bias"], t) * h["lr_scale"]
-        w_o, vel = p["weights_out"], st["vel_weights_out"]
-        w_o, vel = self.apply_update(
-            jnp, w_o, vel, ctx.pmean(gwo).astype(w_o.dtype), lr_w,
-            h["moment"], h["l2"], h["l1_vs_l2"])
-        ctx.update_params(f, weights_out=w_o)
-        ctx.update_state(self, vel_weights_out=vel)
-        if f.include_bias:
-            b_o, velb = p["bias_out"], st["vel_bias_out"]
-            b_o, velb = self.apply_update(
-                jnp, b_o, velb, ctx.pmean(gbo).astype(b_o.dtype),
-                lr_b, h["moment_bias"], h["l2_bias"],
-                h["l1_vs_l2_bias"])
-            ctx.update_params(f, bias_out=b_o)
-            ctx.update_state(self, vel_bias_out=velb)
+        self.update_extra_xla(ctx, {
+            "weights_out": gwo,
+            "bias_out": gbo if f.include_bias else None})

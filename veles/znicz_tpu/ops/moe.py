@@ -1,0 +1,261 @@
+"""Mixture-of-Experts FFN unit pair (NEW — no reference counterpart).
+
+The reference has no MoE and no parallelism beyond async DP
+(SURVEY.md §2.2 "TP / PP / SP / EP ... ABSENT in the reference");
+expert parallelism is part of this rebuild's first-class distributed
+story. The design is the TPU-native GShard/Switch formulation: top-1
+("switch") routing with a fixed per-expert capacity, dispatch/combine
+expressed as dense one-hot einsums so the whole layer is static-shaped
+and jit-compilable — no gather/scatter, no data-dependent shapes. With
+the expert dimension of the parameters sharded over an ``expert`` mesh
+axis (:func:`veles.znicz_tpu.parallel.setup_expert_parallel`), XLA's
+partitioner turns the dispatch einsum into the canonical ``all_to_all``
+token exchange over ICI.
+
+Semantics (Switch Transformer, Fedus et al. 2021 — formulation only):
+
+* router logits ``x·R`` → softmax probs; each token goes to its top-1
+  expert with gate weight ``p_max``;
+* each expert processes at most ``C = ceil(capacity_factor·T/E)``
+  tokens; overflow tokens bypass the experts (residual passes them
+  through unchanged — exactly the Switch "dropped token" rule);
+* an optional load-balancing auxiliary loss ``aux_weight·E·Σ_e f_e·P_e``
+  (f = fraction of tokens routed to e, P = mean router prob) is applied
+  analytically inside the backward unit — consistent with the explicit
+  forward/backward graph design (no autodiff; ``jax.grad`` stays a test
+  oracle, with the aux term added to the oracle loss in tests).
+
+Like every znicz-style op this is a Forward/GD pair sharing one
+formula set between the numpy oracle and the traced path.
+"""
+
+import numpy
+
+from veles.memory import Array
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+from veles.znicz_tpu.ops import activations as A
+
+
+def _one_hot(xp, idx, n):
+    return (xp.arange(n) == idx[..., None]).astype(numpy.float32)
+
+
+@forward_unit("moe_ffn")
+class MoEFFN(Forward):
+    """y = [x +] combine · expert_ffn(dispatch · x), top-1 routed.
+
+    Parameters: ``router`` (D, E); stacked expert mats ``weights``
+    (E, D, H), ``bias`` (E, H), ``weights2`` (E, H, D), ``bias2``
+    (E, D). Output shape == input shape (B, S, D).
+    """
+
+    PARAMS = ("weights", "bias", "weights2", "bias2", "router")
+    ACTIVATION = "strict_relu"
+
+    def __init__(self, workflow, experts=None, hidden=None,
+                 residual=True, capacity_factor=2.0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if not experts or int(experts) < 2:
+            raise ValueError("moe_ffn needs experts >= 2")
+        self.experts = int(experts)
+        self.hidden = hidden
+        self.residual = residual
+        self.capacity_factor = float(capacity_factor)
+        self.router = Array()
+        self.weights2 = Array()
+        self.bias2 = Array()
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def capacity(self, n_tokens):
+        """Static per-expert token capacity for a given token count."""
+        return max(1, int(numpy.ceil(
+            self.capacity_factor * n_tokens / self.experts)))
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        d = self.input.shape[-1]
+        e = self.experts
+        h = self.hidden or 4 * d
+        self.hidden = h
+
+        def fill(arr, shape, fan_in, fan_out):
+            if arr and arr.shape == shape:
+                return
+            arr.reset(numpy.zeros(shape, numpy.float32))
+            self.fill_array(arr, self.weights_filling,
+                            self.weights_stddev
+                            or self.default_weights_stddev(
+                                fan_in, fan_out))
+        fill(self.router, (d, e), d, e)
+        fill(self.weights, (e, d, h), d, h)
+        fill(self.weights2, (e, h, d), h, d)
+        if not self.bias or self.bias.shape != (e, h):
+            self.bias.reset(numpy.zeros((e, h), numpy.float32))
+        if not self.bias2 or self.bias2.shape != (e, d):
+            self.bias2.reset(numpy.zeros((e, d), numpy.float32))
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    # shared formula set ----------------------------------------------
+
+    def _route(self, xp, xt, router):
+        """(probs, onehot_e, gate, dispatch) for flat tokens (T, D).
+
+        ``dispatch`` (T, E, C) is the one-hot token→(expert, slot)
+        assignment; the slot index is the token's rank among the
+        tokens routed to the same expert (cumsum trick), and ranks
+        beyond capacity zero out (dropped tokens).
+        """
+        n_tokens = xt.shape[0]
+        cap = self.capacity(n_tokens)
+        logits = xt @ router
+        probs = A.softmax(xp, logits)
+        eidx = xp.argmax(logits, axis=-1)
+        onehot_e = _one_hot(xp, eidx, self.experts)       # (T, E)
+        gate = (probs * onehot_e).sum(axis=-1)            # (T,)
+        # rank of each token within its expert queue
+        pos = (xp.cumsum(onehot_e, axis=0) - 1.0)         # (T, E)
+        pos_t = (pos * onehot_e).sum(axis=-1)             # (T,)
+        keep = (pos_t < cap).astype(numpy.float32)
+        slot = _one_hot(xp, pos_t.astype(numpy.int32), cap)
+        dispatch = (onehot_e[:, :, None] * slot[:, None, :]
+                    * keep[:, None, None])                # (T, E, C)
+        return probs, onehot_e, gate, dispatch
+
+    def _experts_fwd(self, xp, xe, w1, b1, w2, b2):
+        """Batched expert FFN over (E, C, D) slot buffers."""
+        h = A.ACTIVATIONS[self.ACTIVATION][0](
+            xp, xp.einsum("ecd,edh->ech", xe, w1) + b1[:, None, :])
+        ye = xp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        return h, ye
+
+    def _forward(self, xp, x, p):
+        xt = x.reshape(-1, x.shape[-1])
+        probs, onehot_e, gate, dispatch = self._route(
+            xp, xt, p["router"])
+        xe = xp.einsum("tec,td->ecd", dispatch, xt)
+        h, ye = self._experts_fwd(xp, xe, p["weights"], p["bias"],
+                                  p["weights2"], p["bias2"])
+        combine = dispatch * gate[:, None, None]
+        yt = xp.einsum("tec,ecd->td", combine, ye)
+        y = yt.reshape(x.shape)
+        if self.residual:
+            y = y + x
+        cache = {"probs": probs, "onehot_e": onehot_e, "gate": gate,
+                 "dispatch": dispatch, "xe": xe, "h": h, "ye": ye}
+        return y, cache
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        p = {name: getattr(self, name).map_read().mem
+             for name in self.PARAMS}
+        y, cache = self._forward(numpy, x, p)
+        self.output.map_invalidate()
+        self.output.mem[...] = y
+        self._cache = cache
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        y, cache = self._forward(jnp, x, ctx.unit_params(self))
+        ctx.set(self, "output", y.astype(jnp.float32))
+        for k, v in cache.items():
+            ctx.set(self, "cache_" + k, v)
+
+
+@gradient_for(MoEFFN)
+class GDMoEFFN(GradientDescentBase):
+    """Hand-written backward: expert FFN grads batched over E, router
+    grad through the softmax gate (+ analytic Switch load-balancing
+    term), straight-through on the discrete assignment."""
+
+    EXTRA_PARAMS = (("weights2", False), ("bias2", True),
+                    ("router", False))
+
+    def __init__(self, workflow, aux_weight=0.0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.aux_weight = float(aux_weight)
+
+    def hyperparams(self):
+        out = super().hyperparams()
+        out["aux_weight"] = numpy.float32(self.aux_weight)
+        return out
+
+    def _backward(self, xp, x, p, cache, err, aux_weight):
+        f = self.forward
+        d = x.shape[-1]
+        xt = x.reshape(-1, d)
+        dyt = err.reshape(-1, d)
+        dispatch, gate = cache["dispatch"], cache["gate"]
+        probs, onehot_e = cache["probs"], cache["onehot_e"]
+        xe, h, ye = cache["xe"], cache["h"], cache["ye"]
+        combine = dispatch * gate[:, None, None]
+        # combine path
+        dye = xp.einsum("tec,td->ecd", combine, dyt)
+        ysel = xp.einsum("tec,ecd->td", dispatch, ye)
+        dgate = (ysel * dyt).sum(axis=-1)                 # (T,)
+        # expert FFN backward (batched over E)
+        w1, w2 = p["weights"], p["weights2"]
+        dh = xp.einsum("ecd,ehd->ech", dye, w2)
+        dh = dh * A.ACTIVATIONS[f.ACTIVATION][1](xp, h)
+        gw2 = xp.einsum("ech,ecd->ehd", h, dye)
+        gb2 = dye.sum(axis=1)
+        gw1 = xp.einsum("ecd,ech->edh", xe, dh)
+        gb1 = dh.sum(axis=1)
+        dxe = xp.einsum("ech,edh->ecd", dh, w1)
+        # dispatch path back to tokens
+        dxt = xp.einsum("tec,ecd->td", dispatch, dxe)
+        # router: gate = probs at the argmax (differentiable through
+        # softmax; assignment itself is straight-through)
+        dprobs = onehot_e * dgate[:, None]
+        # d/dprobs of aux = aux_w·E·Σ_e f_e·mean_t(probs[:,e]):
+        # f is a routing frequency, constant under the gradient
+        n_tokens = onehot_e.shape[0]
+        freq = onehot_e.mean(axis=0)                      # (E,)
+        dprobs = dprobs + (aux_weight * f.experts / n_tokens) \
+            * freq[None, :]
+        dlogits = probs * (dprobs
+                           - (dprobs * probs).sum(-1, keepdims=True))
+        grouter = xt.T @ dlogits
+        dxt = dxt + dlogits @ p["router"].T
+        dx = dxt.reshape(x.shape)
+        if f.residual:
+            dx = dx + err
+        return dx, {"weights": gw1, "bias": gb1, "weights2": gw2,
+                    "bias2": gb2, "router": grouter}
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(x.shape)
+        p = {name: getattr(f, name).map_read().mem
+             for name in f.PARAMS}
+        dx, grads = self._backward(numpy, x, p, f._cache, err,
+                                   self.aux_weight)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = dx
+        self.update_weights_numpy(grads["weights"], grads["bias"])
+        self.update_extra_numpy(grads)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        err = ctx.get(self, "err_output").reshape(x.shape)
+        p = ctx.unit_params(f)
+        cache = {k: ctx.get(f, "cache_" + k)
+                 for k in ("probs", "onehot_e", "gate", "dispatch",
+                           "xe", "h", "ye")}
+        h = ctx.hyper[self.name]
+        dx, grads = self._backward(jnp, x, p, cache, err,
+                                   h["aux_weight"])
+        if self.need_err_input:
+            ctx.set(self, "err_input", dx.astype(jnp.float32))
+        self.update_weights_xla(ctx, grads["weights"], grads["bias"])
+        self.update_extra_xla(ctx, grads)

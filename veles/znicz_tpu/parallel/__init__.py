@@ -9,9 +9,11 @@ contraction over the sharded batch axis becomes an all-reduce — the
 compiled analogue of ``apply_data_from_slave`` weight averaging, but
 synchronous, SURVEY.md §3.3 note). Axis conventions:
 
-* ``data``  — batch / data parallelism (DP)
-* ``model`` — tensor parallelism (TP) for the Transformer units
-* ``seq``   — sequence/context parallelism (ring attention)
+* ``data``   — batch / data parallelism (DP)
+* ``model``  — tensor parallelism (TP) for the Transformer units
+* ``seq``    — sequence/context parallelism (ring attention)
+* ``expert`` — expert parallelism (EP) for MoE units
+* ``pipe``   — pipeline parallelism (PP) for the block-stack unit
 
 Multi-host: `jax.distributed.initialize` + the same mesh spanning all
 processes; DCN handles the inter-slice hops. See ``veles/server.py``
@@ -134,6 +136,60 @@ def setup_sequence_parallel(workflow, mesh, axis="seq",
     return mesh
 
 
+def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True):
+    """Expert parallelism for MoE units, the GSPMD way: the leading
+    (expert) dim of every stacked expert parameter — and its momentum
+    state — is sharded over ``axis``, so each device holds E/n experts.
+    The dispatch/combine einsums (``ops/moe.py``) then contract a
+    replicated token tensor against expert-sharded buffers, and XLA's
+    partitioner materialises the canonical ``all_to_all`` token
+    exchange over ICI. The router stays replicated (every device
+    routes every token — the (D,E) matmul is negligible)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from veles.znicz_tpu.ops.moe import MoEFFN
+    step = workflow.xla_step
+    if step is None:
+        raise ValueError("workflow has no xla_step (numpy backend?)")
+    n = mesh.shape[axis]
+    smap = {}
+    touched = 0
+    for i, fwd in enumerate(workflow.forwards):
+        if not isinstance(fwd, MoEFFN):
+            continue
+        if fwd.experts % n:
+            raise ValueError(
+                "%s: %s axis size %d does not divide expert count %d"
+                % (fwd.name, axis, n, fwd.experts))
+        gd = workflow.gds[i] if i < len(workflow.gds) else None
+        for key in ("weights", "bias", "weights2", "bias2"):
+            sh = NamedSharding(
+                mesh, P(*((axis,) + (None,) *
+                          (getattr(fwd, key).mem.ndim - 1))))
+            smap[(fwd.name, key)] = sh
+            if gd is not None:
+                # momentum AND accumulation state shard like the param
+                smap[(gd.name, "vel_" + key)] = sh
+                smap[(gd.name, "acc_" + key)] = sh
+        rep = NamedSharding(mesh, P())
+        smap[(fwd.name, "router")] = rep
+        if gd is not None:
+            smap[(gd.name, "vel_router")] = rep
+            smap[(gd.name, "acc_router")] = rep
+        touched += 1
+    if not touched:
+        raise ValueError("no MoE units to expert-parallelize")
+    step.sync_host()
+    step.param_sharding_map.update(smap)
+    if step.param_sharding is None:
+        step.param_sharding = replicated(mesh)
+    if step.batch_sharding is None:
+        step.batch_sharding = replicated(mesh)
+    workflow.device.mesh = mesh
+    if refresh:
+        step.refresh_device()
+    return mesh
+
+
 def setup_tensor_parallel(workflow, mesh, axis="model", refresh=True):
     """Megatron-style TP for the transformer units, the GSPMD way: no
     hand-written collectives — the qkv/up projections are
@@ -161,7 +217,9 @@ def setup_tensor_parallel(workflow, mesh, axis="model", refresh=True):
         def put(key, sh, vel_key=None):
             smap[(fwd.name, key)] = sh
             if gd is not None and vel_key:
+                # momentum AND accumulation state shard like the param
                 smap[(gd.name, vel_key)] = sh
+                smap[(gd.name, vel_key.replace("vel_", "acc_"))] = sh
         if isinstance(fwd, MultiHeadAttention):
             if (fwd.heads % n) or fwd.seq_mesh is not None:
                 continue   # head split impossible / ring owns attention
