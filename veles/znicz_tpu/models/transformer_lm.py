@@ -26,10 +26,14 @@ root.lm.update({
     # O(S*block) score memory instead of O(S^2)); None = dense
     # moe_experts > 0 swaps the dense FFN for a top-1-routed MoE FFN
     # (ops/moe.py) with that many experts per layer; shard them over
-    # chips with root.lm.parallel.expert
+    # chips with root.lm.parallel.expert. stacked=True fuses the block
+    # stack into ONE transformer_stack unit (lax.scan over layers —
+    # flat compile time in depth, and the vehicle for pipeline
+    # parallelism via root.lm.parallel.pipe).
     "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128,
               "attn_block": None, "moe_experts": 0,
-              "moe_capacity_factor": 2.0, "moe_aux_weight": 0.01},
+              "moe_capacity_factor": 2.0, "moe_aux_weight": 0.01,
+              "stacked": False},
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
               "weights_decay": 0.0},
     "decision": {"max_epochs": 8, "fail_iterations": 50},
@@ -38,7 +42,8 @@ root.lm.update({
     # shards the transformer matmuls Megatron-style via GSPMD; data
     # > 1 shards the batch. All from config alone — e.g.
     #   velescli ... root.lm.parallel.seq=8
-    "parallel": {"seq": 1, "model": 1, "data": 1, "expert": 1},
+    "parallel": {"seq": 1, "model": 1, "data": 1, "expert": 1,
+                 "pipe": 1, "microbatches": 4},
 })
 
 
@@ -80,6 +85,27 @@ def build_layers():
                "->": {"vocab_size": root.lm.loader.vocab,
                       "dim": m.dim},
                "<-": dict(t)}]
+    if m.get("stacked"):
+        if m.get("moe_experts"):
+            raise ValueError(
+                "stacked=True builds dense-FFN blocks; it cannot "
+                "honour moe_experts=%r (use the per-layer model for "
+                "MoE)" % m.moe_experts)
+        if m.get("attn_block"):
+            raise ValueError(
+                "stacked=True uses dense attention inside the block "
+                "scan; attn_block=%r is not supported there (use the "
+                "per-layer model for flash-blocked attention)"
+                % m.attn_block)
+        layers += [
+            {"type": "transformer_stack",
+             "->": {"layers": m.layers, "heads": m.heads,
+                    "hidden": m.ffn_hidden, "causal": True},
+             "<-": dict(t)},
+            {"type": "token_dense",
+             "->": {"output_features": root.lm.loader.vocab},
+             "<-": dict(t)}]
+        return layers
     if m.get("moe_experts"):
         ffn_layer = {
             "type": "moe_ffn",
@@ -138,7 +164,8 @@ class TransformerLMWorkflow(StandardWorkflow):
         model = int(spec.get("model", 1))
         data = int(spec.get("data", 1))
         expert = int(spec.get("expert", 1))
-        if max(seq, model, data, expert) <= 1:
+        pipe = int(spec.get("pipe", 1))
+        if max(seq, model, data, expert, pipe) <= 1:
             return
         from veles.znicz_tpu import parallel
         # ONE composed mesh over every requested axis: all shardings
@@ -152,6 +179,8 @@ class TransformerLMWorkflow(StandardWorkflow):
             axes["model"] = model
         if expert > 1:
             axes["expert"] = expert
+        if pipe > 1:
+            axes["pipe"] = pipe
         mesh = parallel.make_mesh(axes)
         if seq > 1:
             parallel.setup_sequence_parallel(
@@ -163,6 +192,12 @@ class TransformerLMWorkflow(StandardWorkflow):
             parallel.setup_tensor_parallel(self, mesh, refresh=False)
         if expert > 1:
             parallel.setup_expert_parallel(self, mesh, refresh=False)
+        if pipe > 1:
+            parallel.setup_pipeline_parallel(
+                self, mesh,
+                microbatches=int(spec.get("microbatches", 4)),
+                batch_axis="data" if data > 1 else None,
+                refresh=False)
         self.xla_step.refresh_device()
 
 

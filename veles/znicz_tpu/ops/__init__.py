@@ -57,6 +57,9 @@ from veles.znicz_tpu.ops.attention import (  # noqa: F401
 from veles.znicz_tpu.ops.moe import (  # noqa: F401
     MoEFFN, GDMoEFFN,
 )
+from veles.znicz_tpu.ops.transformer_stack import (  # noqa: F401
+    TransformerBlockStack, GDTransformerBlockStack,
+)
 from veles.znicz_tpu.ops.kohonen import (  # noqa: F401
     KohonenForward, KohonenTrainer,
 )

@@ -1,0 +1,186 @@
+"""Stacked transformer-block unit pair (NEW — no reference
+counterpart; the PP vehicle).
+
+One unit owning ``layers`` identical post-LN transformer blocks
+(MHA+residual → LN → FFN+residual → LN — the same block the per-unit
+LM builds from attention/layernorm/transformer_ffn units) with every
+parameter STACKED along a leading layer dimension. Why a fused stack
+instead of per-layer units:
+
+* the traced path runs the whole depth as ONE ``lax.scan`` over the
+  layer dim — compile time stays flat in depth (SURVEY.md §7 "XLA
+  semantics": compiler-friendly control flow);
+* the stacked layer dimension is exactly what pipeline parallelism
+  shards: ``parallel.setup_pipeline_parallel`` puts ``L/P``
+  consecutive blocks on each ``pipe``-axis stage and the unit routes
+  through the GPipe schedule (``parallel/pipeline.py``) — microbatch
+  activations stream stage-to-stage over ``ppermute`` while weights
+  never move.
+
+Math (forward AND hand-written backward) lives in
+``parallel/pipeline.py`` and is shared verbatim between the numpy
+oracle (python loop), the scan path, and the pipelined path.
+Attention inside the stack is the dense formulation (the single-unit
+``MultiHeadAttention`` owns the flash/ring long-context modes).
+"""
+
+import numpy
+
+from veles.znicz_tpu.nn_units import (
+    Forward, GradientDescentBase, forward_unit, gradient_for)
+from veles.znicz_tpu.parallel import pipeline as PL
+
+
+@forward_unit("transformer_stack")
+class TransformerBlockStack(Forward):
+    """N identical transformer blocks with stacked (L, ...) params."""
+
+    PARAMS = ("weights", "bias", "weights_out", "bias_out",
+              "ln1_g", "ln1_b", "ffn_w1", "ffn_b1", "ffn_w2",
+              "ffn_b2", "ln2_g", "ln2_b")
+
+    def __init__(self, workflow, layers=None, heads=4, hidden=None,
+                 causal=True, eps=1e-5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if not layers:
+            raise ValueError("transformer_stack needs layers >= 1")
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.hidden = hidden
+        self.causal = causal
+        self.eps = float(eps)
+        from veles.memory import Array
+        for name in self.PARAMS[2:]:
+            setattr(self, name, Array())
+        #: set by parallel.setup_pipeline_parallel: a Mesh with a
+        #: 'pipe' axis routes fwd/bwd through the GPipe schedule
+        self.pipe_mesh = None
+        self.pipe_axis = "pipe"
+        self.pipe_batch_axis = None
+        self.pipe_microbatches = 4
+
+    def output_shape_for(self, ishape):
+        return tuple(ishape)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        b, s, d = self.input.shape
+        if d % self.heads:
+            raise ValueError("dim %d not divisible by %d heads"
+                             % (d, self.heads))
+        n, h = self.layers, self.hidden or 4 * d
+        self.hidden = h
+
+        def fillmat(arr, shape, fan_in, fan_out):
+            if arr and arr.shape == shape:
+                return
+            arr.reset(numpy.zeros(shape, numpy.float32))
+            self.fill_array(arr, self.weights_filling,
+                            self.weights_stddev
+                            or self.default_weights_stddev(
+                                fan_in, fan_out))
+
+        def zeros(arr, shape):
+            if not arr or arr.shape != shape:
+                arr.reset(numpy.zeros(shape, numpy.float32))
+
+        def ones(arr, shape):
+            if not arr or arr.shape != shape:
+                arr.reset(numpy.ones(shape, numpy.float32))
+
+        fillmat(self.weights, (n, d, 3 * d), d, 3 * d)
+        zeros(self.bias, (n, 3 * d))
+        fillmat(self.weights_out, (n, d, d), d, d)
+        zeros(self.bias_out, (n, d))
+        ones(self.ln1_g, (n, d))
+        zeros(self.ln1_b, (n, d))
+        fillmat(self.ffn_w1, (n, d, h), d, h)
+        zeros(self.ffn_b1, (n, h))
+        fillmat(self.ffn_w2, (n, h, d), h, d)
+        zeros(self.ffn_b2, (n, d))
+        ones(self.ln2_g, (n, d))
+        zeros(self.ln2_b, (n, d))
+        if not self.output or self.output.shape != self.input.shape:
+            self.output.reset(
+                numpy.zeros(self.input.shape, numpy.float32))
+
+    def _layer_params(self, p, i):
+        return {k: p[k][i] for k in self.PARAMS}
+
+    def numpy_run(self):
+        x = self.input.map_read().mem.astype(numpy.float32)
+        p = {k: getattr(self, k).map_read().mem for k in self.PARAMS}
+        caches = []
+        for i in range(self.layers):
+            x, cache = PL.block_fwd(numpy, x, self._layer_params(p, i),
+                                    self.heads, self.causal, self.eps)
+            caches.append(cache)
+        self.output.map_invalidate()
+        self.output.mem[...] = x
+        self._cache = caches
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        x = ctx.get(self, "input")
+        p = ctx.unit_params(self)
+        if self.pipe_mesh is not None:
+            y, caches = PL.pipeline_fwd(
+                p, x, self.pipe_mesh, axis=self.pipe_axis,
+                batch_axis=self.pipe_batch_axis,
+                n_micro=self.pipe_microbatches, heads=self.heads,
+                causal=self.causal, eps=self.eps)
+        else:
+            y, caches = PL.stack_fwd(p, x, self.heads, self.causal,
+                                     self.eps)
+        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "cache_stack", caches)
+
+
+@gradient_for(TransformerBlockStack)
+class GDTransformerBlockStack(GradientDescentBase):
+    """Reverse scan (or reverse GPipe schedule) over the stashed
+    per-layer activations; gradients verified vs jax.grad in tests."""
+
+    EXTRA_PARAMS = (("weights_out", False), ("bias_out", True),
+                    ("ln1_g", False), ("ln1_b", True),
+                    ("ffn_w1", False), ("ffn_b1", True),
+                    ("ffn_w2", False), ("ffn_b2", True),
+                    ("ln2_g", False), ("ln2_b", True))
+
+    def numpy_run(self):
+        f = self.forward
+        x = f.input.map_read().mem.astype(numpy.float32)
+        err = numpy.asarray(self.err_output.map_read().mem,
+                            numpy.float32).reshape(x.shape)
+        p = {k: getattr(f, k).map_read().mem for k in f.PARAMS}
+        grads = {k: numpy.zeros_like(v) for k, v in p.items()}
+        d = err
+        for i in reversed(range(f.layers)):
+            d, g = PL.block_bwd(numpy, f._layer_params(p, i),
+                                f._cache[i], d, f.heads, f.eps)
+            for k, v in g.items():
+                grads[k][i] = v
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = d
+        self.update_weights_numpy(grads["weights"], grads["bias"])
+        self.update_extra_numpy(grads)
+
+    def xla_run(self, ctx):
+        import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input")
+        err = ctx.get(self, "err_output").reshape(x.shape)
+        p = ctx.unit_params(f)
+        caches = ctx.get(f, "cache_stack")
+        if f.pipe_mesh is not None:
+            dx, grads = PL.pipeline_bwd(
+                p, caches, err, f.pipe_mesh, axis=f.pipe_axis,
+                batch_axis=f.pipe_batch_axis,
+                n_micro=f.pipe_microbatches, heads=f.heads, eps=f.eps)
+        else:
+            dx, grads = PL.stack_bwd(p, caches, err, f.heads, f.eps)
+        if self.need_err_input:
+            ctx.set(self, "err_input", dx.astype(jnp.float32))
+        self.update_weights_xla(ctx, grads["weights"], grads["bias"])
+        self.update_extra_xla(ctx, grads)

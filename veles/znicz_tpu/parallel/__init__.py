@@ -190,6 +190,66 @@ def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True):
     return mesh
 
 
+def setup_pipeline_parallel(workflow, mesh, axis="pipe",
+                            microbatches=4, batch_axis=None,
+                            refresh=True):
+    """Pipeline parallelism for :class:`TransformerBlockStack` units:
+    the stacked layer dim of every parameter (and its momentum /
+    accumulation state) is sharded over ``axis`` — each stage owns
+    L/P consecutive blocks — and the unit's traced path switches to
+    the GPipe microbatch schedule (``parallel/pipeline.py``), where
+    activations hop stages via ``ppermute`` and weights never move.
+    ``batch_axis`` names the mesh axis the batch is sharded over when
+    composing PP with DP on one mesh; ``microbatches`` must divide
+    the (per-data-shard) minibatch size."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from veles.znicz_tpu.ops.transformer_stack import (
+        TransformerBlockStack)
+    step = workflow.xla_step
+    if step is None:
+        raise ValueError("workflow has no xla_step (numpy backend?)")
+    n = mesh.shape[axis]
+    dp = mesh.shape[batch_axis] if batch_axis else 1
+    smap = {}
+    touched = 0
+    for i, fwd in enumerate(workflow.forwards):
+        if not isinstance(fwd, TransformerBlockStack):
+            continue
+        if fwd.layers % n:
+            raise ValueError(
+                "%s: %s axis size %d does not divide layer count %d"
+                % (fwd.name, axis, n, fwd.layers))
+        mb = workflow.loader.max_minibatch_size
+        if (mb // dp) % microbatches:
+            raise ValueError(
+                "%s: %d microbatches do not divide the per-shard "
+                "minibatch %d" % (fwd.name, microbatches, mb // dp))
+        fwd.pipe_mesh = mesh
+        fwd.pipe_axis = axis
+        fwd.pipe_batch_axis = batch_axis
+        fwd.pipe_microbatches = int(microbatches)
+        gd = workflow.gds[i] if i < len(workflow.gds) else None
+        sh = NamedSharding(mesh, P(axis))
+        for key in fwd.PARAMS:
+            smap[(fwd.name, key)] = sh
+            if gd is not None:
+                smap[(gd.name, "vel_" + key)] = sh
+                smap[(gd.name, "acc_" + key)] = sh
+        touched += 1
+    if not touched:
+        raise ValueError("no block-stack units to pipeline")
+    step.sync_host()
+    step.param_sharding_map.update(smap)
+    if step.param_sharding is None:
+        step.param_sharding = replicated(mesh)
+    if step.batch_sharding is None:
+        step.batch_sharding = replicated(mesh)
+    workflow.device.mesh = mesh
+    if refresh:
+        step.refresh_device()
+    return mesh
+
+
 def setup_tensor_parallel(workflow, mesh, axis="model", refresh=True):
     """Megatron-style TP for the transformer units, the GSPMD way: no
     hand-written collectives — the qkv/up projections are
@@ -239,7 +299,9 @@ def setup_tensor_parallel(workflow, mesh, axis="model", refresh=True):
     if not touched:
         raise ValueError("no TP-shardable units found")
     step.sync_host()
-    step.param_sharding_map = smap
+    # merge, don't assign: the setup_* family composes in any order
+    # (setup_data_parallel owns the map reset)
+    step.param_sharding_map.update(smap)
     if step.param_sharding is None:
         step.param_sharding = replicated(mesh)
     if step.batch_sharding is None:

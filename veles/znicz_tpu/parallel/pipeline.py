@@ -1,0 +1,359 @@
+"""Pipeline parallelism (PP) — GPipe over a 'pipe' mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2: "TP / PP /
+SP / EP ... ABSENT"); PP is part of this rebuild's first-class
+distributed story. The design is the TPU-native one: the transformer
+block stack's parameters carry a leading LAYER dimension sharded over
+the ``pipe`` axis (each stage owns ``L/P`` consecutive blocks), and the
+schedule is a hand-written GPipe loop under ``shard_map`` — microbatch
+activations hop stage-to-stage via ``lax.ppermute`` (neighbour ICI
+links), the backward replays the loop in reverse consuming stashed
+activations, and per-stage parameter gradients accumulate locally so
+they never leave their stage (the whole point: weights stay put,
+activations move).
+
+This module holds the math only — pure functions over per-layer
+parameter dicts:
+
+* :func:`block_fwd` / :func:`block_bwd` — one post-LN transformer
+  block (MHA+residual → LN → FFN+residual → LN), generic over ``xp``
+  so the numpy oracle shares the formula set (explicit backward, znicz
+  style: ``jax.grad`` is only a test oracle).
+* :func:`stack_fwd` / :func:`stack_bwd` — ``lax.scan`` over the layer
+  dim (single-device / GSPMD path).
+* :func:`pipeline_fwd` / :func:`pipeline_bwd` — the GPipe schedule
+  under shard_map, composable with a ``data`` batch axis on the same
+  mesh (DP×PP).
+
+The consuming unit pair lives in ``ops/transformer_stack.py``.
+"""
+
+import functools
+
+import numpy
+
+from veles.znicz_tpu.ops import activations as A
+from veles.znicz_tpu.parallel.ring import _shard_map
+
+#: per-block stashed activations, in block_fwd production order
+CACHE_KEYS = ("x", "q", "k", "v", "probs", "merged", "a", "n1", "h",
+              "fo")
+
+ACT = "strict_relu"
+
+
+def _split(t, heads):
+    b, s, d = t.shape
+    return t.reshape(b, s, heads, d // heads).transpose(0, 2, 1, 3)
+
+
+def _merge(t):
+    b, h, s, dh = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
+
+
+def _ln_fwd(xp, x, g, b, eps):
+    mu = x.mean(axis=-1, keepdims=True)
+    xc = x - mu
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    rstd = 1.0 / xp.sqrt(var + eps)
+    return (xc * rstd) * g + b
+
+
+def _ln_bwd(xp, x, g, err, eps):
+    mu = x.mean(axis=-1, keepdims=True)
+    xc = x - mu
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    rstd = 1.0 / xp.sqrt(var + eps)
+    xhat = xc * rstd
+    dg = xp.einsum("bsd,bsd->d", err, xhat)
+    db = err.sum(axis=(0, 1))
+    dxhat = err * g
+    m1 = dxhat.mean(axis=-1, keepdims=True)
+    m2 = (dxhat * xhat).mean(axis=-1, keepdims=True)
+    dx = (dxhat - m1 - xhat * m2) * rstd
+    return dx, dg, db
+
+
+def block_fwd(xp, x, lp, heads, causal, eps):
+    """One post-LN transformer block. ``lp``: per-layer param dict
+    (see ops/transformer_stack.py for shapes). Returns (y, cache)."""
+    b, s, d = x.shape
+    dh = d // heads
+    qkv = x @ lp["weights"] + lp["bias"]
+    q = _split(qkv[..., :d], heads)
+    k = _split(qkv[..., d:2 * d], heads)
+    v = _split(qkv[..., 2 * d:], heads)
+    scale = numpy.float32(1.0 / numpy.sqrt(dh))
+    scores = (q @ k.transpose(0, 1, 3, 2)) * scale
+    if causal:
+        mask = xp.asarray(
+            numpy.triu(numpy.full((s, s), -1e9, numpy.float32), 1))
+        scores = scores + mask
+    probs = A.softmax(xp, scores)
+    merged = _merge(probs @ v)
+    a = merged @ lp["weights_out"] + lp["bias_out"] + x
+    n1 = _ln_fwd(xp, a, lp["ln1_g"], lp["ln1_b"], eps)
+    h = A.ACTIVATIONS[ACT][0](xp, n1 @ lp["ffn_w1"] + lp["ffn_b1"])
+    fo = h @ lp["ffn_w2"] + lp["ffn_b2"] + n1
+    y = _ln_fwd(xp, fo, lp["ln2_g"], lp["ln2_b"], eps)
+    cache = dict(zip(CACHE_KEYS,
+                     (x, q, k, v, probs, merged, a, n1, h, fo)))
+    return y, cache
+
+
+def block_bwd(xp, lp, cache, err, heads, eps):
+    """Backward of :func:`block_fwd`: (dx, grads) with grads keyed
+    like the parameter dict."""
+    x, q, k, v, probs, merged, a, n1, h, fo = (
+        cache[key] for key in CACHE_KEYS)
+    b, s, d = x.shape
+    dh = d // heads
+    scale = numpy.float32(1.0 / numpy.sqrt(dh))
+    # ln2
+    dfo, g_ln2g, g_ln2b = _ln_bwd(xp, fo, lp["ln2_g"], err, eps)
+    # ffn (+ n1 residual)
+    dhid = dfo @ lp["ffn_w2"].T
+    dhid = dhid * A.ACTIVATIONS[ACT][1](xp, h)
+    g_w2 = xp.einsum("bsh,bsd->hd", h, dfo)
+    g_b2 = dfo.sum(axis=(0, 1))
+    g_w1 = xp.einsum("bsd,bsh->dh", n1, dhid)
+    g_b1 = dhid.sum(axis=(0, 1))
+    dn1 = dhid @ lp["ffn_w1"].T + dfo
+    # ln1
+    da, g_ln1g, g_ln1b = _ln_bwd(xp, a, lp["ln1_g"], dn1, eps)
+    # attention (+ x residual)
+    g_wo = xp.einsum("bsd,bse->de", merged, da)
+    g_bo = da.sum(axis=(0, 1))
+    dmerged = da @ lp["weights_out"].T
+    dctx = _split(dmerged, heads)
+    dprobs = dctx @ v.transpose(0, 1, 3, 2)
+    dv = probs.transpose(0, 1, 3, 2) @ dctx
+    dscores = probs * (dprobs
+                       - (dprobs * probs).sum(axis=-1, keepdims=True))
+    dscores = dscores * scale
+    dq = dscores @ k
+    dk = dscores.transpose(0, 1, 3, 2) @ q
+    dqkv = xp.concatenate(
+        [_merge(dq), _merge(dk), _merge(dv)], axis=-1)
+    g_w = xp.einsum("bsd,bse->de", x, dqkv)
+    g_b = dqkv.sum(axis=(0, 1))
+    dx = dqkv @ lp["weights"].T + da
+    grads = {"weights": g_w, "bias": g_b, "weights_out": g_wo,
+             "bias_out": g_bo, "ln1_g": g_ln1g, "ln1_b": g_ln1b,
+             "ffn_w1": g_w1, "ffn_b1": g_b1, "ffn_w2": g_w2,
+             "ffn_b2": g_b2, "ln2_g": g_ln2g, "ln2_b": g_ln2b}
+    return dx, grads
+
+
+# ---------------------------------------------------------------------------
+# single-program paths: scan over the layer dimension
+
+
+def stack_fwd(params, x, heads, causal, eps):
+    """scan the block over stacked (L, ...) params. Returns (y,
+    caches) with cache leaves stacked (L, ...)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def step(carry, lp):
+        y, cache = block_fwd(jnp, carry, lp, heads, causal, eps)
+        return y, cache
+
+    return lax.scan(step, x, params)
+
+
+def stack_bwd(params, caches, err, heads, eps):
+    """Reverse scan: (dx, grads), grad leaves stacked (L, ...)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def step(dcarry, layer):
+        lp, cache = layer
+        dx, grads = block_bwd(jnp, lp, cache, dcarry, heads, eps)
+        return dx, grads
+
+    return lax.scan(step, err, (params, caches), reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# the GPipe schedule
+
+
+def _chunk_fwd(params, xin, heads, causal, eps):
+    return stack_fwd(params, xin, heads, causal, eps)
+
+
+def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
+                        heads, causal, eps):
+    """Per-device GPipe forward. ``params`` leaves (L/P, ...), x_loc
+    (b, S, D) with b the data-local batch. Returns (y_loc, caches)
+    with cache leaves (M, L/P, b/M, ...)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    stage = lax.axis_index(axis_name)
+    b, s, d = x_loc.shape
+    bm = b // n_micro
+    x_mb = x_loc.reshape(n_micro, bm, s, d)
+    run = functools.partial(_chunk_fwd, params, heads=heads,
+                            causal=causal, eps=eps)
+    # allocate the activation stash from the chunk's abstract shapes
+    y_shape, cache_shape = jax.eval_shape(
+        run, jax.ShapeDtypeStruct((bm, s, d), jnp.float32))
+    caches0 = jax.tree_util.tree_map(
+        lambda sd: jnp.zeros((n_micro,) + sd.shape, sd.dtype),
+        cache_shape)
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def step(carry, t):
+        recv, caches, outs = carry
+        feed = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        xin = jnp.where(stage == 0, feed, recv)
+        y, cache = run(xin)
+        m = t - stage                     # this stage's microbatch
+        valid = (m >= 0) & (m < n_micro)
+        mc = jnp.clip(m, 0, n_micro - 1)
+        caches = jax.tree_util.tree_map(
+            lambda buf, c: jnp.where(
+                valid, lax.dynamic_update_index_in_dim(buf, c, mc, 0),
+                buf),
+            caches, cache)
+        outs = jnp.where(
+            valid & (stage == n_stage - 1),
+            lax.dynamic_update_index_in_dim(outs, y, mc, 0), outs)
+        send = lax.ppermute(y, axis_name, perm)
+        return (send, caches, outs), None
+
+    carry0 = (jnp.zeros((bm, s, d), jnp.float32), caches0,
+              jnp.zeros((n_micro, bm, s, d), jnp.float32))
+    (recv, caches, outs), _ = lax.scan(
+        step, carry0, jnp.arange(n_micro + n_stage - 1))
+    out = lax.psum(jnp.where(stage == n_stage - 1, outs, 0.0),
+                   axis_name)
+    return out.reshape(b, s, d), caches
+
+
+def _pipeline_bwd_local(params, caches, err_loc, *, axis_name,
+                        n_stage, n_micro, heads, eps, batch_axis):
+    """Per-device GPipe backward: error microbatches flow LAST stage →
+    first; each stage consumes its stashed activations and accumulates
+    its own layers' gradients across microbatches."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    stage = lax.axis_index(axis_name)
+    b, s, d = err_loc.shape
+    bm = b // n_micro
+    err_mb = err_loc.reshape(n_micro, bm, s, d)
+    perm = [(i, (i - 1) % n_stage) for i in range(n_stage)]
+
+    def chunk_bwd(cache_m, derr):
+        return stack_bwd(params, cache_m, derr, heads, eps)
+
+    gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def step(carry, t):
+        recv, gacc, dxs = carry
+        feed = lax.dynamic_index_in_dim(
+            err_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        din = jnp.where(stage == n_stage - 1, feed, recv)
+        m = t - (n_stage - 1 - stage)     # reverse schedule
+        valid = (m >= 0) & (m < n_micro)
+        mc = jnp.clip(m, 0, n_micro - 1)
+        cache_m = jax.tree_util.tree_map(
+            lambda buf: lax.dynamic_index_in_dim(buf, mc, 0,
+                                                 keepdims=False),
+            caches)
+        dx, grads = chunk_bwd(cache_m, din)
+        gacc = jax.tree_util.tree_map(
+            lambda acc, g: acc + jnp.where(valid, g, 0.0),
+            gacc, grads)
+        dxs = jnp.where(
+            valid & (stage == 0),
+            lax.dynamic_update_index_in_dim(dxs, dx, mc, 0), dxs)
+        send = lax.ppermute(dx, axis_name, perm)
+        return (send, gacc, dxs), None
+
+    carry0 = (jnp.zeros((bm, s, d), jnp.float32), gacc0,
+              jnp.zeros((n_micro, bm, s, d), jnp.float32))
+    (recv, gacc, dxs), _ = lax.scan(
+        step, carry0, jnp.arange(n_micro + n_stage - 1))
+    dx = lax.psum(jnp.where(stage == 0, dxs, 0.0), axis_name)
+    if batch_axis is not None:
+        # sum the stage-local grads across data shards (the explicit
+        # twin of the all-reduce GSPMD inserts on the jit path)
+        gacc = lax.psum(gacc, batch_axis)
+    return dx.reshape(b, s, d), gacc
+
+
+def _cache_specs(caches, axis, batch_axis):
+    """PartitionSpecs for the stash: (M, L, B/M, ...) leaves — layer
+    dim on the pipe axis, microbatch-batch dim on the data axis.
+    Works on arrays and ShapeDtypeStructs alike."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    return jax.tree_util.tree_map(
+        lambda a: P(*([None, axis, batch_axis]
+                      + [None] * (len(a.shape) - 3))),
+        caches)
+
+
+def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
+                 n_micro=4, heads=4, causal=True, eps=1e-5):
+    """GPipe forward over ``mesh[axis]``. ``params`` leaves (L, ...)
+    sharded on dim 0; x (B, S, D) global. Returns (y, caches)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    n_stage = mesh.shape[axis]
+    pspec = jax.tree_util.tree_map(
+        lambda _: P(axis), params)
+    xspec = P(batch_axis, None, None)
+    fn = functools.partial(
+        _pipeline_fwd_local, axis_name=axis, n_stage=n_stage,
+        n_micro=n_micro, heads=heads, causal=causal, eps=eps)
+    # shapes of the stash, for out_specs: one chunk's caches (the
+    # chunk itself is axis-free, so eval_shape is safe) + the
+    # microbatch dim in front
+    dp = mesh.shape[batch_axis] if batch_axis else 1
+    b, s, d = x.shape
+    bm = (b // dp) // n_micro
+    local_params = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(
+            (a.shape[0] // n_stage,) + a.shape[1:], a.dtype), params)
+    _, chunk_cache = jax.eval_shape(
+        lambda p, xx: stack_fwd(p, xx, heads, causal, eps),
+        local_params, jax.ShapeDtypeStruct((bm, s, d), jnp.float32))
+    cache_shape = jax.tree_util.tree_map(
+        lambda sd: jax.ShapeDtypeStruct((n_micro,) + sd.shape,
+                                        sd.dtype), chunk_cache)
+    sm = _shard_map()
+    out = sm(fn, mesh=mesh, in_specs=(pspec, xspec),
+             out_specs=(xspec, _cache_specs(cache_shape, axis,
+                                            batch_axis)))(params, x)
+    return out
+
+
+def pipeline_bwd(params, caches, err, mesh, axis="pipe",
+                 batch_axis=None, n_micro=4, heads=4, eps=1e-5):
+    """GPipe backward: (dx, grads) — dx (B, S, D) global, grad leaves
+    (L, ...) sharded on dim 0 like the params."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    n_stage = mesh.shape[axis]
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), params)
+    xspec = P(batch_axis, None, None)
+    cspecs = _cache_specs(caches, axis, batch_axis)
+    fn = functools.partial(
+        _pipeline_bwd_local, axis_name=axis, n_stage=n_stage,
+        n_micro=n_micro, heads=heads, eps=eps, batch_axis=batch_axis)
+    sm = _shard_map()
+    return sm(fn, mesh=mesh, in_specs=(pspec, cspecs, xspec),
+              out_specs=(xspec, pspec))(params, caches, err)
