@@ -8,7 +8,6 @@ import pytest
 
 import veles.prng as prng
 from veles.config import root
-from veles.memory import Array
 from veles.znicz_tpu.ops.transformer_stack import TransformerBlockStack
 from veles.znicz_tpu.parallel import pipeline as PL
 

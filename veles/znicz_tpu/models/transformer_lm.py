@@ -30,8 +30,11 @@ root.lm.update({
     # stack into ONE transformer_stack unit (lax.scan over layers —
     # flat compile time in depth, and the vehicle for pipeline
     # parallelism via root.lm.parallel.pipe).
+    # attn_impl: None/"scan" = lax.scan flash formulation when
+    # attn_block is set; "pallas" = the hand-written Pallas TPU
+    # kernels (parallel/pallas_attention.py)
     "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128,
-              "attn_block": None, "moe_experts": 0,
+              "attn_block": None, "attn_impl": None, "moe_experts": 0,
               "moe_capacity_factor": 2.0, "moe_aux_weight": 0.01,
               "stacked": False},
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
@@ -91,12 +94,13 @@ def build_layers():
                 "stacked=True builds dense-FFN blocks; it cannot "
                 "honour moe_experts=%r (use the per-layer model for "
                 "MoE)" % m.moe_experts)
-        if m.get("attn_block"):
+        if m.get("attn_block") or m.get("attn_impl"):
             raise ValueError(
                 "stacked=True uses dense attention inside the block "
-                "scan; attn_block=%r is not supported there (use the "
-                "per-layer model for flash-blocked attention)"
-                % m.attn_block)
+                "scan; attn_block=%r / attn_impl=%r are not supported "
+                "there (use the per-layer model for flash/pallas "
+                "attention)" % (m.get("attn_block"),
+                                m.get("attn_impl")))
         layers += [
             {"type": "transformer_stack",
              "->": {"layers": m.layers, "heads": m.heads,
@@ -123,7 +127,8 @@ def build_layers():
             {"type": "attention",
              "->": {"heads": m.heads, "causal": True,
                     "residual": True,
-                    "attn_block_size": m.get("attn_block")},
+                    "attn_block_size": m.get("attn_block"),
+                    "attn_impl": m.get("attn_impl")},
              "<-": dict(t)},
             {"type": "layernorm", "<-": dict(t)},
             dict(ffn_layer),

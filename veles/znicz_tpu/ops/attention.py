@@ -250,6 +250,35 @@ class GDTransformerFFN(GradientDescentBase):
 # ---------------------------------------------------------------------------
 # multi-head attention
 
+# The dense softmax-attention core — the ONE copy of the formula pair,
+# shared by the unit below and the fused block stack
+# (parallel/pipeline.py). q/k/v: (B, H, S, dh).
+
+
+def dense_attention_core_fwd(xp, q, k, v, causal, scale):
+    """(probs, ctx) with ctx = softmax(qkᵀ·scale [+ causal mask])·v."""
+    s = q.shape[2]
+    scores = (q @ k.transpose(0, 1, 3, 2)) * scale
+    if causal:
+        mask = xp.asarray(
+            numpy.triu(numpy.full((s, s), -1e9, numpy.float32), 1))
+        scores = scores + mask
+    probs = A.softmax(xp, scores)
+    return probs, probs @ v
+
+
+def dense_attention_core_bwd(xp, q, k, v, probs, dctx, scale):
+    """Backward of the core: (dq, dk, dv). The causal mask needs no
+    re-application — masked probs are exactly zero."""
+    dprobs = dctx @ v.transpose(0, 1, 3, 2)
+    dv = probs.transpose(0, 1, 3, 2) @ dctx
+    dscores = probs * (dprobs - (dprobs * probs)
+                       .sum(axis=-1, keepdims=True))
+    dscores = dscores * scale
+    dq = dscores @ k
+    dk = dscores.transpose(0, 1, 3, 2) @ q
+    return dq, dk, dv
+
 
 @forward_unit("attention")
 class MultiHeadAttention(Forward):
@@ -282,6 +311,15 @@ class MultiHeadAttention(Forward):
         #: style online softmax, exact — parallel/flash.py). Must
         #: divide the sequence length. None = dense.
         self.attn_block_size = kwargs.get("attn_block_size")
+        #: "pallas" routes the blocked path through the hand-written
+        #: Pallas TPU kernels (parallel/pallas_attention.py) instead
+        #: of the lax.scan formulation; None/"scan" keeps the scan.
+        #: Same exact math, same cache signature — a pure kernel swap.
+        self.attn_impl = kwargs.get("attn_impl")
+        if self.attn_impl not in (None, "scan", "pallas"):
+            raise ValueError(
+                "attn_impl must be None, 'scan' or 'pallas', got %r"
+                % (self.attn_impl,))
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -328,13 +366,9 @@ class MultiHeadAttention(Forward):
         k = self._split(qkv[..., d:2 * d])
         v = self._split(qkv[..., 2 * d:])
         scale = numpy.float32(1.0 / numpy.sqrt(dh))
-        scores = (q @ k.transpose(0, 1, 3, 2)) * scale
-        if self.causal:
-            mask = xp.asarray(
-                numpy.triu(numpy.full((s, s), -1e9, numpy.float32), 1))
-            scores = scores + mask
-        probs = A.softmax(xp, scores)
-        merged = self._merge(probs @ v)
+        probs, ctx = dense_attention_core_fwd(
+            xp, q, k, v, self.causal, scale)
+        merged = self._merge(ctx)
         y = merged @ wo
         if self.include_bias:
             y = y + bo
@@ -359,6 +393,9 @@ class MultiHeadAttention(Forward):
         p = ctx.unit_params(self)
         if self.seq_mesh is not None:
             y, cache = self._fwd_ring(jnp, x, p)
+            names = ("q", "k", "v", "out_heads", "lse", "merged")
+        elif self.attn_impl == "pallas":
+            y, cache = self._fwd_pallas(jnp, x, p)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
         elif self.attn_block_size:
             y, cache = self._fwd_blocked(jnp, x, p)
@@ -399,6 +436,32 @@ class MultiHeadAttention(Forward):
         y = self._finish(x, merged, p)
         return y, (q, k, v, out_heads, lse, merged)
 
+    def _pallas_block(self):
+        """Kernel block size: attn_block_size, or the largest
+        power-of-two divisor of S up to 128 (so attn_impl='pallas'
+        works without attn_block_size for any even S)."""
+        s = self.input.shape[1]
+        if self.attn_block_size:
+            if s % self.attn_block_size:
+                raise ValueError(
+                    "%s: attn_block_size %d does not divide sequence "
+                    "length %d (attn_impl='pallas')"
+                    % (self.name, self.attn_block_size, s))
+            return self.attn_block_size
+        return max(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
+                   if s % b == 0)
+
+    def _fwd_pallas(self, xp, x, p):
+        """Flash forward on the hand-written Pallas TPU kernel."""
+        from veles.znicz_tpu.parallel import pallas_attention as PA
+        blk = self._pallas_block()
+        q, k, v = self._project_qkv(x, p)
+        out_heads, lse = PA.flash_attention_fwd(
+            q, k, v, causal=self.causal, block_q=blk, block_k=blk)
+        merged = self._merge(out_heads)
+        y = self._finish(x, merged, p)
+        return y, (q, k, v, out_heads, lse, merged)
+
     def _fwd_ring(self, xp, x, p):
         """Sequence-parallel forward: qkv projection under
         auto-sharding, attention proper via the ppermute ring."""
@@ -429,13 +492,8 @@ class GDMultiHeadAttention(GradientDescentBase):
         gbo = err.reshape(-1, d).sum(axis=0)
         dmerged = err @ wo.T
         dctx = f._split(dmerged)                       # (B,H,S,dh)
-        dprobs = dctx @ v.transpose(0, 1, 3, 2)        # (B,H,S,S)
-        dv = probs.transpose(0, 1, 3, 2) @ dctx
-        dscores = probs * (dprobs - (dprobs * probs)
-                           .sum(axis=-1, keepdims=True))
-        dscores = dscores * scale
-        dq = dscores @ k
-        dk = dscores.transpose(0, 1, 3, 2) @ q
+        dq, dk, dv = dense_attention_core_bwd(
+            xp, q, k, v, probs, dctx, scale)
         dqkv = xp.concatenate(
             [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
         gw = x.reshape(-1, d).T @ dqkv.reshape(-1, 3 * d)
@@ -506,6 +564,17 @@ class GDMultiHeadAttention(GradientDescentBase):
                 q, k, v, o, lse, dctx, causal=f.causal,
                 block=f.attn_block_size))
 
+    def _bwd_pallas(self, xp, x, p, ctx, err):
+        """Flash backward on the Pallas kernels."""
+        from veles.znicz_tpu.parallel import pallas_attention as PA
+        f = self.forward
+        blk = f._pallas_block()
+        return self._bwd_outer(
+            xp, x, p, ctx, err,
+            lambda q, k, v, o, lse, dctx: PA.flash_attention_bwd(
+                q, k, v, o, lse, dctx, causal=f.causal,
+                block_q=blk, block_k=blk))
+
     def xla_run(self, ctx):
         import jax.numpy as jnp
         f = self.forward
@@ -514,6 +583,9 @@ class GDMultiHeadAttention(GradientDescentBase):
         p = ctx.unit_params(f)
         if f.seq_mesh is not None:
             dx, gw, gb, gwo, gbo = self._bwd_ring(jnp, x, p, ctx, err)
+        elif f.attn_impl == "pallas":
+            dx, gw, gb, gwo, gbo = self._bwd_pallas(
+                jnp, x, p, ctx, err)
         elif f.attn_block_size:
             dx, gw, gb, gwo, gbo = self._bwd_blocked(
                 jnp, x, p, ctx, err)

@@ -12,6 +12,34 @@ from veles.znicz_tpu.nn_units import (
     Forward, GradientDescentBase, forward_unit, gradient_for)
 
 
+def ln_fwd(xp, x, g, b, eps):
+    """LayerNorm over the trailing dim — the ONE copy of the formula
+    (shared by the unit pair and the fused block stack)."""
+    mu = x.mean(axis=-1, keepdims=True)
+    xc = x - mu
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    rstd = 1.0 / xp.sqrt(var + eps)
+    return (xc * rstd) * g + b
+
+
+def ln_bwd(xp, x, g, err, eps):
+    """Backward of :func:`ln_fwd`: (dx, dg, db); dg/db reduced over
+    every leading dim."""
+    d = x.shape[-1]
+    mu = x.mean(axis=-1, keepdims=True)
+    xc = x - mu
+    var = (xc * xc).mean(axis=-1, keepdims=True)
+    rstd = 1.0 / xp.sqrt(var + eps)
+    xhat = xc * rstd
+    dg = (err * xhat).reshape(-1, d).sum(axis=0)
+    db = err.reshape(-1, d).sum(axis=0)
+    dxhat = err * g
+    m1 = dxhat.mean(axis=-1, keepdims=True)
+    m2 = (dxhat * xhat).mean(axis=-1, keepdims=True)
+    dx = (dxhat - m1 - xhat * m2) * rstd
+    return dx, dg, db
+
+
 @forward_unit("layernorm")
 class LayerNormForward(Forward):
     PARAMS = ("weights", "bias")   # gamma, beta
@@ -35,11 +63,7 @@ class LayerNormForward(Forward):
                 numpy.zeros(self.input.shape, numpy.float32))
 
     def _forward(self, xp, x, g, b):
-        mu = x.mean(axis=-1, keepdims=True)
-        xc = x - mu
-        var = (xc * xc).mean(axis=-1, keepdims=True)
-        rstd = 1.0 / xp.sqrt(var + self.eps)
-        return (xc * rstd) * g + b
+        return ln_fwd(xp, x, g, b, self.eps)
 
     def numpy_run(self):
         x = self.input.map_read().mem.astype(numpy.float32)
@@ -60,19 +84,7 @@ class LayerNormForward(Forward):
 @gradient_for(LayerNormForward)
 class GDLayerNorm(GradientDescentBase):
     def _backward(self, xp, x, g, err):
-        eps = self.forward.eps
-        mu = x.mean(axis=-1, keepdims=True)
-        xc = x - mu
-        var = (xc * xc).mean(axis=-1, keepdims=True)
-        rstd = 1.0 / xp.sqrt(var + eps)
-        xhat = xc * rstd
-        dg = (err * xhat).reshape(-1, x.shape[-1]).sum(axis=0)
-        db = err.reshape(-1, x.shape[-1]).sum(axis=0)
-        dxhat = err * g
-        m1 = dxhat.mean(axis=-1, keepdims=True)
-        m2 = (dxhat * xhat).mean(axis=-1, keepdims=True)
-        dx = (dxhat - m1 - xhat * m2) * rstd
-        return dx, dg, db
+        return ln_bwd(xp, x, g, err, self.forward.eps)
 
     def numpy_run(self):
         f = self.forward

@@ -33,6 +33,9 @@ import functools
 import numpy
 
 from veles.znicz_tpu.ops import activations as A
+from veles.znicz_tpu.ops.attention import (
+    dense_attention_core_fwd, dense_attention_core_bwd)
+from veles.znicz_tpu.ops.layernorm import ln_fwd, ln_bwd
 from veles.znicz_tpu.parallel.ring import _shard_map
 
 #: per-block stashed activations, in block_fwd production order
@@ -52,32 +55,11 @@ def _merge(t):
     return t.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
 
 
-def _ln_fwd(xp, x, g, b, eps):
-    mu = x.mean(axis=-1, keepdims=True)
-    xc = x - mu
-    var = (xc * xc).mean(axis=-1, keepdims=True)
-    rstd = 1.0 / xp.sqrt(var + eps)
-    return (xc * rstd) * g + b
-
-
-def _ln_bwd(xp, x, g, err, eps):
-    mu = x.mean(axis=-1, keepdims=True)
-    xc = x - mu
-    var = (xc * xc).mean(axis=-1, keepdims=True)
-    rstd = 1.0 / xp.sqrt(var + eps)
-    xhat = xc * rstd
-    dg = xp.einsum("bsd,bsd->d", err, xhat)
-    db = err.sum(axis=(0, 1))
-    dxhat = err * g
-    m1 = dxhat.mean(axis=-1, keepdims=True)
-    m2 = (dxhat * xhat).mean(axis=-1, keepdims=True)
-    dx = (dxhat - m1 - xhat * m2) * rstd
-    return dx, dg, db
-
-
 def block_fwd(xp, x, lp, heads, causal, eps):
     """One post-LN transformer block. ``lp``: per-layer param dict
-    (see ops/transformer_stack.py for shapes). Returns (y, cache)."""
+    (see ops/transformer_stack.py for shapes). Returns (y, cache).
+    Attention/LN formulas are the shared ones from ops/attention.py
+    and ops/layernorm.py — one copy of the math repo-wide."""
     b, s, d = x.shape
     dh = d // heads
     qkv = x @ lp["weights"] + lp["bias"]
@@ -85,18 +67,13 @@ def block_fwd(xp, x, lp, heads, causal, eps):
     k = _split(qkv[..., d:2 * d], heads)
     v = _split(qkv[..., 2 * d:], heads)
     scale = numpy.float32(1.0 / numpy.sqrt(dh))
-    scores = (q @ k.transpose(0, 1, 3, 2)) * scale
-    if causal:
-        mask = xp.asarray(
-            numpy.triu(numpy.full((s, s), -1e9, numpy.float32), 1))
-        scores = scores + mask
-    probs = A.softmax(xp, scores)
-    merged = _merge(probs @ v)
+    probs, ctx = dense_attention_core_fwd(xp, q, k, v, causal, scale)
+    merged = _merge(ctx)
     a = merged @ lp["weights_out"] + lp["bias_out"] + x
-    n1 = _ln_fwd(xp, a, lp["ln1_g"], lp["ln1_b"], eps)
+    n1 = ln_fwd(xp, a, lp["ln1_g"], lp["ln1_b"], eps)
     h = A.ACTIVATIONS[ACT][0](xp, n1 @ lp["ffn_w1"] + lp["ffn_b1"])
     fo = h @ lp["ffn_w2"] + lp["ffn_b2"] + n1
-    y = _ln_fwd(xp, fo, lp["ln2_g"], lp["ln2_b"], eps)
+    y = ln_fwd(xp, fo, lp["ln2_g"], lp["ln2_b"], eps)
     cache = dict(zip(CACHE_KEYS,
                      (x, q, k, v, probs, merged, a, n1, h, fo)))
     return y, cache
@@ -111,7 +88,7 @@ def block_bwd(xp, lp, cache, err, heads, eps):
     dh = d // heads
     scale = numpy.float32(1.0 / numpy.sqrt(dh))
     # ln2
-    dfo, g_ln2g, g_ln2b = _ln_bwd(xp, fo, lp["ln2_g"], err, eps)
+    dfo, g_ln2g, g_ln2b = ln_bwd(xp, fo, lp["ln2_g"], err, eps)
     # ffn (+ n1 residual)
     dhid = dfo @ lp["ffn_w2"].T
     dhid = dhid * A.ACTIVATIONS[ACT][1](xp, h)
@@ -121,19 +98,14 @@ def block_bwd(xp, lp, cache, err, heads, eps):
     g_b1 = dhid.sum(axis=(0, 1))
     dn1 = dhid @ lp["ffn_w1"].T + dfo
     # ln1
-    da, g_ln1g, g_ln1b = _ln_bwd(xp, a, lp["ln1_g"], dn1, eps)
+    da, g_ln1g, g_ln1b = ln_bwd(xp, a, lp["ln1_g"], dn1, eps)
     # attention (+ x residual)
     g_wo = xp.einsum("bsd,bse->de", merged, da)
     g_bo = da.sum(axis=(0, 1))
     dmerged = da @ lp["weights_out"].T
     dctx = _split(dmerged, heads)
-    dprobs = dctx @ v.transpose(0, 1, 3, 2)
-    dv = probs.transpose(0, 1, 3, 2) @ dctx
-    dscores = probs * (dprobs
-                       - (dprobs * probs).sum(axis=-1, keepdims=True))
-    dscores = dscores * scale
-    dq = dscores @ k
-    dk = dscores.transpose(0, 1, 3, 2) @ q
+    dq, dk, dv = dense_attention_core_bwd(
+        xp, q, k, v, probs, dctx, scale)
     dqkv = xp.concatenate(
         [_merge(dq), _merge(dk), _merge(dv)], axis=-1)
     g_w = xp.einsum("bsd,bse->de", x, dqkv)
