@@ -168,6 +168,28 @@ def lm_tokens_per_sec(measure_chunks=1):
         measure_chunks=measure_chunks)
 
 
+def lm_scale_tokens_per_sec(measure_chunks=1):
+    """Transformer-LM throughput at REAL model scale (57.5M params:
+    dim 768, 12 heads, 8 layers, ffn 3072, S=512, flash attn_block
+    128) — the recorded large-model number (BASELINE.md 'Transformer
+    LM at scale')."""
+    from veles.loader.base import CLASS_TRAIN
+    from veles.config import root
+    from veles.znicz_tpu.models import transformer_lm
+    root.lm.loader.update({"minibatch_size": 16, "n_train": 256,
+                           "n_valid": 32, "seq_len": 512,
+                           "vocab": 32, "max_period": 8})
+    root.lm.model.update({"dim": 768, "heads": 12, "layers": 8,
+                          "ffn_hidden": 3072, "attn_block": 128})
+    seq = root.lm.loader.seq_len
+    return _xla_throughput(
+        transformer_lm.create_workflow, root.lm,
+        lambda ld: int(ld.minibatch_size) * seq
+        if ld.minibatch_class == CLASS_TRAIN else 0,
+        epochs_per_dispatch=1, name="BenchLMScale",
+        measure_chunks=measure_chunks)
+
+
 def main():
     base = numpy_steps_per_sec()
     fast, grad_bytes = xla_mnist_bench()
@@ -193,6 +215,11 @@ def main():
             lm_tokens_per_sec(), 1)
     except Exception as exc:
         extra["lm_train_tokens_per_sec_error"] = str(exc)[:200]
+    try:
+        extra["lm_57M_tokens_per_sec"] = round(
+            lm_scale_tokens_per_sec(), 1)
+    except Exception as exc:
+        extra["lm_57M_tokens_per_sec_error"] = str(exc)[:200]
     print(json.dumps({
         "metric": "mnist_train_steps_per_sec",
         "value": round(fast, 2),

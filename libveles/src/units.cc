@@ -15,7 +15,9 @@
 #include <algorithm>
 #include <cmath>
 #include <limits>
+#include <map>
 #include <stdexcept>
+#include <string>
 #include <vector>
 
 #include "veles/matrix.h"
@@ -457,6 +459,91 @@ class Embedding : public Unit {
 
 VELES_REGISTER_UNIT("embedding", Embedding)
 
+// In-place LayerNorm over trailing dim — the ONE C++ copy of the
+// formula (used by the LayerNorm unit and the fused block stack).
+void LayerNormRows(float* x, const float* gamma, const float* beta,
+                   int64_t rows, int64_t d, float eps) {
+  for (int64_t r = 0; r < rows; ++r) {
+    float* row = x + r * d;
+    float mu = 0;
+    for (int64_t i = 0; i < d; ++i) mu += row[i];
+    mu /= d;
+    float var = 0;
+    for (int64_t i = 0; i < d; ++i)
+      var += (row[i] - mu) * (row[i] - mu);
+    var /= d;
+    float rstd = 1.0f / std::sqrt(var + eps);
+    for (int64_t i = 0; i < d; ++i)
+      row[i] = (row[i] - mu) * rstd * gamma[i] + beta[i];
+  }
+}
+
+// Dense multi-head self-attention (B, S, D) — raw-pointer core shared
+// by the MultiHeadAttention unit and the block stack. bqkv/bout may be
+// null (no bias). O(S) score memory per row.
+void AttentionRows(const float* in, float* out, const float* wqkv,
+                   const float* bqkv, const float* wout,
+                   const float* bout, int64_t b, int64_t s, int64_t d,
+                   int64_t heads, bool causal, bool residual) {
+  int64_t dh = d / heads;
+  int64_t rows = b * s;
+  std::vector<float> qkv(static_cast<size_t>(rows * 3 * d));
+  Gemm(in, wqkv, qkv.data(), rows, d, 3 * d, false);
+  if (bqkv) AddBias(qkv.data(), bqkv, rows, 3 * d);
+  const float scale = 1.0f / std::sqrt(static_cast<float>(dh));
+  std::vector<float> merged(static_cast<size_t>(rows * d));
+  std::vector<float> scores(static_cast<size_t>(s));
+  for (int64_t bi = 0; bi < b; ++bi) {
+    for (int64_t h = 0; h < heads; ++h) {
+      for (int64_t i = 0; i < s; ++i) {
+        const float* q = qkv.data() + ((bi * s + i) * 3 + 0) * d
+                         + h * dh;
+        int64_t kmax = causal ? i + 1 : s;
+        float mx = -std::numeric_limits<float>::infinity();
+        for (int64_t j = 0; j < kmax; ++j) {
+          const float* k = qkv.data() + ((bi * s + j) * 3 + 1) * d
+                           + h * dh;
+          float sc = 0;
+          for (int64_t e = 0; e < dh; ++e) sc += q[e] * k[e];
+          scores[j] = sc * scale;
+          mx = std::max(mx, scores[j]);
+        }
+        float sum = 0;
+        for (int64_t j = 0; j < kmax; ++j) {
+          scores[j] = std::exp(scores[j] - mx);
+          sum += scores[j];
+        }
+        float* dst = merged.data() + (bi * s + i) * d + h * dh;
+        std::fill_n(dst, dh, 0.0f);
+        for (int64_t j = 0; j < kmax; ++j) {
+          const float p = scores[j] / sum;
+          const float* v = qkv.data() + ((bi * s + j) * 3 + 2) * d
+                           + h * dh;
+          for (int64_t e = 0; e < dh; ++e) dst[e] += p * v[e];
+        }
+      }
+    }
+  }
+  Gemm(merged.data(), wout, out, rows, d, d, false);
+  if (bout) AddBias(out, bout, rows, d);
+  if (residual)
+    for (int64_t i = 0; i < rows * d; ++i) out[i] += in[i];
+}
+
+// y = [x +] strict_relu(x·W1+b1)·W2+b2 — shared FFN core.
+void FFNRows(const float* in, float* out, const float* w1,
+             const float* b1, const float* w2, const float* b2,
+             int64_t rows, int64_t d, int64_t hidden, bool residual) {
+  std::vector<float> h(static_cast<size_t>(rows * hidden));
+  Gemm(in, w1, h.data(), rows, d, hidden, false);
+  AddBias(h.data(), b1, rows, hidden);
+  ApplyActivation(Act::kStrictRelu, h.data(), rows, hidden);
+  Gemm(h.data(), w2, out, rows, hidden, d, false);
+  AddBias(out, b2, rows, d);
+  if (residual)
+    for (int64_t i = 0; i < rows * d; ++i) out[i] += in[i];
+}
+
 class LayerNorm : public Unit {
  public:
   void Configure(const json::Value& spec, const std::string& dir) override {
@@ -472,18 +559,8 @@ class LayerNorm : public Unit {
     if (gamma_.NumElements() != d || beta_.NumElements() != d)
       throw std::runtime_error(name() + ": weight shape mismatch");
     *out = in;
-    for (int64_t r = 0; r < rows; ++r) {
-      float* x = out->data() + r * d;
-      float mu = 0;
-      for (int64_t i = 0; i < d; ++i) mu += x[i];
-      mu /= d;
-      float var = 0;
-      for (int64_t i = 0; i < d; ++i) var += (x[i] - mu) * (x[i] - mu);
-      var /= d;
-      float rstd = 1.0f / std::sqrt(var + eps_);
-      for (int64_t i = 0; i < d; ++i)
-        x[i] = (x[i] - mu) * rstd * gamma_.data()[i] + beta_.data()[i];
-    }
+    LayerNormRows(out->data(), gamma_.data(), beta_.data(), rows, d,
+                  eps_);
   }
 
  private:
@@ -562,16 +639,9 @@ class TransformerFFN : public Unit {
         w2_.dim(0) != hidden_ || w2_.dim(1) != d ||
         b1_.NumElements() != hidden_ || b2_.NumElements() != d)
       throw std::runtime_error(name() + ": weight shape mismatch");
-    std::vector<float> h(static_cast<size_t>(rows * hidden_));
-    Gemm(in.data(), w1_.data(), h.data(), rows, d, hidden_, false);
-    AddBias(h.data(), b1_.data(), rows, hidden_);
-    ApplyActivation(Act::kStrictRelu, h.data(), rows, hidden_);
     out->Reset(in.shape());
-    Gemm(h.data(), w2_.data(), out->data(), rows, hidden_, d, false);
-    AddBias(out->data(), b2_.data(), rows, d);
-    if (residual_)
-      for (int64_t i = 0; i < rows * d; ++i)
-        out->data()[i] += in.data()[i];
+    FFNRows(in.data(), out->data(), w1_.data(), b1_.data(),
+            w2_.data(), b2_.data(), rows, d, hidden_, residual_);
   }
 
  private:
@@ -607,7 +677,6 @@ class MultiHeadAttention : public Unit {
                                "(B, S, D), got " + in.ShapeString());
     CheckNonEmpty(in, name());
     int64_t b = in.dim(0), s = in.dim(1), d = in.dim(2);
-    int64_t dh = d / heads_;
     if (d % heads_)
       throw std::runtime_error(name() + ": dim % heads != 0");
     if (w_qkv_.dim(0) != d || w_qkv_.dim(1) != 3 * d ||
@@ -617,51 +686,11 @@ class MultiHeadAttention : public Unit {
       CheckVecSize(b_qkv_, 3 * d, name(), "bias");
       CheckVecSize(b_out_, d, name(), "bias_out");
     }
-    int64_t rows = b * s;
-    std::vector<float> qkv(static_cast<size_t>(rows * 3 * d));
-    Gemm(in.data(), w_qkv_.data(), qkv.data(), rows, d, 3 * d, false);
-    if (has_bias_) AddBias(qkv.data(), b_qkv_.data(), rows, 3 * d);
-    const float scale = 1.0f / std::sqrt(static_cast<float>(dh));
-    std::vector<float> merged(static_cast<size_t>(rows * d));
-    std::vector<float> scores(static_cast<size_t>(s));
-    // per (batch, head): scores row by row — O(S) score memory
-    for (int64_t bi = 0; bi < b; ++bi) {
-      for (int64_t h = 0; h < heads_; ++h) {
-        for (int64_t i = 0; i < s; ++i) {
-          const float* q = qkv.data() + ((bi * s + i) * 3 + 0) * d
-                           + h * dh;
-          int64_t kmax = causal_ ? i + 1 : s;
-          float mx = -std::numeric_limits<float>::infinity();
-          for (int64_t j = 0; j < kmax; ++j) {
-            const float* k = qkv.data() + ((bi * s + j) * 3 + 1) * d
-                             + h * dh;
-            float sc = 0;
-            for (int64_t e = 0; e < dh; ++e) sc += q[e] * k[e];
-            scores[j] = sc * scale;
-            mx = std::max(mx, scores[j]);
-          }
-          float sum = 0;
-          for (int64_t j = 0; j < kmax; ++j) {
-            scores[j] = std::exp(scores[j] - mx);
-            sum += scores[j];
-          }
-          float* dst = merged.data() + (bi * s + i) * d + h * dh;
-          std::fill_n(dst, dh, 0.0f);
-          for (int64_t j = 0; j < kmax; ++j) {
-            const float p = scores[j] / sum;
-            const float* v = qkv.data() + ((bi * s + j) * 3 + 2) * d
-                             + h * dh;
-            for (int64_t e = 0; e < dh; ++e) dst[e] += p * v[e];
-          }
-        }
-      }
-    }
     out->Reset({b, s, d});
-    Gemm(merged.data(), w_out_.data(), out->data(), rows, d, d, false);
-    if (has_bias_) AddBias(out->data(), b_out_.data(), rows, d);
-    if (residual_)
-      for (int64_t i = 0; i < rows * d; ++i)
-        out->data()[i] += in.data()[i];
+    AttentionRows(in.data(), out->data(), w_qkv_.data(),
+                  has_bias_ ? b_qkv_.data() : nullptr, w_out_.data(),
+                  has_bias_ ? b_out_.data() : nullptr, b, s, d,
+                  heads_, causal_, residual_);
   }
 
  private:
@@ -671,6 +700,181 @@ class MultiHeadAttention : public Unit {
 };
 
 VELES_REGISTER_UNIT("attention", MultiHeadAttention)
+
+// Top-1-routed MoE FFN (veles/znicz_tpu/ops/moe.py): same capacity
+// semantics as the Python forward — tokens are assigned to their
+// argmax expert in order; overflow beyond ceil(cf·T/E) bypasses the
+// experts (residual-only), so C++ output == oracle output exactly.
+class MoEFFN : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    router_ = npy::Load(ResolvePath(dir, spec.at("router").AsString()));
+    w1_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    b1_ = npy::Load(ResolvePath(dir, spec.at("bias").AsString()));
+    w2_ = npy::Load(ResolvePath(dir, spec.at("weights2").AsString()));
+    b2_ = npy::Load(ResolvePath(dir, spec.at("bias2").AsString()));
+    const json::Value& cfg = spec.at("config");
+    experts_ = CheckDim(cfg.at("experts").AsInt(), name(), "experts",
+                        2);
+    hidden_ = CheckDim(cfg.at("hidden").AsInt(), name(), "hidden");
+    residual_ = cfg.at("residual").AsBool();
+    capacity_factor_ = cfg.at("capacity_factor").AsDouble();
+    if (capacity_factor_ <= 0)
+      throw std::runtime_error(name() + ": bad capacity_factor");
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    CheckNonEmpty(in, name());
+    int64_t d = in.shape().back();
+    int64_t rows = in.NumElements() / d;
+    if (router_.rank() != 2 || router_.dim(0) != d ||
+        router_.dim(1) != experts_ || w1_.rank() != 3 ||
+        w1_.dim(0) != experts_ || w1_.dim(1) != d ||
+        w1_.dim(2) != hidden_ || w2_.rank() != 3 ||
+        w2_.dim(0) != experts_ || w2_.dim(1) != hidden_ ||
+        w2_.dim(2) != d ||
+        b1_.NumElements() != experts_ * hidden_ ||
+        b2_.NumElements() != experts_ * d)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    std::vector<float> logits(static_cast<size_t>(rows * experts_));
+    Gemm(in.data(), router_.data(), logits.data(), rows, d, experts_,
+         false);
+    ApplyActivation(Act::kSoftmax, logits.data(), rows, experts_);
+    // double math to match the Python oracle's capacity() exactly —
+    // float32 rounding can flip the ceil() by one
+    const int64_t cap = std::max<int64_t>(
+        1, static_cast<int64_t>(std::ceil(
+               static_cast<double>(capacity_factor_) * rows /
+               experts_)));
+    std::vector<int64_t> seen(static_cast<size_t>(experts_), 0);
+    *out = in;
+    if (!residual_)
+      std::fill_n(out->data(), rows * d, 0.0f);
+    std::vector<float> h(static_cast<size_t>(hidden_));
+    for (int64_t t = 0; t < rows; ++t) {
+      const float* probs = logits.data() + t * experts_;
+      int64_t e = 0;
+      for (int64_t j = 1; j < experts_; ++j)
+        if (probs[j] > probs[e]) e = j;
+      if (seen[e] >= cap) continue;        // dropped: residual only
+      ++seen[e];
+      const float gate = probs[e];
+      const float* x = in.data() + t * d;
+      const float* w1 = w1_.data() + e * d * hidden_;
+      const float* b1 = b1_.data() + e * hidden_;
+      const float* w2 = w2_.data() + e * hidden_ * d;
+      const float* b2 = b2_.data() + e * d;
+      for (int64_t j = 0; j < hidden_; ++j) {
+        float acc = b1[j];
+        for (int64_t i = 0; i < d; ++i) acc += x[i] * w1[i * hidden_ + j];
+        h[j] = std::max(acc, 0.0f);
+      }
+      float* y = out->data() + t * d;
+      for (int64_t i = 0; i < d; ++i) {
+        float acc = b2[i];
+        for (int64_t j = 0; j < hidden_; ++j)
+          acc += h[j] * w2[j * d + i];
+        y[i] += gate * acc;
+      }
+    }
+  }
+
+ private:
+  Tensor router_, w1_, b1_, w2_, b2_;
+  int64_t experts_ = 0, hidden_ = 0;
+  double capacity_factor_ = 2.0;
+  bool residual_ = true;
+};
+
+VELES_REGISTER_UNIT("moe_ffn", MoEFFN)
+
+// Fused stack of L post-LN transformer blocks with stacked (L, ...)
+// parameters (veles/znicz_tpu/ops/transformer_stack.py): per layer
+// MHA(+residual) -> LN -> FFN(+residual) -> LN, on the shared
+// AttentionRows / LayerNormRows / FFNRows cores.
+class TransformerStack : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    static const char* kParams[] = {
+        "weights", "bias", "weights_out", "bias_out", "ln1_g",
+        "ln1_b", "ffn_w1", "ffn_b1", "ffn_w2", "ffn_b2", "ln2_g",
+        "ln2_b"};
+    for (const char* p : kParams)
+      params_[p] = npy::Load(ResolvePath(dir, spec.at(p).AsString()));
+    const json::Value& cfg = spec.at("config");
+    layers_ = CheckDim(cfg.at("layers").AsInt(), name(), "layers");
+    heads_ = CheckDim(cfg.at("heads").AsInt(), name(), "heads");
+    hidden_ = CheckDim(cfg.at("hidden").AsInt(), name(), "hidden");
+    causal_ = cfg.at("causal").AsBool();
+    eps_ = static_cast<float>(cfg.at("eps").AsDouble());
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    if (in.rank() != 3)
+      throw std::runtime_error(name() + ": stack input must be "
+                               "(B, S, D), got " + in.ShapeString());
+    CheckNonEmpty(in, name());
+    int64_t b = in.dim(0), s = in.dim(1), d = in.dim(2);
+    if (d % heads_)
+      throw std::runtime_error(name() + ": dim % heads != 0");
+    CheckStacked("weights", d, 3 * d);
+    CheckStacked("weights_out", d, d);
+    CheckStacked("ffn_w1", d, hidden_);
+    CheckStacked("ffn_w2", hidden_, d);
+    CheckStackedVec("bias", 3 * d);
+    CheckStackedVec("bias_out", d);
+    CheckStackedVec("ln1_g", d);
+    CheckStackedVec("ln1_b", d);
+    CheckStackedVec("ffn_b1", hidden_);
+    CheckStackedVec("ffn_b2", d);
+    CheckStackedVec("ln2_g", d);
+    CheckStackedVec("ln2_b", d);
+    int64_t rows = b * s;
+    *out = in;
+    std::vector<float> tmp(static_cast<size_t>(rows * d));
+    for (int64_t l = 0; l < layers_; ++l) {
+      AttentionRows(out->data(), tmp.data(),
+                    At("weights", l, d * 3 * d),
+                    At("bias", l, 3 * d),
+                    At("weights_out", l, d * d),
+                    At("bias_out", l, d), b, s, d, heads_, causal_,
+                    /*residual=*/true);
+      LayerNormRows(tmp.data(), At("ln1_g", l, d), At("ln1_b", l, d),
+                    rows, d, eps_);
+      FFNRows(tmp.data(), out->data(), At("ffn_w1", l, d * hidden_),
+              At("ffn_b1", l, hidden_), At("ffn_w2", l, hidden_ * d),
+              At("ffn_b2", l, d), rows, d, hidden_,
+              /*residual=*/true);
+      LayerNormRows(out->data(), At("ln2_g", l, d),
+                    At("ln2_b", l, d), rows, d, eps_);
+    }
+  }
+
+ private:
+  const float* At(const char* p, int64_t layer, int64_t stride) const {
+    return params_.at(p).data() + layer * stride;
+  }
+  void CheckStacked(const char* p, int64_t r, int64_t c) const {
+    const Tensor& t = params_.at(p);
+    if (t.rank() != 3 || t.dim(0) != layers_ || t.dim(1) != r ||
+        t.dim(2) != c)
+      throw std::runtime_error(name() + ": bad shape for " +
+                               std::string(p));
+  }
+  void CheckStackedVec(const char* p, int64_t n) const {
+    const Tensor& t = params_.at(p);
+    if (t.rank() != 2 || t.dim(0) != layers_ || t.dim(1) != n)
+      throw std::runtime_error(name() + ": bad shape for " +
+                               std::string(p));
+  }
+
+  std::map<std::string, Tensor> params_;
+  int64_t layers_ = 0, heads_ = 0, hidden_ = 0;
+  bool causal_ = true;
+  float eps_ = 1e-5f;
+};
+
+VELES_REGISTER_UNIT("transformer_stack", TransformerStack)
 
 // -- pass-through + standalone activations -------------------------------
 

@@ -191,3 +191,56 @@ def test_export_rejects_unsupported(tmp_path):
         KohonenForward(wf, shape=(4, 4)))
     with pytest.raises(ValueError, match="no C\\+\\+ engine"):
         wf.export_inference(os.path.join(tmp_path, "bad"))
+
+
+def _train_lm_variant(name, model_extra, seed):
+    prng.seed_all(seed)
+    from veles.znicz_tpu.models import transformer_lm
+    saved = root.lm.loader.to_dict()
+    saved_model = root.lm.model.to_dict()
+    root.lm.loader.update({"minibatch_size": 16, "n_train": 64,
+                           "n_valid": 32, "seq_len": 12})
+    root.lm.model.update({"dim": 16, "heads": 4, "layers": 2,
+                          "ffn_hidden": 32})
+    root.lm.model.update(model_extra)
+    root.lm.decision.max_epochs = 1
+    try:
+        wf = transformer_lm.create_workflow(name=name)
+        wf.initialize(device="numpy")
+        wf.run()
+    finally:
+        root.lm.loader.update(saved)
+        root.lm.model.update(saved_model)
+        root.lm.decision.max_epochs = 8
+    return wf
+
+
+def _lm_oracle_vs_engine(engine, tmp_path, wf, archive_name):
+    archive = os.path.join(tmp_path, archive_name)
+    wf.export_inference(archive)
+    ids = numpy.array(wf.loader.minibatch_data.map_read().mem,
+                      numpy.int32)
+    wf.loader.minibatch_data.map_invalidate()
+    wf.loader.minibatch_data.mem[...] = ids
+    for f in wf.forwards:
+        f.numpy_run()
+    expected = numpy.array(wf.forwards[-1].output.map_read().mem)
+    got = _run_infer(engine, archive, ids, str(tmp_path))
+    assert got.shape == expected.shape
+    numpy.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_moe_lm_matches_oracle(engine, tmp_path):
+    """The MoE LM (top-1 routing incl. the capacity-drop rule) runs
+    forward in C++ and matches the numpy oracle exactly."""
+    wf = _train_lm_variant(
+        "CxxMoE", {"moe_experts": 4, "moe_capacity_factor": 1.0},
+        seed=77)
+    _lm_oracle_vs_engine(engine, tmp_path, wf, "moe_archive")
+
+
+def test_stacked_lm_matches_oracle(engine, tmp_path):
+    """The fused transformer_stack unit (stacked per-layer params)
+    runs forward in C++ and matches the numpy oracle."""
+    wf = _train_lm_variant("CxxStack", {"stacked": True}, seed=78)
+    _lm_oracle_vs_engine(engine, tmp_path, wf, "stack_archive")

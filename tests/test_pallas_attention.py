@@ -96,6 +96,9 @@ def test_lm_trains_with_pallas_attention():
     from veles.config import root
     prng.seed_all(4242)
     from veles.znicz_tpu.models import transformer_lm
+    saved_loader = root.lm.loader.to_dict()
+    saved_model = root.lm.model.to_dict()
+    saved_epochs = root.lm.decision.get("max_epochs")
     root.lm.loader.update({"minibatch_size": 32, "n_train": 256,
                            "n_valid": 64, "seq_len": 16, "vocab": 8,
                            "max_period": 4})
@@ -106,9 +109,13 @@ def test_lm_trains_with_pallas_attention():
     root.lm.decision.max_epochs = 5
     root.lm.parallel.update({"seq": 1, "model": 1, "data": 1,
                              "expert": 1, "pipe": 1})
-    wf = transformer_lm.create_workflow(name="PallasLM")
-    wf.initialize(device="xla")
-    wf.run()
-    root.lm.model.update({"attn_impl": None, "attn_block": None})
+    try:
+        wf = transformer_lm.create_workflow(name="PallasLM")
+        wf.initialize(device="xla")
+        wf.run()
+    finally:
+        root.lm.loader.update(saved_loader)
+        root.lm.model.update(saved_model)
+        root.lm.decision.max_epochs = saved_epochs
     hist = [h["validation"]["metric"] for h in wf.decision.history]
     assert hist[-1] < hist[0], hist

@@ -30,7 +30,7 @@ ENGINE_TYPES = frozenset({
     "activation_tanh", "activation_relu", "activation_str",
     "activation_sigmoid",
     "embedding", "layernorm", "token_dense", "token_dense_relu",
-    "transformer_ffn", "attention",
+    "transformer_ffn", "attention", "moe_ffn", "transformer_stack",
 })
 
 
@@ -74,6 +74,9 @@ def _unit_spec(unit, path):
     from veles.znicz_tpu.ops.all2all import All2AllBase
     from veles.znicz_tpu.ops.attention import (
         MultiHeadAttention, TokenDenseBase, TransformerFFN)
+    from veles.znicz_tpu.ops.moe import MoEFFN
+    from veles.znicz_tpu.ops.transformer_stack import (
+        TransformerBlockStack)
     from veles.znicz_tpu.ops.conv import ConvBase
     from veles.znicz_tpu.ops.embedding import EmbeddingForward
     from veles.znicz_tpu.ops.layernorm import LayerNormForward
@@ -145,6 +148,21 @@ def _unit_spec(unit, path):
         _export_weighted(unit, path, spec)
         _save_extra(unit, path, spec, "weights2")
         _save_extra(unit, path, spec, "bias2")
+    elif isinstance(unit, MoEFFN):
+        spec["config"].update({
+            "experts": int(unit.experts), "hidden": int(unit.hidden),
+            "residual": bool(unit.residual),
+            "capacity_factor": float(unit.capacity_factor)})
+        _export_weighted(unit, path, spec)
+        for extra in ("weights2", "bias2", "router"):
+            _save_extra(unit, path, spec, extra)
+    elif isinstance(unit, TransformerBlockStack):
+        spec["config"].update({
+            "layers": int(unit.layers), "heads": int(unit.heads),
+            "hidden": int(unit.hidden), "causal": bool(unit.causal),
+            "eps": float(unit.eps)})
+        for pname in unit.PARAMS:
+            _save_extra(unit, path, spec, pname)
     elif isinstance(unit, TokenDenseBase):
         spec["config"]["output_features"] = int(unit.output_features)
         _export_weighted(unit, path, spec)
