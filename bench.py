@@ -151,21 +151,45 @@ def xla_cifar_images_per_sec(measure_chunks=1):
         measure_chunks=measure_chunks)
 
 
-def lm_tokens_per_sec(measure_chunks=1):
-    """Transformer-LM training throughput (tokens/sec) on the XLA
-    device — the north star's NEW config (BASELINE config #5)."""
+def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
+                   measure_chunks):
+    """Shared LM bench scaffold: save/override/restore the LM config
+    AND the engine compute dtype, then time dispatch chunks.
+
+    Runs with float32 compute dtype: measured on v5e, the bf16 matmul
+    casts cost the transformer units ~4% at 57M scale and ~30% at toy
+    scale (cast traffic dominates small matmuls), while the conv stack
+    gains — so each bench pins the measured-best engine config, as a
+    user would via ``root.common.engine.compute_dtype``."""
     from veles.loader.base import CLASS_TRAIN
     from veles.config import root
     from veles.znicz_tpu.models import transformer_lm
-    root.lm.loader.update({"minibatch_size": 64, "n_train": 2048,
-                           "n_valid": 256, "seq_len": 128})
+    saved_loader = root.lm.loader.to_dict()
+    saved_model = root.lm.model.to_dict()
+    saved_dtype = root.common.engine.get("compute_dtype")
+    root.lm.loader.update(loader_cfg)
+    root.lm.model.update(model_cfg)
+    root.common.engine.compute_dtype = "float32"
     seq = root.lm.loader.seq_len
-    return _xla_throughput(
-        transformer_lm.create_workflow, root.lm,
-        lambda ld: int(ld.minibatch_size) * seq
-        if ld.minibatch_class == CLASS_TRAIN else 0,
-        epochs_per_dispatch=8, name="BenchLM",
-        measure_chunks=measure_chunks)
+    try:
+        return _xla_throughput(
+            transformer_lm.create_workflow, root.lm,
+            lambda ld: int(ld.minibatch_size) * seq
+            if ld.minibatch_class == CLASS_TRAIN else 0,
+            epochs_per_dispatch=epochs_per_dispatch, name=name,
+            measure_chunks=measure_chunks)
+    finally:
+        root.lm.loader.update(saved_loader)
+        root.lm.model.update(saved_model)
+        root.common.engine.compute_dtype = saved_dtype
+
+
+def lm_tokens_per_sec(measure_chunks=1):
+    """Transformer-LM training throughput (tokens/sec) on the XLA
+    device — the north star's NEW config (BASELINE config #5)."""
+    return _lm_throughput(
+        {"minibatch_size": 64, "n_train": 2048, "n_valid": 256,
+         "seq_len": 128}, {}, "BenchLM", 8, measure_chunks)
 
 
 def lm_scale_tokens_per_sec(measure_chunks=1):
@@ -173,21 +197,12 @@ def lm_scale_tokens_per_sec(measure_chunks=1):
     dim 768, 12 heads, 8 layers, ffn 3072, S=512, flash attn_block
     128) — the recorded large-model number (BASELINE.md 'Transformer
     LM at scale')."""
-    from veles.loader.base import CLASS_TRAIN
-    from veles.config import root
-    from veles.znicz_tpu.models import transformer_lm
-    root.lm.loader.update({"minibatch_size": 16, "n_train": 256,
-                           "n_valid": 32, "seq_len": 512,
-                           "vocab": 32, "max_period": 8})
-    root.lm.model.update({"dim": 768, "heads": 12, "layers": 8,
-                          "ffn_hidden": 3072, "attn_block": 128})
-    seq = root.lm.loader.seq_len
-    return _xla_throughput(
-        transformer_lm.create_workflow, root.lm,
-        lambda ld: int(ld.minibatch_size) * seq
-        if ld.minibatch_class == CLASS_TRAIN else 0,
-        epochs_per_dispatch=1, name="BenchLMScale",
-        measure_chunks=measure_chunks)
+    return _lm_throughput(
+        {"minibatch_size": 16, "n_train": 256, "n_valid": 32,
+         "seq_len": 512, "vocab": 32, "max_period": 8},
+        {"dim": 768, "heads": 12, "layers": 8, "ffn_hidden": 3072,
+         "attn_block": 128},
+        "BenchLMScale", 1, measure_chunks)
 
 
 def main():

@@ -207,6 +207,13 @@ class FlowContext:
         return jnp.matmul(a.astype(cd), b.astype(cd),
                           preferred_element_type=jnp.float32)
 
+    def einsum(self, spec, *ops):
+        """MXU-friendly einsum: same dtype contract as :meth:`dot`."""
+        import jax.numpy as jnp
+        cd = self._compiler.device.compute_dtype
+        return jnp.einsum(spec, *[o.astype(cd) for o in ops],
+                          preferred_element_type=jnp.float32)
+
 
 def _resolve_link(unit, attr):
     """Follow LinkableAttribute aliases to the producing (unit, attr)."""

@@ -56,8 +56,8 @@ class TokenDenseBase(Forward):
         if not self.output or self.output.shape != oshape:
             self.output.reset(numpy.zeros(oshape, numpy.float32))
 
-    def _forward(self, xp, x, w, b):
-        v = x @ w
+    def _forward(self, xp, x, w, b, dot):
+        v = dot(x, w)
         if self.include_bias:
             v = v + b
         return A.ACTIVATIONS[self.ACTIVATION][0](xp, v)
@@ -67,14 +67,15 @@ class TokenDenseBase(Forward):
         b = self.bias.map_read().mem if self.include_bias else None
         self.output.map_invalidate()
         self.output.mem[...] = self._forward(
-            numpy, x, self.weights.map_read().mem, b)
+            numpy, x, self.weights.map_read().mem, b, numpy.matmul)
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
         p = ctx.unit_params(self)
         ctx.set(self, "output",
-                self._forward(jnp, x, p["weights"], p.get("bias"))
+                self._forward(jnp, x, p["weights"], p.get("bias"),
+                              ctx.dot)
                 .astype(jnp.float32))
 
 
@@ -91,14 +92,14 @@ class TokenDenseRELU(TokenDenseBase):
 class GDTokenDenseBase(GradientDescentBase):
     ACTIVATION = "linear"
 
-    def _backward(self, xp, x, y, w, err):
+    def _backward(self, xp, x, y, w, err, dot):
         d = A.ACTIVATIONS[self.ACTIVATION][1](xp, y)
         dz = err if isinstance(d, float) else err * d
         x2 = x.reshape(-1, x.shape[-1])
         dz2 = dz.reshape(-1, dz.shape[-1])
-        grad_w = x2.T @ dz2
+        grad_w = dot(x2.T, dz2)
         grad_b = dz2.sum(axis=0) if self.include_bias else None
-        dx = (dz @ w.T) if self.need_err_input else None
+        dx = dot(dz, w.T) if self.need_err_input else None
         return dx, grad_w, grad_b
 
     def numpy_run(self):
@@ -108,7 +109,8 @@ class GDTokenDenseBase(GradientDescentBase):
         err = numpy.asarray(self.err_output.map_read().mem,
                             numpy.float32).reshape(y.shape)
         dx, gw, gb = self._backward(numpy, x, y,
-                                    f.weights.map_read().mem, err)
+                                    f.weights.map_read().mem, err,
+                                    numpy.matmul)
         if dx is not None:
             self.err_input.map_invalidate()
             self.err_input.mem[...] = dx
@@ -121,7 +123,7 @@ class GDTokenDenseBase(GradientDescentBase):
         y = ctx.get(f, "output")
         err = ctx.get(self, "err_output").reshape(y.shape)
         dx, gw, gb = self._backward(
-            jnp, x, y, ctx.unit_params(f)["weights"], err)
+            jnp, x, y, ctx.unit_params(f)["weights"], err, ctx.dot)
         if dx is not None:
             ctx.set(self, "err_input", dx.astype(jnp.float32))
         self.update_weights_xla(ctx, gw, gb)
@@ -173,9 +175,9 @@ class TransformerFFN(Forward):
             self.output.reset(
                 numpy.zeros(self.input.shape, numpy.float32))
 
-    def _forward(self, xp, x, w1, b1, w2, b2):
-        hcur = A.ACTIVATIONS[self.ACTIVATION][0](xp, x @ w1 + b1)
-        y = hcur @ w2 + b2
+    def _forward(self, xp, x, w1, b1, w2, b2, dot):
+        hcur = A.ACTIVATIONS[self.ACTIVATION][0](xp, dot(x, w1) + b1)
+        y = dot(hcur, w2) + b2
         if self.residual:
             y = y + x
         return y, hcur
@@ -185,7 +187,8 @@ class TransformerFFN(Forward):
         y, hcur = self._forward(
             numpy, x, self.weights.map_read().mem,
             self.bias.map_read().mem,
-            self.weights2.map_read().mem, self.bias2.map_read().mem)
+            self.weights2.map_read().mem, self.bias2.map_read().mem,
+            numpy.matmul)
         self.output.map_invalidate()
         self.output.mem[...] = y
         self._cache_h = hcur
@@ -195,7 +198,7 @@ class TransformerFFN(Forward):
         x = ctx.get(self, "input")
         p = ctx.unit_params(self)
         y, hcur = self._forward(jnp, x, p["weights"], p["bias"],
-                                p["weights2"], p["bias2"])
+                                p["weights2"], p["bias2"], ctx.dot)
         ctx.set(self, "output", y.astype(jnp.float32))
         ctx.set(self, "cache_h", hcur)
 
@@ -204,16 +207,16 @@ class TransformerFFN(Forward):
 class GDTransformerFFN(GradientDescentBase):
     EXTRA_PARAMS = (("weights2", False), ("bias2", True))
 
-    def _backward(self, xp, x, w1, w2, hcur, err):
+    def _backward(self, xp, x, w1, w2, hcur, err, dot):
         f = self.forward
         d = x.shape[-1]
-        dh = err @ w2.T
+        dh = dot(err, w2.T)
         dh = dh * A.ACTIVATIONS[f.ACTIVATION][1](xp, hcur)
-        gw2 = hcur.reshape(-1, f.hidden).T @ err.reshape(-1, d)
+        gw2 = dot(hcur.reshape(-1, f.hidden).T, err.reshape(-1, d))
         gb2 = err.reshape(-1, d).sum(axis=0)
-        gw1 = x.reshape(-1, d).T @ dh.reshape(-1, f.hidden)
+        gw1 = dot(x.reshape(-1, d).T, dh.reshape(-1, f.hidden))
         gb1 = dh.reshape(-1, f.hidden).sum(axis=0)
-        dx = dh @ w1.T
+        dx = dot(dh, w1.T)
         if f.residual:
             dx = dx + err
         return dx, gw1, gb1, gw2, gb2
@@ -225,7 +228,7 @@ class GDTransformerFFN(GradientDescentBase):
                             numpy.float32).reshape(x.shape)
         dx, gw1, gb1, gw2, gb2 = self._backward(
             numpy, x, f.weights.map_read().mem,
-            f.weights2.map_read().mem, f._cache_h, err)
+            f.weights2.map_read().mem, f._cache_h, err, numpy.matmul)
         if self.need_err_input:
             self.err_input.map_invalidate()
             self.err_input.mem[...] = dx
@@ -240,7 +243,7 @@ class GDTransformerFFN(GradientDescentBase):
         p = ctx.unit_params(f)
         hcur = ctx.get(f, "cache_h")
         dx, gw1, gb1, gw2, gb2 = self._backward(
-            jnp, x, p["weights"], p["weights2"], hcur, err)
+            jnp, x, p["weights"], p["weights2"], hcur, err, ctx.dot)
         if self.need_err_input:
             ctx.set(self, "err_input", dx.astype(jnp.float32))
         self.update_weights_xla(ctx, gw1, gb1)
@@ -255,28 +258,33 @@ class GDTransformerFFN(GradientDescentBase):
 # (parallel/pipeline.py). q/k/v: (B, H, S, dh).
 
 
-def dense_attention_core_fwd(xp, q, k, v, causal, scale):
-    """(probs, ctx) with ctx = softmax(qkᵀ·scale [+ causal mask])·v."""
+def dense_attention_core_fwd(xp, q, k, v, causal, scale, dot=None):
+    """(probs, ctx) with ctx = softmax(qkᵀ·scale [+ causal mask])·v.
+    ``dot``: matmul implementation (``ctx.dot`` on the traced path for
+    bf16 MXU inputs; defaults to the plain xp matmul)."""
+    dot = dot or xp.matmul
     s = q.shape[2]
-    scores = (q @ k.transpose(0, 1, 3, 2)) * scale
+    scores = dot(q, k.transpose(0, 1, 3, 2)) * scale
     if causal:
         mask = xp.asarray(
             numpy.triu(numpy.full((s, s), -1e9, numpy.float32), 1))
         scores = scores + mask
     probs = A.softmax(xp, scores)
-    return probs, probs @ v
+    return probs, dot(probs, v)
 
 
-def dense_attention_core_bwd(xp, q, k, v, probs, dctx, scale):
+def dense_attention_core_bwd(xp, q, k, v, probs, dctx, scale,
+                             dot=None):
     """Backward of the core: (dq, dk, dv). The causal mask needs no
     re-application — masked probs are exactly zero."""
-    dprobs = dctx @ v.transpose(0, 1, 3, 2)
-    dv = probs.transpose(0, 1, 3, 2) @ dctx
+    dot = dot or xp.matmul
+    dprobs = dot(dctx, v.transpose(0, 1, 3, 2))
+    dv = dot(probs.transpose(0, 1, 3, 2), dctx)
     dscores = probs * (dprobs - (dprobs * probs)
                        .sum(axis=-1, keepdims=True))
     dscores = dscores * scale
-    dq = dscores @ k
-    dk = dscores.transpose(0, 1, 3, 2) @ q
+    dq = dot(dscores, k)
+    dk = dot(dscores.transpose(0, 1, 3, 2), q)
     return dq, dk, dv
 
 
@@ -356,10 +364,11 @@ class MultiHeadAttention(Forward):
         b, h, s, dh = t.shape
         return t.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
 
-    def _fwd_core(self, xp, x, w, bqkv, wo, bo):
+    def _fwd_core(self, xp, x, w, bqkv, wo, bo, dot=None):
+        dot = dot or xp.matmul
         b, s, d = x.shape
         dh = d // self.heads
-        qkv = x @ w
+        qkv = dot(x, w)
         if self.include_bias:
             qkv = qkv + bqkv
         q = self._split(qkv[..., :d])
@@ -367,9 +376,9 @@ class MultiHeadAttention(Forward):
         v = self._split(qkv[..., 2 * d:])
         scale = numpy.float32(1.0 / numpy.sqrt(dh))
         probs, ctx = dense_attention_core_fwd(
-            xp, q, k, v, self.causal, scale)
+            xp, q, k, v, self.causal, scale, dot)
         merged = self._merge(ctx)
-        y = merged @ wo
+        y = dot(merged, wo)
         if self.include_bias:
             y = y + bo
         if self.residual:
@@ -392,48 +401,49 @@ class MultiHeadAttention(Forward):
         x = ctx.get(self, "input")
         p = ctx.unit_params(self)
         if self.seq_mesh is not None:
-            y, cache = self._fwd_ring(jnp, x, p)
+            y, cache = self._fwd_ring(jnp, x, p, ctx.dot)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
         elif self.attn_impl == "pallas":
-            y, cache = self._fwd_pallas(jnp, x, p)
+            y, cache = self._fwd_pallas(jnp, x, p, ctx.dot)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
         elif self.attn_block_size:
-            y, cache = self._fwd_blocked(jnp, x, p)
+            y, cache = self._fwd_blocked(jnp, x, p, ctx.dot)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
         else:
             y, cache = self._fwd_core(
                 jnp, x, p["weights"], p.get("bias"), p["weights_out"],
-                p.get("bias_out"))
+                p.get("bias_out"), ctx.dot)
             names = ("q", "k", "v", "probs", "merged")
         ctx.set(self, "output", y.astype(jnp.float32))
         for name, t in zip(names, cache):
             ctx.set(self, "cache_" + name, t)
 
-    def _project_qkv(self, x, p):
+    def _project_qkv(self, x, p, dot):
         d = x.shape[-1]
-        qkv = x @ p["weights"]
+        qkv = dot(x, p["weights"])
         if self.include_bias:
             qkv = qkv + p["bias"]
         return (self._split(qkv[..., :d]),
                 self._split(qkv[..., d:2 * d]),
                 self._split(qkv[..., 2 * d:]))
 
-    def _finish(self, x, merged, p):
-        y = merged @ p["weights_out"]
+    def _finish(self, x, merged, p, dot):
+        y = dot(merged, p["weights_out"])
         if self.include_bias:
             y = y + p["bias_out"]
         if self.residual:
             y = y + x
         return y
 
-    def _fwd_blocked(self, xp, x, p):
+    def _fwd_blocked(self, xp, x, p, dot):
         """Single-chip flash-style forward: O(S·block) score memory."""
         from veles.znicz_tpu.parallel import flash
-        q, k, v = self._project_qkv(x, p)
+        q, k, v = self._project_qkv(x, p, dot)
         out_heads, lse = flash.blocked_attention_fwd(
-            q, k, v, causal=self.causal, block=self.attn_block_size)
+            q, k, v, causal=self.causal, block=self.attn_block_size,
+            dot=dot)
         merged = self._merge(out_heads)
-        y = self._finish(x, merged, p)
+        y = self._finish(x, merged, p, dot)
         return y, (q, k, v, out_heads, lse, merged)
 
     def _pallas_block(self):
@@ -451,27 +461,27 @@ class MultiHeadAttention(Forward):
         return max(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
                    if s % b == 0)
 
-    def _fwd_pallas(self, xp, x, p):
+    def _fwd_pallas(self, xp, x, p, dot):
         """Flash forward on the hand-written Pallas TPU kernel."""
         from veles.znicz_tpu.parallel import pallas_attention as PA
         blk = self._pallas_block()
-        q, k, v = self._project_qkv(x, p)
+        q, k, v = self._project_qkv(x, p, dot)
         out_heads, lse = PA.flash_attention_fwd(
             q, k, v, causal=self.causal, block_q=blk, block_k=blk)
         merged = self._merge(out_heads)
-        y = self._finish(x, merged, p)
+        y = self._finish(x, merged, p, dot)
         return y, (q, k, v, out_heads, lse, merged)
 
-    def _fwd_ring(self, xp, x, p):
+    def _fwd_ring(self, xp, x, p, dot):
         """Sequence-parallel forward: qkv projection under
         auto-sharding, attention proper via the ppermute ring."""
         from veles.znicz_tpu.parallel import ring
-        q, k, v = self._project_qkv(x, p)
+        q, k, v = self._project_qkv(x, p, dot)
         out_heads, lse = ring.ring_self_attention(
             q, k, v, self.seq_mesh, axis=self.seq_axis,
             causal=self.causal, batch_axis=self.seq_batch_axis)
         merged = self._merge(out_heads)
-        y = self._finish(x, merged, p)
+        y = self._finish(x, merged, p, dot)
         return y, (q, k, v, out_heads, lse, merged)
 
 
@@ -481,24 +491,25 @@ class GDMultiHeadAttention(GradientDescentBase):
 
     EXTRA_PARAMS = (("weights_out", False), ("bias_out", True))
 
-    def _bwd_core(self, xp, x, w, wo, cache, err):
+    def _bwd_core(self, xp, x, w, wo, cache, err, dot=None):
+        dot = dot or xp.matmul
         f = self.forward
         b, s, d = x.shape
         dh = d // f.heads
         q, k, v, probs, merged = cache
         scale = numpy.float32(1.0 / numpy.sqrt(dh))
 
-        gwo = merged.reshape(-1, d).T @ err.reshape(-1, d)
+        gwo = dot(merged.reshape(-1, d).T, err.reshape(-1, d))
         gbo = err.reshape(-1, d).sum(axis=0)
-        dmerged = err @ wo.T
+        dmerged = dot(err, wo.T)
         dctx = f._split(dmerged)                       # (B,H,S,dh)
         dq, dk, dv = dense_attention_core_bwd(
-            xp, q, k, v, probs, dctx, scale)
+            xp, q, k, v, probs, dctx, scale, dot)
         dqkv = xp.concatenate(
             [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
-        gw = x.reshape(-1, d).T @ dqkv.reshape(-1, 3 * d)
+        gw = dot(x.reshape(-1, d).T, dqkv.reshape(-1, 3 * d))
         gb = dqkv.reshape(-1, 3 * d).sum(axis=0)
-        dx = dqkv @ w.T
+        dx = dot(dqkv, w.T)
         if f.residual:
             dx = dx + err
         return dx, gw, gb, gwo, gbo
@@ -526,19 +537,20 @@ class GDMultiHeadAttention(GradientDescentBase):
         projection grads + residual."""
         f = self.forward
         d = x.shape[-1]
+        dot = ctx.dot
         q, k, v, out_heads, lse, merged = (
             ctx.get(f, "cache_" + n)
             for n in ("q", "k", "v", "out_heads", "lse", "merged"))
-        gwo = merged.reshape(-1, d).T @ err.reshape(-1, d)
+        gwo = dot(merged.reshape(-1, d).T, err.reshape(-1, d))
         gbo = err.reshape(-1, d).sum(axis=0)
-        dmerged = err @ p["weights_out"].T
+        dmerged = dot(err, p["weights_out"].T)
         dctx = f._split(dmerged)
         dq, dk, dv = attn_bwd(q, k, v, out_heads, lse, dctx)
         dqkv = xp.concatenate(
             [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
-        gw = x.reshape(-1, d).T @ dqkv.reshape(-1, 3 * d)
+        gw = dot(x.reshape(-1, d).T, dqkv.reshape(-1, 3 * d))
         gb = dqkv.reshape(-1, 3 * d).sum(axis=0)
-        dx = dqkv @ p["weights"].T
+        dx = dot(dqkv, p["weights"].T)
         if f.residual:
             dx = dx + err
         return dx, gw, gb, gwo, gbo
@@ -562,7 +574,7 @@ class GDMultiHeadAttention(GradientDescentBase):
             xp, x, p, ctx, err,
             lambda q, k, v, o, lse, dctx: flash.blocked_attention_bwd(
                 q, k, v, o, lse, dctx, causal=f.causal,
-                block=f.attn_block_size))
+                block=f.attn_block_size, dot=ctx.dot))
 
     def _bwd_pallas(self, xp, x, p, ctx, err):
         """Flash backward on the Pallas kernels."""
@@ -593,7 +605,8 @@ class GDMultiHeadAttention(GradientDescentBase):
             cache = tuple(ctx.get(f, "cache_" + n)
                           for n in ("q", "k", "v", "probs", "merged"))
             dx, gw, gb, gwo, gbo = self._bwd_core(
-                jnp, x, p["weights"], p["weights_out"], cache, err)
+                jnp, x, p["weights"], p["weights_out"], cache, err,
+                ctx.dot)
         if self.need_err_input:
             ctx.set(self, "err_input", dx.astype(jnp.float32))
         self.update_weights_xla(ctx, gw, gb if f.include_bias else None)

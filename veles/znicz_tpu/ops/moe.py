@@ -126,22 +126,23 @@ class MoEFFN(Forward):
                     * keep[:, None, None])                # (T, E, C)
         return probs, onehot_e, gate, dispatch
 
-    def _experts_fwd(self, xp, xe, w1, b1, w2, b2):
+    def _experts_fwd(self, xp, xe, w1, b1, w2, b2, es):
         """Batched expert FFN over (E, C, D) slot buffers."""
         h = A.ACTIVATIONS[self.ACTIVATION][0](
-            xp, xp.einsum("ecd,edh->ech", xe, w1) + b1[:, None, :])
-        ye = xp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+            xp, es("ecd,edh->ech", xe, w1) + b1[:, None, :])
+        ye = es("ech,ehd->ecd", h, w2) + b2[:, None, :]
         return h, ye
 
-    def _forward(self, xp, x, p):
+    def _forward(self, xp, x, p, es=None):
+        es = es or xp.einsum
         xt = x.reshape(-1, x.shape[-1])
         probs, onehot_e, gate, dispatch = self._route(
             xp, xt, p["router"])
-        xe = xp.einsum("tec,td->ecd", dispatch, xt)
+        xe = es("tec,td->ecd", dispatch, xt)
         h, ye = self._experts_fwd(xp, xe, p["weights"], p["bias"],
-                                  p["weights2"], p["bias2"])
+                                  p["weights2"], p["bias2"], es)
         combine = dispatch * gate[:, None, None]
-        yt = xp.einsum("tec,ecd->td", combine, ye)
+        yt = es("tec,ecd->td", combine, ye)
         y = yt.reshape(x.shape)
         if self.residual:
             y = y + x
@@ -161,7 +162,8 @@ class MoEFFN(Forward):
     def xla_run(self, ctx):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
-        y, cache = self._forward(jnp, x, ctx.unit_params(self))
+        y, cache = self._forward(jnp, x, ctx.unit_params(self),
+                                 ctx.einsum)
         ctx.set(self, "output", y.astype(jnp.float32))
         for k, v in cache.items():
             ctx.set(self, "cache_" + k, v)
@@ -185,7 +187,8 @@ class GDMoEFFN(GradientDescentBase):
         out["aux_weight"] = numpy.float32(self.aux_weight)
         return out
 
-    def _backward(self, xp, x, p, cache, err, aux_weight):
+    def _backward(self, xp, x, p, cache, err, aux_weight, es=None):
+        es = es or xp.einsum
         f = self.forward
         d = x.shape[-1]
         xt = x.reshape(-1, d)
@@ -195,20 +198,20 @@ class GDMoEFFN(GradientDescentBase):
         xe, h, ye = cache["xe"], cache["h"], cache["ye"]
         combine = dispatch * gate[:, None, None]
         # combine path
-        dye = xp.einsum("tec,td->ecd", combine, dyt)
-        ysel = xp.einsum("tec,ecd->td", dispatch, ye)
+        dye = es("tec,td->ecd", combine, dyt)
+        ysel = es("tec,ecd->td", dispatch, ye)
         dgate = (ysel * dyt).sum(axis=-1)                 # (T,)
         # expert FFN backward (batched over E)
         w1, w2 = p["weights"], p["weights2"]
-        dh = xp.einsum("ecd,ehd->ech", dye, w2)
+        dh = es("ecd,ehd->ech", dye, w2)
         dh = dh * A.ACTIVATIONS[f.ACTIVATION][1](xp, h)
-        gw2 = xp.einsum("ech,ecd->ehd", h, dye)
+        gw2 = es("ech,ecd->ehd", h, dye)
         gb2 = dye.sum(axis=1)
-        gw1 = xp.einsum("ecd,ech->edh", xe, dh)
+        gw1 = es("ecd,ech->edh", xe, dh)
         gb1 = dh.sum(axis=1)
-        dxe = xp.einsum("ech,edh->ecd", dh, w1)
+        dxe = es("ech,edh->ecd", dh, w1)
         # dispatch path back to tokens
-        dxt = xp.einsum("tec,ecd->td", dispatch, dxe)
+        dxt = es("tec,ecd->td", dispatch, dxe)
         # router: gate = probs at the argmax (differentiable through
         # softmax; assignment itself is straight-through)
         dprobs = onehot_e * dgate[:, None]
@@ -254,7 +257,7 @@ class GDMoEFFN(GradientDescentBase):
                            "xe", "h", "ye")}
         h = ctx.hyper[self.name]
         dx, grads = self._backward(jnp, x, p, cache, err,
-                                   h["aux_weight"])
+                                   h["aux_weight"], ctx.einsum)
         if self.need_err_input:
             ctx.set(self, "err_input", dx.astype(jnp.float32))
         self.update_weights_xla(ctx, grads["weights"], grads["bias"])

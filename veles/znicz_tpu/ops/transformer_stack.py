@@ -113,7 +113,8 @@ class TransformerBlockStack(Forward):
         caches = []
         for i in range(self.layers):
             x, cache = PL.block_fwd(numpy, x, self._layer_params(p, i),
-                                    self.heads, self.causal, self.eps)
+                                    self.heads, self.causal, self.eps,
+                                    numpy.matmul)
             caches.append(cache)
         self.output.map_invalidate()
         self.output.mem[...] = x
@@ -128,10 +129,10 @@ class TransformerBlockStack(Forward):
                 p, x, self.pipe_mesh, axis=self.pipe_axis,
                 batch_axis=self.pipe_batch_axis,
                 n_micro=self.pipe_microbatches, heads=self.heads,
-                causal=self.causal, eps=self.eps)
+                causal=self.causal, eps=self.eps, dot=ctx.dot)
         else:
             y, caches = PL.stack_fwd(p, x, self.heads, self.causal,
-                                     self.eps)
+                                     self.eps, ctx.dot)
         ctx.set(self, "output", y.astype(jnp.float32))
         ctx.set(self, "cache_stack", caches)
 
@@ -157,7 +158,8 @@ class GDTransformerBlockStack(GradientDescentBase):
         d = err
         for i in reversed(range(f.layers)):
             d, g = PL.block_bwd(numpy, f._layer_params(p, i),
-                                f._cache[i], d, f.heads, f.eps)
+                                f._cache[i], d, f.heads, f.eps,
+                                numpy.matmul, numpy.einsum)
             for k, v in g.items():
                 grads[k][i] = v
         if self.need_err_input:
@@ -177,9 +179,11 @@ class GDTransformerBlockStack(GradientDescentBase):
             dx, grads = PL.pipeline_bwd(
                 p, caches, err, f.pipe_mesh, axis=f.pipe_axis,
                 batch_axis=f.pipe_batch_axis,
-                n_micro=f.pipe_microbatches, heads=f.heads, eps=f.eps)
+                n_micro=f.pipe_microbatches, heads=f.heads, eps=f.eps,
+                dot=ctx.dot, es=ctx.einsum)
         else:
-            dx, grads = PL.stack_bwd(p, caches, err, f.heads, f.eps)
+            dx, grads = PL.stack_bwd(p, caches, err, f.heads, f.eps,
+                                     ctx.dot, ctx.einsum)
         if self.need_err_input:
             ctx.set(self, "err_input", dx.astype(jnp.float32))
         self.update_weights_xla(ctx, grads["weights"], grads["bias"])

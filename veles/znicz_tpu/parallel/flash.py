@@ -18,11 +18,13 @@ exactly against the dense formulation in tests.
 import numpy
 
 
-def blocked_attention_fwd(q, k, v, causal=True, block=128):
+def blocked_attention_fwd(q, k, v, causal=True, block=128, dot=None):
     """q/k/v: (B, H, S, dh) → (out, lse); exact softmax(qkᵀ)v with
-    O(S·block) peak score memory. ``block`` must divide S."""
+    O(S·block) peak score memory. ``block`` must divide S. ``dot``:
+    matmul implementation (``ctx.dot`` for bf16 MXU inputs)."""
     import jax.numpy as jnp
     from jax import lax
+    dot = dot or jnp.matmul
 
     b, h, s, dh = q.shape
     if s % block:
@@ -37,7 +39,7 @@ def blocked_attention_fwd(q, k, v, causal=True, block=128):
     def body(carry, xs):
         m, l, acc = carry
         i, k_blk, v_blk = xs
-        sc = (q @ k_blk.transpose(0, 1, 3, 2)) * scale   # (B,H,S,blk)
+        sc = dot(q, k_blk.transpose(0, 1, 3, 2)) * scale  # (B,H,S,blk)
         if causal:
             kpos = i * block + jnp.arange(block)
             mask = (kpos[None, :] > qpos[:, None]) * jnp.float32(-1e9)
@@ -46,7 +48,7 @@ def blocked_attention_fwd(q, k, v, causal=True, block=128):
         p = jnp.exp(sc - m_new[..., None])
         coef = jnp.exp(m - m_new)
         l_new = l * coef + p.sum(axis=-1)
-        acc_new = acc * coef[..., None] + p @ v_blk
+        acc_new = acc * coef[..., None] + dot(p, v_blk)
         return (m_new, l_new, acc_new), None
 
     m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
@@ -60,11 +62,12 @@ def blocked_attention_fwd(q, k, v, causal=True, block=128):
 
 
 def blocked_attention_bwd(q, k, v, out, lse, dout, causal=True,
-                          block=128):
+                          block=128, dot=None):
     """Backward by block recomputation from ``lse``; -> (dq, dk, dv),
     all exact (same formulas as the dense adjoint)."""
     import jax.numpy as jnp
     from jax import lax
+    dot = dot or jnp.matmul
 
     b, h, s, dh = q.shape
     if s % block:
@@ -79,17 +82,17 @@ def blocked_attention_bwd(q, k, v, out, lse, dout, causal=True,
 
     def body(dq, xs):
         i, k_blk, v_blk = xs
-        sc = (q @ k_blk.transpose(0, 1, 3, 2)) * scale
+        sc = dot(q, k_blk.transpose(0, 1, 3, 2)) * scale
         if causal:
             kpos = i * block + jnp.arange(block)
             mask = (kpos[None, :] > qpos[:, None]) * jnp.float32(-1e9)
             sc = sc + mask[None, None, :, :]
         p = jnp.exp(sc - lse[..., None])                  # exact probs
-        dp = dout @ v_blk.transpose(0, 1, 3, 2)
+        dp = dot(dout, v_blk.transpose(0, 1, 3, 2))
         ds = p * (dp - delta[..., None]) * scale
-        dq = dq + ds @ k_blk
-        dk_blk = ds.transpose(0, 1, 3, 2) @ q
-        dv_blk = p.transpose(0, 1, 3, 2) @ dout
+        dq = dq + dot(ds, k_blk)
+        dk_blk = dot(ds.transpose(0, 1, 3, 2), q)
+        dv_blk = dot(p.transpose(0, 1, 3, 2), dout)
         return dq, (dk_blk, dv_blk)
 
     dq, (dks, dvs) = lax.scan(

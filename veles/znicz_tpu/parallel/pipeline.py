@@ -55,33 +55,39 @@ def _merge(t):
     return t.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
 
 
-def block_fwd(xp, x, lp, heads, causal, eps):
+def block_fwd(xp, x, lp, heads, causal, eps, dot=None):
     """One post-LN transformer block. ``lp``: per-layer param dict
     (see ops/transformer_stack.py for shapes). Returns (y, cache).
     Attention/LN formulas are the shared ones from ops/attention.py
-    and ops/layernorm.py — one copy of the math repo-wide."""
+    and ops/layernorm.py — one copy of the math repo-wide. ``dot``:
+    matmul implementation (``ctx.dot`` for bf16 MXU inputs)."""
+    dot = dot or xp.matmul
     b, s, d = x.shape
     dh = d // heads
-    qkv = x @ lp["weights"] + lp["bias"]
+    qkv = dot(x, lp["weights"]) + lp["bias"]
     q = _split(qkv[..., :d], heads)
     k = _split(qkv[..., d:2 * d], heads)
     v = _split(qkv[..., 2 * d:], heads)
     scale = numpy.float32(1.0 / numpy.sqrt(dh))
-    probs, ctx = dense_attention_core_fwd(xp, q, k, v, causal, scale)
+    probs, ctx = dense_attention_core_fwd(xp, q, k, v, causal, scale,
+                                          dot)
     merged = _merge(ctx)
-    a = merged @ lp["weights_out"] + lp["bias_out"] + x
+    a = dot(merged, lp["weights_out"]) + lp["bias_out"] + x
     n1 = ln_fwd(xp, a, lp["ln1_g"], lp["ln1_b"], eps)
-    h = A.ACTIVATIONS[ACT][0](xp, n1 @ lp["ffn_w1"] + lp["ffn_b1"])
-    fo = h @ lp["ffn_w2"] + lp["ffn_b2"] + n1
+    h = A.ACTIVATIONS[ACT][0](xp, dot(n1, lp["ffn_w1"])
+                              + lp["ffn_b1"])
+    fo = dot(h, lp["ffn_w2"]) + lp["ffn_b2"] + n1
     y = ln_fwd(xp, fo, lp["ln2_g"], lp["ln2_b"], eps)
     cache = dict(zip(CACHE_KEYS,
                      (x, q, k, v, probs, merged, a, n1, h, fo)))
     return y, cache
 
 
-def block_bwd(xp, lp, cache, err, heads, eps):
+def block_bwd(xp, lp, cache, err, heads, eps, dot=None, es=None):
     """Backward of :func:`block_fwd`: (dx, grads) with grads keyed
     like the parameter dict."""
+    dot = dot or xp.matmul
+    es = es or xp.einsum
     x, q, k, v, probs, merged, a, n1, h, fo = (
         cache[key] for key in CACHE_KEYS)
     b, s, d = x.shape
@@ -90,27 +96,27 @@ def block_bwd(xp, lp, cache, err, heads, eps):
     # ln2
     dfo, g_ln2g, g_ln2b = ln_bwd(xp, fo, lp["ln2_g"], err, eps)
     # ffn (+ n1 residual)
-    dhid = dfo @ lp["ffn_w2"].T
+    dhid = dot(dfo, lp["ffn_w2"].T)
     dhid = dhid * A.ACTIVATIONS[ACT][1](xp, h)
-    g_w2 = xp.einsum("bsh,bsd->hd", h, dfo)
+    g_w2 = es("bsh,bsd->hd", h, dfo)
     g_b2 = dfo.sum(axis=(0, 1))
-    g_w1 = xp.einsum("bsd,bsh->dh", n1, dhid)
+    g_w1 = es("bsd,bsh->dh", n1, dhid)
     g_b1 = dhid.sum(axis=(0, 1))
-    dn1 = dhid @ lp["ffn_w1"].T + dfo
+    dn1 = dot(dhid, lp["ffn_w1"].T) + dfo
     # ln1
     da, g_ln1g, g_ln1b = ln_bwd(xp, a, lp["ln1_g"], dn1, eps)
     # attention (+ x residual)
-    g_wo = xp.einsum("bsd,bse->de", merged, da)
+    g_wo = es("bsd,bse->de", merged, da)
     g_bo = da.sum(axis=(0, 1))
-    dmerged = da @ lp["weights_out"].T
+    dmerged = dot(da, lp["weights_out"].T)
     dctx = _split(dmerged, heads)
     dq, dk, dv = dense_attention_core_bwd(
-        xp, q, k, v, probs, dctx, scale)
+        xp, q, k, v, probs, dctx, scale, dot)
     dqkv = xp.concatenate(
         [_merge(dq), _merge(dk), _merge(dv)], axis=-1)
-    g_w = xp.einsum("bsd,bse->de", x, dqkv)
+    g_w = es("bsd,bse->de", x, dqkv)
     g_b = dqkv.sum(axis=(0, 1))
-    dx = dqkv @ lp["weights"].T + da
+    dx = dot(dqkv, lp["weights"].T) + da
     grads = {"weights": g_w, "bias": g_b, "weights_out": g_wo,
              "bias_out": g_bo, "ln1_g": g_ln1g, "ln1_b": g_ln1b,
              "ffn_w1": g_w1, "ffn_b1": g_b1, "ffn_w2": g_w2,
@@ -122,27 +128,28 @@ def block_bwd(xp, lp, cache, err, heads, eps):
 # single-program paths: scan over the layer dimension
 
 
-def stack_fwd(params, x, heads, causal, eps):
+def stack_fwd(params, x, heads, causal, eps, dot=None):
     """scan the block over stacked (L, ...) params. Returns (y,
     caches) with cache leaves stacked (L, ...)."""
     import jax.numpy as jnp
     from jax import lax
 
     def step(carry, lp):
-        y, cache = block_fwd(jnp, carry, lp, heads, causal, eps)
+        y, cache = block_fwd(jnp, carry, lp, heads, causal, eps, dot)
         return y, cache
 
     return lax.scan(step, x, params)
 
 
-def stack_bwd(params, caches, err, heads, eps):
+def stack_bwd(params, caches, err, heads, eps, dot=None, es=None):
     """Reverse scan: (dx, grads), grad leaves stacked (L, ...)."""
     import jax.numpy as jnp
     from jax import lax
 
     def step(dcarry, layer):
         lp, cache = layer
-        dx, grads = block_bwd(jnp, lp, cache, dcarry, heads, eps)
+        dx, grads = block_bwd(jnp, lp, cache, dcarry, heads, eps,
+                              dot, es)
         return dx, grads
 
     return lax.scan(step, err, (params, caches), reverse=True)
@@ -152,12 +159,12 @@ def stack_bwd(params, caches, err, heads, eps):
 # the GPipe schedule
 
 
-def _chunk_fwd(params, xin, heads, causal, eps):
-    return stack_fwd(params, xin, heads, causal, eps)
+def _chunk_fwd(params, xin, heads, causal, eps, dot=None):
+    return stack_fwd(params, xin, heads, causal, eps, dot)
 
 
 def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
-                        heads, causal, eps):
+                        heads, causal, eps, dot=None):
     """Per-device GPipe forward. ``params`` leaves (L/P, ...), x_loc
     (b, S, D) with b the data-local batch. Returns (y_loc, caches)
     with cache leaves (M, L/P, b/M, ...)."""
@@ -170,7 +177,7 @@ def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
     bm = b // n_micro
     x_mb = x_loc.reshape(n_micro, bm, s, d)
     run = functools.partial(_chunk_fwd, params, heads=heads,
-                            causal=causal, eps=eps)
+                            causal=causal, eps=eps, dot=dot)
     # allocate the activation stash from the chunk's abstract shapes
     y_shape, cache_shape = jax.eval_shape(
         run, jax.ShapeDtypeStruct((bm, s, d), jnp.float32))
@@ -209,7 +216,8 @@ def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
 
 
 def _pipeline_bwd_local(params, caches, err_loc, *, axis_name,
-                        n_stage, n_micro, heads, eps, batch_axis):
+                        n_stage, n_micro, heads, eps, batch_axis,
+                        dot=None, es=None):
     """Per-device GPipe backward: error microbatches flow LAST stage →
     first; each stage consumes its stashed activations and accumulates
     its own layers' gradients across microbatches."""
@@ -224,7 +232,7 @@ def _pipeline_bwd_local(params, caches, err_loc, *, axis_name,
     perm = [(i, (i - 1) % n_stage) for i in range(n_stage)]
 
     def chunk_bwd(cache_m, derr):
-        return stack_bwd(params, cache_m, derr, heads, eps)
+        return stack_bwd(params, cache_m, derr, heads, eps, dot, es)
 
     gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
 
@@ -275,7 +283,8 @@ def _cache_specs(caches, axis, batch_axis):
 
 
 def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
-                 n_micro=4, heads=4, causal=True, eps=1e-5):
+                 n_micro=4, heads=4, causal=True, eps=1e-5,
+                 dot=None):
     """GPipe forward over ``mesh[axis]``. ``params`` leaves (L, ...)
     sharded on dim 0; x (B, S, D) global. Returns (y, caches)."""
     import jax
@@ -289,7 +298,8 @@ def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
     xspec = P(batch_axis, None, None)
     fn = functools.partial(
         _pipeline_fwd_local, axis_name=axis, n_stage=n_stage,
-        n_micro=n_micro, heads=heads, causal=causal, eps=eps)
+        n_micro=n_micro, heads=heads, causal=causal, eps=eps,
+        dot=dot)
     # shapes of the stash, for out_specs: one chunk's caches (the
     # chunk itself is axis-free, so eval_shape is safe) + the
     # microbatch dim in front
@@ -300,7 +310,7 @@ def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
         lambda a: jax.ShapeDtypeStruct(
             (a.shape[0] // n_stage,) + a.shape[1:], a.dtype), params)
     _, chunk_cache = jax.eval_shape(
-        lambda p, xx: stack_fwd(p, xx, heads, causal, eps),
+        lambda p, xx: stack_fwd(p, xx, heads, causal, eps, dot),
         local_params, jax.ShapeDtypeStruct((bm, s, d), jnp.float32))
     cache_shape = jax.tree_util.tree_map(
         lambda sd: jax.ShapeDtypeStruct((n_micro,) + sd.shape,
@@ -313,7 +323,8 @@ def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
 
 
 def pipeline_bwd(params, caches, err, mesh, axis="pipe",
-                 batch_axis=None, n_micro=4, heads=4, eps=1e-5):
+                 batch_axis=None, n_micro=4, heads=4, eps=1e-5,
+                 dot=None, es=None):
     """GPipe backward: (dx, grads) — dx (B, S, D) global, grad leaves
     (L, ...) sharded on dim 0 like the params."""
     import jax
@@ -325,7 +336,8 @@ def pipeline_bwd(params, caches, err, mesh, axis="pipe",
     cspecs = _cache_specs(caches, axis, batch_axis)
     fn = functools.partial(
         _pipeline_bwd_local, axis_name=axis, n_stage=n_stage,
-        n_micro=n_micro, heads=heads, eps=eps, batch_axis=batch_axis)
+        n_micro=n_micro, heads=heads, eps=eps, batch_axis=batch_axis,
+        dot=dot, es=es)
     sm = _shard_map()
     return sm(fn, mesh=mesh, in_specs=(pspec, cspecs, xspec),
               out_specs=(xspec, pspec))(params, caches, err)
