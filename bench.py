@@ -179,6 +179,8 @@ def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
             epochs_per_dispatch=epochs_per_dispatch, name=name,
             measure_chunks=measure_chunks)
     finally:
+        # full restore: every key the overrides touch exists in the
+        # sample defaults, so Config.update round-trips cleanly
         root.lm.loader.update(saved_loader)
         root.lm.model.update(saved_model)
         root.common.engine.compute_dtype = saved_dtype

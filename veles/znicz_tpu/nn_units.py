@@ -159,7 +159,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
 
     FORWARD = None
     STATE = ("vel_weights", "vel_bias", "acc_weights", "acc_bias",
-             "acc_count", "iteration")
+             "sq_weights", "sq_bias", "acc_count", "iteration")
     #: (param_name, bias_like) for forward parameters BEYOND
     #: weights/bias (attention out-projection, FFN second layer, MoE
     #: router...). Velocity/accumulator Arrays ``vel_<p>``/``acc_<p>``
@@ -173,7 +173,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)
         derived = [n for p, _ in cls.__dict__.get("EXTRA_PARAMS", ())
-                   for n in ("vel_" + p, "acc_" + p)]
+                   for n in ("vel_" + p, "acc_" + p, "sq_" + p)]
         if derived:
             cls.STATE = tuple(cls.STATE) + tuple(
                 n for n in derived if n not in cls.STATE)
@@ -183,6 +183,7 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         for pname, _ in self.EXTRA_PARAMS:
             setattr(self, "vel_" + pname, Array())
             setattr(self, "acc_" + pname, Array())
+            setattr(self, "sq_" + pname, Array())
         self.err_output = None       # linked from the unit after us
         self.err_input = Array()     # produced for the unit before us
         self.forward = None          # paired Forward unit
@@ -197,6 +198,17 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         self.gradient_moment = kwargs.get("gradient_moment", 0.0)
         self.gradient_moment_bias = kwargs.get(
             "gradient_moment_bias", self.gradient_moment)
+        #: update rule: "momentum" (reference semantics, the default)
+        #: or "adam" (AdamW: decoupled weight decay, bias-corrected
+        #: moments; beta1 = gradient_moment — set it to ~0.9 — and
+        #: the L1 mix is momentum-only). ``vel_*`` holds the first
+        #: moment, ``sq_*`` the second.
+        self.solver = kwargs.get("solver", "momentum")
+        if self.solver not in ("momentum", "adam"):
+            raise ValueError("solver must be 'momentum' or 'adam', "
+                             "got %r" % (self.solver,))
+        self.adam_beta2 = float(kwargs.get("adam_beta2", 0.999))
+        self.adam_eps = float(kwargs.get("adam_eps", 1e-8))
         #: host-adjustable multiplier applied AFTER the lr policy
         #: (NNRollback's lr cut uses this: policies like
         #: ArbitraryStepPolicy replace the base lr, so cutting
@@ -215,6 +227,8 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         self.vel_bias = Array()
         self.acc_weights = Array()
         self.acc_bias = Array()
+        self.sq_weights = Array()
+        self.sq_bias = Array()
         self.acc_count = Array()
         #: train-minibatch counter driving the lr schedule (traced STATE
         #: so chunked epoch scans advance it on device)
@@ -263,6 +277,15 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
                 self.acc_count.reset(numpy.zeros((), numpy.int32))
         if not self.iteration:
             self.iteration.reset(numpy.zeros((), numpy.int32))
+        if self.solver == "adam":
+            if f.weights and (not self.sq_weights
+                              or self.sq_weights.shape
+                              != f.weights.shape):
+                self.sq_weights.reset(numpy.zeros_like(f.weights.mem))
+            if f.include_bias and f.bias and (
+                    not self.sq_bias
+                    or self.sq_bias.shape != f.bias.shape):
+                self.sq_bias.reset(numpy.zeros_like(f.bias.mem))
         for pname, _ in self.EXTRA_PARAMS:
             src = getattr(f, pname, None)
             if src is None or not src:
@@ -274,6 +297,10 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
                 acc = getattr(self, "acc_" + pname)
                 if not acc or acc.shape != src.shape:
                     acc.reset(numpy.zeros_like(src.mem))
+            if self.solver == "adam":
+                sq = getattr(self, "sq_" + pname)
+                if not sq or sq.shape != src.shape:
+                    sq.reset(numpy.zeros_like(src.mem))
 
     # hyper-parameters (traced scalars; changing them never retraces) --
 
@@ -288,6 +315,8 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             "moment": numpy.float32(self.gradient_moment),
             "moment_bias": numpy.float32(self.gradient_moment_bias),
             "lr_scale": numpy.float32(self.lr_scale),
+            "beta2": numpy.float32(self.adam_beta2),
+            "adam_eps": numpy.float32(self.adam_eps),
         }
         # ZeroFiller mask rides along as a traced input (not a baked
         # constant) so host-side mask edits reach the compiled step
@@ -306,23 +335,48 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         vel = vel * moment - lr * reg
         return w + vel, vel
 
+    def apply_update_adam(self, xp, w, m, v, grad, lr, beta1, beta2,
+                          eps, l2, step):
+        """AdamW: bias-corrected moments + DECOUPLED weight decay
+        (``l2`` multiplies ``lr·w`` directly, not the gradient).
+        ``step`` counts applied updates from 1."""
+        m = beta1 * m + (1.0 - beta1) * grad
+        v = beta2 * v + (1.0 - beta2) * grad * grad
+        mhat = m / (1.0 - beta1 ** step)
+        vhat = v / (1.0 - beta2 ** step)
+        w = w - lr * (mhat / (xp.sqrt(vhat) + eps) + l2 * w)
+        return w, m, v
+
     def _step_param(self, xp, w, vel, acc, grad, apply_now,
-                    lr, moment, l2, l1_vs_l2):
-        """One (possibly accumulated) parameter step. With gradient
-        accumulation, the update applies only when ``apply_now`` and
-        the accumulator resets; otherwise the gradient just adds up.
-        Returns (w, vel, acc)."""
-        if acc is None:
-            nw, nv = self.apply_update(xp, w, vel, grad, lr, moment,
+                    lr, moment, l2, l1_vs_l2, sq=None, t=0,
+                    beta2=0.999, adam_eps=1e-8):
+        """One (possibly accumulated) parameter step under the
+        configured solver. With gradient accumulation, the update
+        applies only when ``apply_now`` and the accumulator resets;
+        otherwise the gradient just adds up. ``t`` is the pre-advance
+        iteration counter (adam bias correction counts APPLIED steps).
+        Returns (w, vel, acc, sq)."""
+        adam = self.solver == "adam"
+        g = grad if acc is None else acc + grad
+        if adam:
+            # applied-step count from 1 (iterations / accumulation)
+            step = (t + 1) / max(1, self.accumulate_gradient)
+            nw, nv, nsq = self.apply_update_adam(
+                xp, w, vel, sq, g, lr, moment, beta2, adam_eps, l2,
+                step)
+        else:
+            nw, nv = self.apply_update(xp, w, vel, g, lr, moment,
                                        l2, l1_vs_l2)
-            return nw, nv, None
-        acc = acc + grad
-        nw, nv = self.apply_update(xp, w, vel, acc, lr, moment,
-                                   l2, l1_vs_l2)
+            nsq = sq
+        if acc is None:
+            return nw, nv, None, nsq
         w = xp.where(apply_now, nw, w)
         vel = xp.where(apply_now, nv, vel)
-        acc = xp.where(apply_now, xp.zeros_like(acc), acc)
-        return w, vel, acc
+        # store the GROWN accumulator (g), zeroed once applied
+        acc = xp.where(apply_now, xp.zeros_like(g), g)
+        if adam:
+            nsq = xp.where(apply_now, nsq, sq)
+        return w, vel, acc, nsq
 
     @staticmethod
     def _scheduled_lr(xp, policy, base_lr, t):
@@ -347,30 +401,39 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             apply_now = count >= self.accumulate_gradient
             self.acc_count.mem[...] = 0 if apply_now else count
             acc_w = self.acc_weights.map_write().mem
+        adam = self.solver == "adam"
+        sq_w = self.sq_weights.map_write().mem if adam else None
         f.weights.map_write()
         self.vel_weights.map_write()
-        w, vel, acc = self._step_param(
+        w, vel, acc, sq = self._step_param(
             numpy, f.weights.mem, self.vel_weights.mem, acc_w, grad_w,
             apply_now, lr_w, self.gradient_moment,
-            self.weights_decay, self.l1_vs_l2)
+            self.weights_decay, self.l1_vs_l2, sq=sq_w, t=t,
+            beta2=self.adam_beta2, adam_eps=self.adam_eps)
         f.weights.mem[...] = w
         self.vel_weights.mem[...] = vel
         if acc is not None:
             self.acc_weights.mem[...] = acc
+        if sq is not None:
+            self.sq_weights.mem[...] = sq
         if f.include_bias and grad_b is not None:
             if accumulating:
                 acc_b = self.acc_bias.map_write().mem
+            sq_b = self.sq_bias.map_write().mem if adam else None
             f.bias.map_write()
             self.vel_bias.map_write()
-            b, velb, accb = self._step_param(
+            b, velb, accb, sqb = self._step_param(
                 numpy, f.bias.mem, self.vel_bias.mem, acc_b, grad_b,
                 apply_now, lr_b,
                 self.gradient_moment_bias, self.weights_decay_bias,
-                self.l1_vs_l2_bias)
+                self.l1_vs_l2_bias, sq=sq_b, t=t,
+                beta2=self.adam_beta2, adam_eps=self.adam_eps)
             f.bias.mem[...] = b
             self.vel_bias.mem[...] = velb
             if accb is not None:
                 self.acc_bias.mem[...] = accb
+            if sqb is not None:
+                self.sq_bias.mem[...] = sqb
         if self.iteration:
             self.iteration.map_write()
             self.iteration.mem[...] = t + 1
@@ -400,10 +463,13 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
                 .astype(jnp.int32))
             acc_w = state["acc_weights"]
         w, vel = params["weights"], state["vel_weights"]
+        sq_w = state.get("sq_weights") if self.solver == "adam" \
+            else None
         grad_w = ctx.pmean(grad_w)
-        w, vel, acc = self._step_param(
+        w, vel, acc, sq = self._step_param(
             jnp, w, vel, acc_w, grad_w.astype(w.dtype), apply_now,
-            lr_w, h["moment"], h["l2"], h["l1_vs_l2"])
+            lr_w, h["moment"], h["l2"], h["l1_vs_l2"], sq=sq_w, t=t,
+            beta2=h["beta2"], adam_eps=h["adam_eps"])
         # ZeroFiller mask (traced via hyperparams): pin masked entries
         # at zero INSIDE the trace — host-side mutation never reaches
         # device-resident params
@@ -413,19 +479,26 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         ctx.update_state(self, vel_weights=vel)
         if acc is not None:
             ctx.update_state(self, acc_weights=acc)
+        if sq is not None:
+            ctx.update_state(self, sq_weights=sq)
         if f.include_bias and grad_b is not None:
             if accumulating:
                 acc_b = state["acc_bias"]
             b, velb = params["bias"], state["vel_bias"]
+            sq_b = state.get("sq_bias") if self.solver == "adam" \
+                else None
             grad_b = ctx.pmean(grad_b)
-            b, velb, accb = self._step_param(
+            b, velb, accb, sqb = self._step_param(
                 jnp, b, velb, acc_b, grad_b.astype(b.dtype), apply_now,
                 lr_b, h["moment_bias"], h["l2_bias"],
-                h["l1_vs_l2_bias"])
+                h["l1_vs_l2_bias"], sq=sq_b, t=t,
+                beta2=h["beta2"], adam_eps=h["adam_eps"])
             ctx.update_params(f, bias=b)
             ctx.update_state(self, vel_bias=velb)
             if accb is not None:
                 ctx.update_state(self, acc_bias=accb)
+            if sqb is not None:
+                ctx.update_state(self, sq_bias=sqb)
 
     # extra-parameter updates (EXTRA_PARAMS declarations) --------------
 
@@ -462,16 +535,22 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             vel = getattr(self, "vel_" + pname)
             acc = getattr(self, "acc_" + pname) if accumulating \
                 else None
+            sq = getattr(self, "sq_" + pname) \
+                if self.solver == "adam" else None
             arr.map_write()
             vel.map_write()
             acc_mem = acc.map_write().mem if acc is not None else None
-            w, v, a = self._step_param(
+            sq_mem = sq.map_write().mem if sq is not None else None
+            w, v, a, q = self._step_param(
                 numpy, arr.mem, vel.mem, acc_mem, grad, apply_now,
-                lr, moment, l2, l1r)
+                lr, moment, l2, l1r, sq=sq_mem, t=t,
+                beta2=self.adam_beta2, adam_eps=self.adam_eps)
             arr.mem[...] = w
             vel.mem[...] = v
             if a is not None:
                 acc.mem[...] = a
+            if q is not None:
+                sq.mem[...] = q
 
     def update_extra_xla(self, ctx, grads):
         """Traced twin of :meth:`update_extra_numpy`; call after
@@ -498,13 +577,18 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             w = ctx.unit_params(f)[pname]
             vel = st["vel_" + pname]
             acc = st.get("acc_" + pname) if accumulating else None
-            w, vel, acc = self._step_param(
+            sq = st.get("sq_" + pname) if self.solver == "adam" \
+                else None
+            w, vel, acc, sq = self._step_param(
                 jnp, w, vel, acc, ctx.pmean(grad).astype(w.dtype),
-                apply_now, lr, moment, l2, l1r)
+                apply_now, lr, moment, l2, l1r, sq=sq, t=t,
+                beta2=h["beta2"], adam_eps=h["adam_eps"])
             ctx.update_params(f, **{pname: w})
             ctx.update_state(self, **{"vel_" + pname: vel})
             if acc is not None:
                 ctx.update_state(self, **{"acc_" + pname: acc})
+            if sq is not None:
+                ctx.update_state(self, **{"sq_" + pname: sq})
 
     # IDistributable compat layer (SURVEY.md §2.2) ---------------------
 
