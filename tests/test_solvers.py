@@ -181,3 +181,26 @@ def test_lm_trains_with_adam_from_config():
         root.lm.decision.max_epochs = saved_epochs
     hist = [h["validation"]["metric"] for h in wf.decision.history]
     assert hist[-1] < hist[0], hist
+
+
+def test_warmup_cosine_policy():
+    """warmup_cosine: linear ramp to base at t=warmup, cosine decay to
+    min_ratio*base at t=total, flat after; numpy == traced values."""
+    import jax
+    import jax.numpy as jnp
+    from veles.znicz_tpu.lr_adjust import make_policy
+
+    pol = make_policy({"name": "warmup_cosine", "warmup": 10,
+                       "total": 110, "min_ratio": 0.1})
+    base = 0.4
+    assert abs(pol(numpy, base, 0) - 0.0) < 1e-7
+    assert abs(pol(numpy, base, 5) - 0.2) < 1e-6
+    assert abs(pol(numpy, base, 10) - base) < 1e-6
+    mid = pol(numpy, base, 60)           # halfway through the decay
+    assert abs(mid - base * 0.55) < 1e-6  # 0.1 + 0.9*0.5
+    assert abs(pol(numpy, base, 110) - base * 0.1) < 1e-6
+    assert abs(pol(numpy, base, 500) - base * 0.1) < 1e-6
+    for t in (0, 5, 10, 60, 110, 500):
+        traced = jax.jit(lambda tt: pol(jnp, jnp.float32(base),
+                                        tt))(jnp.int32(t))
+        assert abs(float(traced) - pol(numpy, base, t)) < 1e-6, t

@@ -99,12 +99,37 @@ class ArbitraryStepPolicy(LRPolicy):
         return xp.asarray(self._values)[idx]
 
 
+class WarmupCosinePolicy(LRPolicy):
+    """Linear warmup over ``warmup`` iterations, then cosine decay to
+    ``min_ratio``·base over the remaining ``total - warmup`` (NEW —
+    no reference counterpart; the standard transformer-LM schedule,
+    pairs with ``solver="adam"``)."""
+
+    def __init__(self, warmup=100, total=10000, min_ratio=0.0):
+        if total <= warmup:
+            raise ValueError("total must exceed warmup")
+        self.warmup = int(warmup)
+        self.total = int(total)
+        self.min_ratio = float(min_ratio)
+
+    def __call__(self, xp, lr, t):
+        tf = t.astype(numpy.float32) if hasattr(t, "astype") else \
+            numpy.float32(t)
+        warm = tf / max(self.warmup, 1)
+        frac = xp.clip((tf - self.warmup)
+                       / (self.total - self.warmup), 0.0, 1.0)
+        cos = self.min_ratio + (1.0 - self.min_ratio) * 0.5 \
+            * (1.0 + xp.cos(numpy.float32(numpy.pi) * frac))
+        return lr * xp.where(tf < self.warmup, warm, cos)
+
+
 POLICIES = {
     "fixed": FixedPolicy,
     "step": StepPolicy,
     "exp": ExpPolicy,
     "inv": InvPolicy,
     "arbitrary_step": ArbitraryStepPolicy,
+    "warmup_cosine": WarmupCosinePolicy,
 }
 
 
