@@ -27,6 +27,10 @@ class Unit {
 
   virtual void Execute(const Tensor& in, Tensor* out) const = 0;
 
+  // Longest sequence this unit supports (0 = unbounded); the decode
+  // loop windows at the workflow-wide minimum (positions tables).
+  virtual int64_t MaxSequence() const { return 0; }
+
   const std::string& name() const { return name_; }
   void set_name(std::string n) { name_ = std::move(n); }
 

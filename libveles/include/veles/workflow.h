@@ -23,6 +23,16 @@ class Workflow {
   const std::string& name() const { return name_; }
   void set_name(std::string n) { name_ = std::move(n); }
 
+  // Longest sequence any unit supports (0 = unbounded).
+  int64_t MaxSequence() const {
+    int64_t m = 0;
+    for (const auto& u : units_) {
+      int64_t s = u->MaxSequence();
+      if (s && (!m || s < m)) m = s;
+    }
+    return m;
+  }
+
   const std::vector<int64_t>& input_sample_shape() const {
     return input_sample_shape_;
   }

@@ -428,6 +428,10 @@ class Embedding : public Unit {
     }
   }
 
+  int64_t MaxSequence() const override {
+    return has_positions_ ? positions_.dim(0) : 0;
+  }
+
   void Execute(const Tensor& in, Tensor* out) const override {
     // ids arrive as floats (the interchange format is float .npy)
     CheckNonEmpty(in, name());

@@ -2,17 +2,87 @@
 // .npy batch (SURVEY.md §3.5 "C++ inference ... no Python, no GPU").
 //
 //   veles_infer <archive_dir> <input.npy> <output.npy>
+//   veles_infer <archive_dir> <prompt.npy> <output.npy> --generate N
+//
+// --generate: autoregressive GREEDY decode for exported LMs — the
+// prompt is a (B, P) id matrix (float .npy, the interchange format);
+// each step re-runs the full forward on the growing sequence and
+// appends the argmax of the last position. Matches the Python-side
+// greedy decode (veles.znicz_tpu.generate) exactly while the total
+// sequence fits the exported positions table (export writes a 4x-
+// seq_len extended table); beyond that the window slides over the
+// last max_s tokens — an approximation the Python side does not make.
 
+#include <algorithm>
 #include <cstdio>
+#include <cstdlib>
+#include <cstring>
 #include <exception>
+#include <string>
+#include <vector>
 
 #include "veles/npy.h"
 #include "veles/workflow.h"
 
+namespace {
+
+veles::Tensor Generate(const veles::Workflow& wf,
+                       const veles::Tensor& prompt, int64_t n_tokens) {
+  if (prompt.rank() != 2 || prompt.dim(0) < 1 || prompt.dim(1) < 1)
+    throw std::runtime_error(
+        "--generate needs a (B>=1, P>=1) prompt");
+  int64_t b = prompt.dim(0);
+  // positions-table bound (0 = unbounded): window only past it
+  int64_t max_s = wf.MaxSequence();
+  std::vector<std::vector<float>> ids(static_cast<size_t>(b));
+  for (int64_t i = 0; i < b; ++i)
+    ids[i].assign(prompt.data() + i * prompt.dim(1),
+                  prompt.data() + (i + 1) * prompt.dim(1));
+  veles::Tensor out;
+  out.Reset({b, n_tokens});
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    int64_t cur = static_cast<int64_t>(ids[0].size());
+    int64_t win = (max_s && cur > max_s) ? max_s : cur;
+    veles::Tensor in;
+    in.Reset({b, win});
+    for (int64_t i = 0; i < b; ++i)
+      std::copy_n(ids[i].end() - win, win, in.data() + i * win);
+    veles::Tensor logits;
+    wf.Execute(in, &logits);
+    if (logits.rank() != 3 || logits.dim(0) != b ||
+        logits.dim(1) != win)
+      throw std::runtime_error(
+          "--generate needs (B, S, vocab) logits, got " +
+          logits.ShapeString());
+    int64_t v = logits.dim(2);
+    for (int64_t i = 0; i < b; ++i) {
+      const float* row = logits.data() + ((i * win) + win - 1) * v;
+      int64_t best = 0;
+      for (int64_t j = 1; j < v; ++j)
+        if (row[j] > row[best]) best = j;
+      ids[i].push_back(static_cast<float>(best));
+      out.data()[i * n_tokens + t] = static_cast<float>(best);
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
 int main(int argc, char** argv) {
-  if (argc != 4) {
+  int64_t n_generate = -1;
+  if (argc == 6 && std::strcmp(argv[4], "--generate") == 0) {
+    char* end = nullptr;
+    n_generate = std::strtoll(argv[5], &end, 10);
+    if (end == argv[5] || *end != '\0' || n_generate < 0) {
+      std::fprintf(stderr, "error: --generate needs N >= 0, got %s\n",
+                   argv[5]);
+      return 2;
+    }
+  } else if (argc != 4) {
     std::fprintf(stderr,
-                 "usage: %s <archive_dir> <input.npy> <output.npy>\n",
+                 "usage: %s <archive_dir> <input.npy> <output.npy> "
+                 "[--generate N]\n",
                  argv[0]);
     return 2;
   }
@@ -20,11 +90,20 @@ int main(int argc, char** argv) {
     veles::Workflow wf = veles::WorkflowLoader::Load(argv[1]);
     veles::Tensor in = veles::npy::Load(argv[2]);
     veles::Tensor out;
-    wf.Execute(in, &out);
+    if (n_generate >= 0) {
+      out = Generate(wf, in, n_generate);
+      std::fprintf(stderr, "%s: generated %lld tokens for %lld rows\n",
+                   wf.name().c_str(),
+                   static_cast<long long>(n_generate),
+                   static_cast<long long>(in.dim(0)));
+    } else {
+      wf.Execute(in, &out);
+      std::fprintf(stderr, "%s: %zu units, in %s -> out %s\n",
+                   wf.name().c_str(), wf.size(),
+                   in.ShapeString().c_str(),
+                   out.ShapeString().c_str());
+    }
     veles::npy::Save(argv[3], out);
-    std::fprintf(stderr, "%s: %zu units, in %s -> out %s\n",
-                 wf.name().c_str(), wf.size(), in.ShapeString().c_str(),
-                 out.ShapeString().c_str());
     return 0;
   } catch (const std::exception& e) {
     std::fprintf(stderr, "error: %s\n", e.what());

@@ -244,3 +244,25 @@ def test_stacked_lm_matches_oracle(engine, tmp_path):
     runs forward in C++ and matches the numpy oracle."""
     wf = _train_lm_variant("CxxStack", {"stacked": True}, seed=78)
     _lm_oracle_vs_engine(engine, tmp_path, wf, "stack_archive")
+
+
+def test_cxx_generate_matches_python(engine, tmp_path):
+    """veles_infer --generate: C++ greedy decode over the exported LM
+    == the Python KV-cached greedy decode."""
+    from veles.znicz_tpu.generate import generate
+    wf = _train_lm_variant("CxxGen", {}, seed=81)
+    archive = os.path.join(tmp_path, "gen_archive")
+    wf.export_inference(archive)
+    prompt = numpy.array([[1, 2, 3, 1, 2, 3, 1, 2],
+                          [5, 6, 5, 6, 5, 6, 5, 6]], numpy.float32)
+    inp = os.path.join(tmp_path, "prompt.npy")
+    outp = os.path.join(tmp_path, "gen.npy")
+    numpy.save(inp, prompt)
+    subprocess.run(
+        [os.path.join(engine, "veles_infer"), archive, inp, outp,
+         "--generate", "6"],
+        check=True, capture_output=True)
+    got = numpy.load(outp).astype(numpy.int32)
+    want = generate(wf, prompt.astype(numpy.int32), 6,
+                    temperature=0.0)
+    assert (got == want).all(), (got, want)

@@ -224,3 +224,33 @@ def test_web_status(tmp_path):
         assert doc["slave0"]["epoch"] == 7
     finally:
         ws.close()
+
+
+def test_profile_dir_writes_trace(tmp_path):
+    """--profile-dir wraps the run in jax.profiler.trace and leaves a
+    trace artifact behind (SURVEY §5.1 kernel-level profiling)."""
+    import os
+    import veles.prng as prng
+    from veles.config import root
+    from veles.launcher import Launcher
+    prng.seed_all(5)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("n_train", "n_valid", "minibatch_size")}
+    saved_epochs = root.mnist.decision.get("max_epochs")
+    root.mnist.loader.update({"n_train": 64, "n_valid": 32,
+                              "minibatch_size": 16})
+    root.mnist.decision.max_epochs = 1
+    prof = str(tmp_path / "trace")
+    try:
+        wf = mnist.create_workflow(name="ProfiledRun")
+        launcher = Launcher(device="xla", stats=False,
+                            profile_dir=prof)
+        launcher.initialize(wf)
+        launcher.run()
+    finally:
+        root.mnist.loader.update(saved)
+        root.mnist.decision.max_epochs = saved_epochs
+    found = [os.path.join(dp, f) for dp, _, fs in os.walk(prof)
+             for f in fs]
+    assert found, "no profiler trace files written"

@@ -65,6 +65,10 @@ def build_argparser():
                    help="stream plots to a renderer process writing "
                         "PNGs here (also auto-links the standard "
                         "plotters when the workflow has none)")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the run here "
+                        "(kernel-level timeline; view in TensorBoard "
+                        "or Perfetto)")
     p.add_argument("--web-status", type=int, default=None,
                    metavar="PORT",
                    help="serve the status dashboard on this port "
@@ -137,7 +141,8 @@ class Main:
             listen_address=args.listen_address,
             master_address=args.master_address,
             graphics_dir=args.graphics_dir,
-            web_status_port=args.web_status)
+            web_status_port=args.web_status,
+            profile_dir=args.profile_dir)
         if args.graphics_dir and not getattr(
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):

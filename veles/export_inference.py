@@ -125,10 +125,15 @@ def _unit_spec(unit, path):
                                "dim": int(unit.dim)})
         _export_weighted(unit, path, spec)
         if unit._positions is not None:
+            # export an EXTENDED sinusoidal table (deterministic, data
+            # free) so the C++ --generate decode can grow sequences
+            # well past the training seq_len before it must window
+            from veles.znicz_tpu.ops.embedding import (
+                sinusoidal_positions)
+            n = max(4 * unit._positions.shape[0], 256)
             fname = _npy_name(unit, "positions")
             numpy.save(os.path.join(path, fname),
-                       numpy.ascontiguousarray(
-                           unit._positions, numpy.float32))
+                       sinusoidal_positions(n, unit.dim))
             spec["positions"] = fname
     elif isinstance(unit, LayerNormForward):
         spec["config"]["eps"] = float(unit.eps)

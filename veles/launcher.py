@@ -28,7 +28,8 @@ class Launcher(Logger):
 
     def __init__(self, device=None, snapshot=None, stats=True,
                  listen_address=None, master_address=None,
-                 graphics_dir=None, web_status_port=None):
+                 graphics_dir=None, web_status_port=None,
+                 profile_dir=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
@@ -37,6 +38,11 @@ class Launcher(Logger):
         self.master_address = master_address
         self.workflow = None
         self.interrupted = False
+        #: directory for a jax.profiler trace of the run (XLA op/HLO
+        #: timeline, viewable in TensorBoard/Perfetto) — the kernel-
+        #: level complement to the per-unit wall times (SURVEY.md §5.1
+        #: "TPU equivalent: jax.profiler traces + per-step timing")
+        self.profile_dir = profile_dir
         #: directory for streamed plot PNGs (spawns the renderer
         #: process); None disables graphics (SURVEY.md §2.7)
         self.graphics_dir = graphics_dir
@@ -94,13 +100,25 @@ class Launcher(Logger):
             signal.signal(signal.SIGINT, on_sigint)
         except ValueError:          # not on the main thread
             previous = None
-        try:
+        import contextlib
+        prof = contextlib.nullcontext()
+        if self.profile_dir:
             if self.mode == "master":
-                self._run_master()
-            elif self.mode == "slave":
-                self._run_slave()
+                # master never computes — nothing worth tracing
+                self.warning("--profile-dir ignored in master mode")
             else:
-                wf.run()
+                import jax
+                prof = jax.profiler.trace(self.profile_dir)
+        try:
+            with prof:
+                if self.mode == "master":
+                    self._run_master()
+                elif self.mode == "slave":
+                    self._run_slave()
+                else:
+                    wf.run()
+            if not isinstance(prof, contextlib.nullcontext):
+                self.info("profiler trace in %s", self.profile_dir)
         finally:
             if previous is not None:
                 signal.signal(signal.SIGINT, previous)

@@ -142,6 +142,10 @@ def _plan(workflow):
             steps.append(("attn", unit, n_caches))
             n_caches += 1
         elif isinstance(unit, TransformerBlockStack):
+            if not unit.causal:
+                raise ValueError(
+                    "%s: generation needs causal attention"
+                    % unit.name)
             steps.append(("stack", unit, n_caches))
             n_caches += unit.layers
         elif isinstance(unit, (LayerNormForward, TransformerFFN,
@@ -291,10 +295,16 @@ def generate(workflow, prompt_ids, n_tokens, temperature=0.0,
     steps, n_caches = _plan(workflow)
     if key is None:
         key = jax.random.PRNGKey(0)
+    # bounded FIFO of compiled decoders: each distinct
+    # (batch, prompt_len, n_tokens, temperature) signature costs one
+    # XLA compile; callers with many prompt lengths should pad to a
+    # few bucket sizes themselves
     cache = workflow.__dict__.setdefault("_generate_jit_cache", {})
     sig = (b, p_len, n_tokens, float(temperature),
            tuple(id(u) for _, u, _ in steps))
     if sig not in cache:
+        if len(cache) >= 16:
+            cache.pop(next(iter(cache)))
         cache[sig] = _build_fns(workflow, steps, n_caches, maxlen,
                                 float(temperature), n_tokens)
     ptrees = [_unit_params(workflow, unit) for _, unit, _ in steps]
