@@ -132,3 +132,17 @@ def test_generate_zero_tokens_and_compile_cache(lm):
     n = len(lm._generate_jit_cache)
     generate(lm, prompt, 4)
     assert len(lm._generate_jit_cache) == n
+
+
+def test_generate_top_k_top_p(lm):
+    """top_k=1 sampling must equal greedy whatever the temperature;
+    top_p near 0 likewise (only the top token survives)."""
+    import jax
+    prompt = numpy.array([[1, 2, 3, 1, 2, 3, 1, 2]], numpy.int32)
+    greedy = generate(lm, prompt, 6, temperature=0.0)
+    k1 = generate(lm, prompt, 6, temperature=1.5,
+                  key=jax.random.PRNGKey(3), top_k=1)
+    assert (k1 == greedy).all(), (k1, greedy)
+    p0 = generate(lm, prompt, 6, temperature=1.5,
+                  key=jax.random.PRNGKey(3), top_p=1e-6)
+    assert (p0 == greedy).all(), (p0, greedy)

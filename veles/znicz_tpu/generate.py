@@ -186,7 +186,7 @@ def _token_apply(unit, p, x):
 
 
 def _build_fns(workflow, steps, n_caches, maxlen, temperature,
-               n_tokens):
+               n_tokens, top_k, top_p):
     """(prefill_fn, decode_fn) pure in their parameters: every jitted
     tensor (param trees, prompt ids, carry) is an argument."""
     import jax
@@ -234,8 +234,27 @@ def _build_fns(workflow, steps, n_caches, maxlen, temperature,
     def sample(logits, k):
         if temperature <= 0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / jnp.float32(temperature), axis=-1) \
+        logits = logits / jnp.float32(temperature)
+        if top_k or top_p:
+            # ONE shared descending sort serves both filters
+            srt = jnp.sort(logits, axis=-1)[:, ::-1]
+            if top_k:
+                kth = srt[:, min(int(top_k), srt.shape[1]) - 1]
+                logits = jnp.where(logits < kth[:, None],
+                                   jnp.float32(-1e9), logits)
+            if top_p:
+                # nucleus: keep the smallest prefix of the sorted
+                # probs whose mass exceeds top_p (the top token
+                # always stays: its cumsum-minus-self is 0 < top_p)
+                probs = jax.nn.softmax(srt, axis=-1)
+                keep = jnp.cumsum(probs, axis=-1) - probs \
+                    < jnp.float32(top_p)
+                cutoff = jnp.min(
+                    jnp.where(keep, srt, jnp.float32(numpy.inf)),
+                    axis=-1, keepdims=True)
+                logits = jnp.where(logits < cutoff,
+                                   jnp.float32(-1e9), logits)
+        return jax.random.categorical(k, logits, axis=-1) \
             .astype(jnp.int32)
 
     def decode_step(ptrees, carry, _):
@@ -278,9 +297,12 @@ def _build_fns(workflow, steps, n_caches, maxlen, temperature,
 
 
 def generate(workflow, prompt_ids, n_tokens, temperature=0.0,
-             key=None):
+             key=None, top_k=None, top_p=None):
     """Generate ``n_tokens`` continuations for ``prompt_ids`` (B, P)
-    from a trained LM workflow. Returns int32 (B, n_tokens)."""
+    from a trained LM workflow. Returns int32 (B, n_tokens).
+    ``temperature=0`` is greedy; otherwise softmax sampling, optionally
+    truncated to the ``top_k`` highest logits and/or the ``top_p``
+    nucleus (smallest prefix of probability mass)."""
     import jax
     import jax.numpy as jnp
 
@@ -290,23 +312,33 @@ def generate(workflow, prompt_ids, n_tokens, temperature=0.0,
     n_tokens = int(n_tokens)
     if n_tokens <= 0:
         return numpy.zeros(prompt_ids.shape[:1] + (0,), numpy.int32)
+    # normalize disabled truncation values so behavior-identical
+    # calls share one compiled decoder
+    top_k = int(top_k) if top_k else None
+    top_p = float(top_p) if top_p is not None and top_p < 1.0 \
+        else None
+    if top_k is not None and top_k < 1:
+        raise ValueError("top_k must be >= 1, got %r" % (top_k,))
+    if top_p is not None and top_p <= 0:
+        raise ValueError("top_p must be in (0, 1], got %r" % (top_p,))
     b, p_len = prompt_ids.shape
     maxlen = p_len + n_tokens
     steps, n_caches = _plan(workflow)
     if key is None:
         key = jax.random.PRNGKey(0)
     # bounded FIFO of compiled decoders: each distinct
-    # (batch, prompt_len, n_tokens, temperature) signature costs one
-    # XLA compile; callers with many prompt lengths should pad to a
-    # few bucket sizes themselves
+    # (batch, prompt_len, n_tokens, temperature, top_k, top_p)
+    # signature costs one XLA compile; callers with many prompt
+    # lengths should pad to a few bucket sizes themselves
     cache = workflow.__dict__.setdefault("_generate_jit_cache", {})
-    sig = (b, p_len, n_tokens, float(temperature),
+    sig = (b, p_len, n_tokens, float(temperature), top_k, top_p,
            tuple(id(u) for _, u, _ in steps))
     if sig not in cache:
         if len(cache) >= 16:
             cache.pop(next(iter(cache)))
         cache[sig] = _build_fns(workflow, steps, n_caches, maxlen,
-                                float(temperature), n_tokens)
+                                float(temperature), n_tokens,
+                                top_k, top_p)
     ptrees = [_unit_params(workflow, unit) for _, unit, _ in steps]
     out = cache[sig](ptrees, jnp.asarray(prompt_ids), key)
     return numpy.asarray(out, numpy.int32)
