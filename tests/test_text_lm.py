@@ -1,0 +1,93 @@
+"""Character-level text-corpus LM: loader windows/vocab, training on
+a real file, and text generation round-trip."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.config import root
+from veles.znicz_tpu.generate import generate
+
+
+CORPUS = ("the quick brown fox jumps over the lazy dog. " * 60)
+
+
+@pytest.fixture()
+def corpus_file(tmp_path):
+    path = tmp_path / "corpus.txt"
+    path.write_text(CORPUS)
+    return str(path)
+
+
+def _train_text_lm(path, name, epochs=14):
+    prng.seed_all(321)
+    from veles.znicz_tpu.models import transformer_lm
+    saved_loader = root.lm.loader.to_dict()
+    saved_model = root.lm.model.to_dict()
+    saved_train = root.lm.train.to_dict()
+    saved_epochs = root.lm.decision.get("max_epochs")
+    root.lm.loader.update({"minibatch_size": 32, "seq_len": 24,
+                           "text_file": path, "valid_ratio": 0.1})
+    root.lm.model.update({"dim": 48, "heads": 2, "layers": 2,
+                          "ffn_hidden": 96, "moe_experts": 0,
+                          "attn_block": None, "attn_impl": None,
+                          "stacked": False})
+    root.lm.train.update({"solver": "adam", "learning_rate": 0.01,
+                          "gradient_moment": 0.9,
+                          "weights_decay": 0.0})
+    root.lm.decision.max_epochs = epochs
+    root.lm.parallel.update({"seq": 1, "model": 1, "data": 1,
+                             "expert": 1, "pipe": 1})
+    try:
+        wf = transformer_lm.create_workflow(name=name)
+        wf.initialize(device="xla")
+        wf.run()
+    finally:
+        root.lm.loader.update(dict(saved_loader, text_file=None))
+        root.lm.model.update(saved_model)
+        root.lm.train.update({"solver": "momentum"})
+        root.lm.train.update(saved_train)
+        root.lm.decision.max_epochs = saved_epochs
+    return wf
+
+
+def test_text_loader_windows(corpus_file, tmp_path):
+    """Vocab is the sorted character set; windows are next-char
+    shifted; validation is the corpus tail."""
+    from veles.znicz_tpu.models.transformer_lm import (
+        TextLMLoader, text_vocab)
+    itos, stoi = text_vocab(corpus_file)
+    assert itos == sorted(set(CORPUS))
+    prng.seed_all(1)
+    saved = root.lm.loader.to_dict()
+    root.lm.loader.update({"minibatch_size": 8, "seq_len": 24,
+                           "text_file": corpus_file})
+    try:
+        from veles.workflow import Workflow
+        wf = Workflow(None, name="TextWf")
+        loader = TextLMLoader(wf, name="loader", minibatch_size=8)
+        loader.load_data()
+    finally:
+        root.lm.loader.update(dict(saved, text_file=None))
+    data = loader.original_data.mem
+    labels = loader.original_labels.mem
+    assert (data[:, 1:] == labels[:, :-1]).all()   # shift by one
+    text0 = loader.decode(data[loader.class_lengths[1]])
+    assert text0 in CORPUS                          # a real window
+    assert loader.decode(loader.encode("fox")[0]) == "fox"
+
+
+def test_text_lm_trains_and_generates(corpus_file):
+    """The char LM learns the corpus (validation loss well under the
+    uniform-vocab baseline) and continues text plausibly."""
+    wf = _train_text_lm(corpus_file, "TextLM")
+    hist = [h["validation"]["metric"] for h in wf.decision.history]
+    vocab = root.lm.loader.get("vocab")
+    assert hist[-1] < hist[0] * 0.6, hist
+    wf.xla_step.sync_host()
+    loader = wf.loader
+    prompt = loader.encode("the quick brown ")
+    out = generate(wf, prompt, 12, temperature=0.0)
+    text = loader.decode(out[0])
+    # greedy continuation of a memorized corpus: next chars are "fox "
+    assert text.startswith("fox"), repr(text)

@@ -65,6 +65,19 @@ def build_argparser():
                    help="stream plots to a renderer process writing "
                         "PNGs here (also auto-links the standard "
                         "plotters when the workflow has none)")
+    p.add_argument("--generate", default=None, metavar="IDS",
+                   help="after the run, decode from the trained LM: "
+                        "comma-separated prompt token ids (e.g. "
+                        "'1,2,3'); prints the continuation")
+    p.add_argument("--generate-text", default=None, metavar="PROMPT",
+                   help="like --generate but with TEXT through the "
+                        "loader's character vocabulary (text-corpus "
+                        "LMs: root.lm.loader.text_file)")
+    p.add_argument("--gen-tokens", type=int, default=32,
+                   help="tokens to generate with --generate")
+    p.add_argument("--gen-temperature", type=float, default=0.0,
+                   help="sampling temperature for --generate "
+                        "(0 = greedy)")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run here "
                         "(kernel-level timeline; view in TensorBoard "
@@ -158,6 +171,34 @@ class Main:
         if args.export_inference:
             self.workflow.export_inference(args.export_inference)
             print("inference archive -> %s" % args.export_inference)
+        if args.generate or args.generate_text:
+            import numpy
+            from veles.znicz_tpu.generate import generate
+            loader = getattr(self.workflow, "loader", None)
+            if args.generate_text:
+                if not hasattr(loader, "encode"):
+                    raise SystemExit(
+                        "--generate-text needs a text-corpus loader "
+                        "(root.lm.loader.text_file)")
+                try:
+                    prompt = loader.encode(args.generate_text)
+                except ValueError as exc:
+                    raise SystemExit("--generate-text: %s" % exc)
+            else:
+                prompt = numpy.array(
+                    [[int(t) for t in args.generate.split(",")]],
+                    numpy.int32)
+            step = getattr(self.workflow, "xla_step", None)
+            if step is not None:
+                step.sync_host()
+            out = generate(self.workflow, prompt, args.gen_tokens,
+                           temperature=args.gen_temperature)
+            if args.generate_text:
+                print("generated: %s"
+                      % (args.generate_text + loader.decode(out[0])))
+            else:
+                print("generated: %s"
+                      % ",".join(str(t) for t in out[0].tolist()))
         if args.result_file and self.workflow.decision is not None:
             with open(args.result_file, "w") as f:
                 json.dump({

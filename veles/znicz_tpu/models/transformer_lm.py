@@ -20,8 +20,12 @@ from veles.znicz_tpu.ops.evaluator import EvaluatorLM
 from veles.znicz_tpu.standard_workflow import StandardWorkflow
 
 root.lm.update({
+    # text_file: path to a utf-8 corpus → character-level LM via
+    # TextLMLoader (vocab inferred); None → the synthetic periodic
+    # pattern task
     "loader": {"minibatch_size": 64, "n_train": 2048, "n_valid": 256,
-               "seq_len": 32, "vocab": 16, "max_period": 6},
+               "seq_len": 32, "vocab": 16, "max_period": 6,
+               "text_file": None, "valid_ratio": 0.1},
     # attn_block: single-chip flash-style blocked attention (exact;
     # O(S*block) score memory instead of O(S^2)); None = dense
     # moe_experts > 0 swaps the dense FFN for a top-1-routed MoE FFN
@@ -50,6 +54,69 @@ root.lm.update({
 })
 
 
+def text_vocab(path, text=None):
+    """Sorted character vocabulary of a text file (or of ``text``
+    when the caller already read it) → (itos, stoi)."""
+    if text is None:
+        with open(path, "r", encoding="utf-8",
+                  errors="replace") as f:
+            text = f.read()
+    chars = sorted(set(text))
+    if not chars:
+        raise ValueError("%s: empty corpus" % path)
+    return chars, {c: i for i, c in enumerate(chars)}
+
+
+def _tail_valid_order(n, n_valid):
+    """[valid | train] index order with validation as the TAIL of
+    the corpus (shared by both LM loaders)."""
+    return numpy.concatenate([
+        numpy.arange(n - n_valid, n), numpy.arange(0, n - n_valid)])
+
+
+class TextLMLoader(FullBatchLoader):
+    """Character-level corpus loader: a text file becomes (B, S)
+    next-char windows (NEW — the real-data path for the LM sample;
+    configure with ``root.lm.loader.text_file``). The synthetic
+    periodic loader below remains the no-data default."""
+
+    def load_data(self):
+        cfg = root.lm.loader
+        path = cfg.text_file
+        s = cfg.get("seq_len", 32)
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        self.itos, self.stoi = text_vocab(path, text)
+        stream = numpy.fromiter(
+            (self.stoi[c] for c in text), numpy.int32, len(text))
+        n = (len(stream) - 1) // s
+        if n < 2:
+            raise ValueError(
+                "%s: corpus too short for seq_len %d" % (path, s))
+        data = numpy.stack([stream[i * s:i * s + s + 1]
+                            for i in range(n)])
+        # held-out tail as validation, at least one sequence
+        n_valid = max(1, int(n * cfg.get("valid_ratio", 0.1)))
+        data = data[_tail_valid_order(n, n_valid)]
+        self.original_data.mem = data[:, :-1]
+        self.original_labels.mem = data[:, 1:]
+        self.class_lengths = [0, n_valid, n - n_valid]
+        self.serve_dtype = numpy.int32
+
+    def encode(self, text):
+        bad = sorted(set(text) - set(self.stoi))
+        if bad:
+            raise ValueError(
+                "prompt characters %r are not in the corpus "
+                "vocabulary (%d known characters)"
+                % ("".join(bad), len(self.itos)))
+        return numpy.array([[self.stoi[c] for c in text]],
+                           numpy.int32)
+
+    def decode(self, ids):
+        return "".join(self.itos[int(i)] for i in numpy.ravel(ids))
+
+
 class PeriodicLMLoader(FullBatchLoader):
     """Sequences repeating a random pattern of random period ≤
     max_period; labels are the next-token shift. Prediction beyond one
@@ -75,8 +142,7 @@ class PeriodicLMLoader(FullBatchLoader):
         # serve token ids as ints, not floats
         self.serve_dtype = numpy.int32
         # [valid | train] layout expected by the loader
-        order = numpy.concatenate([
-            numpy.arange(n - n_valid, n), numpy.arange(0, n - n_valid)])
+        order = _tail_valid_order(n, n_valid)
         self.original_data.mem = self.original_data.mem[order]
         self.original_labels.mem = self.original_labels.mem[order]
 
@@ -206,25 +272,38 @@ class TransformerLMWorkflow(StandardWorkflow):
         self.xla_step.refresh_device()
 
 
+def _loader_factory():
+    """Pick the corpus: a text file (char-level, vocab inferred and
+    written back into the config BEFORE layers are built) or the
+    synthetic periodic task."""
+    cfg = root.lm.loader
+    if cfg.get("text_file"):
+        itos, _ = text_vocab(cfg.text_file)
+        cfg.vocab = len(itos)
+        cls = TextLMLoader
+    else:
+        cls = PeriodicLMLoader
+    return lambda wf: cls(wf, name="loader",
+                          minibatch_size=cfg.minibatch_size)
+
+
 def create_workflow(name="TransformerLM", **kwargs):
     cfg = root.lm
+    factory = _loader_factory()
     return TransformerLMWorkflow(
         None, name=name,
         layers=build_layers(),
-        loader_factory=lambda wf: PeriodicLMLoader(
-            wf, name="loader",
-            minibatch_size=cfg.loader.minibatch_size),
+        loader_factory=factory,
         evaluator_factory=lm_evaluator_factory,
         decision_config=cfg.decision.to_dict(),
         **kwargs)
 
 
 def run(load, main):
+    factory = _loader_factory()
     load(TransformerLMWorkflow,
          layers=build_layers(),
-         loader_factory=lambda wf: PeriodicLMLoader(
-             wf, name="loader",
-             minibatch_size=root.lm.loader.minibatch_size),
+         loader_factory=factory,
          evaluator_factory=lm_evaluator_factory,
          decision_config=root.lm.decision.to_dict())
     main()
