@@ -405,6 +405,163 @@ class LRNorm : public Unit {
 
 VELES_REGISTER_UNIT("norm", LRNorm)
 
+// -- autoencoder path: transposed conv + depooling -----------------------
+//
+// Overlap-add of (B·oy·ox, ky·kx·C) window patches into a padded
+// (hp, wp) canvas, cropped to (h, w) — the C++ twin of
+// veles/znicz_tpu/ops/conv_math.py col2im.
+void Col2Im(const float* cols, float* out, int64_t b, int64_t oy,
+            int64_t ox, int64_t ky, int64_t kx, int64_t c, int64_t h,
+            int64_t w, int64_t sy, int64_t sx, int64_t top,
+            int64_t left, int64_t bottom, int64_t right) {
+  int64_t hp = h + top + bottom, wp = w + left + right;
+  std::vector<float> acc(static_cast<size_t>(b * hp * wp * c), 0.0f);
+  for (int64_t bi = 0; bi < b; ++bi)
+    for (int64_t i = 0; i < oy; ++i)
+      for (int64_t j = 0; j < ox; ++j) {
+        const float* patch =
+            cols + ((bi * oy + i) * ox + j) * ky * kx * c;
+        for (int64_t p = 0; p < ky; ++p)
+          for (int64_t q = 0; q < kx; ++q) {
+            float* dst = acc.data()
+                + ((bi * hp + (p + sy * i)) * wp + (q + sx * j)) * c;
+            const float* src = patch + (p * kx + q) * c;
+            for (int64_t e = 0; e < c; ++e) dst[e] += src[e];
+          }
+      }
+  for (int64_t bi = 0; bi < b; ++bi)
+    for (int64_t y = 0; y < h; ++y)
+      std::copy_n(
+          acc.data() + ((bi * hp + y + top) * wp + left) * c, w * c,
+          out + (bi * h + y) * w * c);
+}
+
+class Deconv : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    weights_ = npy::Load(ResolvePath(dir, spec.at("weights").AsString()));
+    const json::Value& cfg = spec.at("config");
+    n_kernels_ = CheckDim(cfg.at("n_kernels").AsInt(), name(),
+                          "n_kernels");
+    kx_ = CheckDim(cfg.at("kx").AsInt(), name(), "kx");
+    ky_ = CheckDim(cfg.at("ky").AsInt(), name(), "ky");
+    std::vector<int64_t> sl = cfg.at("sliding").AsIntVector();
+    std::vector<int64_t> pad = cfg.at("padding").AsIntVector();
+    out_shape_ = cfg.at("out_shape").AsIntVector();
+    if (sl.size() != 2 || pad.size() != 4 || out_shape_.size() != 3)
+      throw std::runtime_error(name() + ": bad sliding/padding/"
+                               "out_shape");
+    sy_ = CheckDim(sl[0], name(), "sliding");
+    sx_ = CheckDim(sl[1], name(), "sliding");
+    for (int64_t p : pad) CheckDim(p, name(), "padding", 0);
+    top_ = pad[0]; bottom_ = pad[1]; left_ = pad[2]; right_ = pad[3];
+    for (int64_t d : out_shape_) CheckDim(d, name(), "out_shape");
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    if (in.rank() != 4)
+      throw std::runtime_error(name() + ": deconv input must be "
+                               "(B, oy, ox, K), got " +
+                               in.ShapeString());
+    CheckNonEmpty(in, name());
+    int64_t b = in.dim(0), oy = in.dim(1), ox = in.dim(2),
+            k = in.dim(3);
+    int64_t h = out_shape_[0], w = out_shape_[1], c = out_shape_[2];
+    if (k != n_kernels_ || weights_.rank() != 2 ||
+        weights_.dim(0) != n_kernels_ ||
+        weights_.dim(1) != ky_ * kx_ * c)
+      throw std::runtime_error(name() + ": weight shape mismatch");
+    int64_t hp = h + top_ + bottom_, wp = w + left_ + right_;
+    if ((hp - ky_) / sy_ + 1 != oy || (wp - kx_) / sx_ + 1 != ox)
+      throw std::runtime_error(name() + ": input/output geometry "
+                               "mismatch");
+    int64_t rows = CheckedMul(CheckedMul(b, oy, name()), ox, name());
+    int64_t patch = CheckedMul(CheckedMul(ky_, kx_, name()), c,
+                               name());
+    std::vector<float> cols(
+        static_cast<size_t>(CheckedMul(rows, patch, name())));
+    // padded canvas the overlap-add writes into
+    CheckedMul(CheckedMul(CheckedMul(b, hp, name()), wp, name()), c,
+               name());
+    Gemm(in.data(), weights_.data(), cols.data(), rows, k, patch,
+         false);
+    out->Reset({b, h, w, c});
+    Col2Im(cols.data(), out->data(), b, oy, ox, ky_, kx_, c, h, w,
+           sy_, sx_, top_, left_, bottom_, right_);
+  }
+
+ private:
+  Tensor weights_;
+  int64_t n_kernels_ = 0, kx_ = 0, ky_ = 0, sy_ = 1, sx_ = 1;
+  int64_t top_ = 0, bottom_ = 0, left_ = 0, right_ = 0;
+  std::vector<int64_t> out_shape_;
+};
+
+VELES_REGISTER_UNIT("deconv", Deconv)
+
+class Depooling : public Unit {
+ public:
+  void Configure(const json::Value& spec, const std::string& dir) override {
+    const json::Value& cfg = spec.at("config");
+    kx_ = CheckDim(cfg.at("kx").AsInt(), name(), "kx");
+    ky_ = CheckDim(cfg.at("ky").AsInt(), name(), "ky");
+    std::vector<int64_t> sl = cfg.at("sliding").AsIntVector();
+    out_shape_ = cfg.at("out_shape").AsIntVector();
+    if (sl.size() != 2 || out_shape_.size() != 3)
+      throw std::runtime_error(name() + ": bad sliding/out_shape");
+    sy_ = CheckDim(sl[0], name(), "sliding");
+    sx_ = CheckDim(sl[1], name(), "sliding");
+    for (int64_t d : out_shape_) CheckDim(d, name(), "out_shape");
+  }
+
+  void Execute(const Tensor& in, Tensor* out) const override {
+    if (in.rank() != 4)
+      throw std::runtime_error(name() + ": depooling input must be "
+                               "(B, oy, ox, C), got " +
+                               in.ShapeString());
+    CheckNonEmpty(in, name());
+    int64_t b = in.dim(0), oy = in.dim(1), ox = in.dim(2),
+            c = in.dim(3);
+    int64_t h = out_shape_[0], w = out_shape_[1];
+    if (out_shape_[2] != c)
+      throw std::runtime_error(name() + ": channel mismatch");
+    int64_t need_h = CheckedMul(sy_, oy - 1, name()) + ky_;
+    int64_t need_w = CheckedMul(sx_, ox - 1, name()) + kx_;
+    if (h > need_h || w > need_w)
+      throw std::runtime_error(name() + ": out_shape exceeds the "
+                               "spread window coverage");
+    const float inv = 1.0f / static_cast<float>(ky_ * kx_);
+    std::vector<float> acc(static_cast<size_t>(
+        CheckedMul(CheckedMul(CheckedMul(b, need_h, name()), need_w,
+                              name()), c, name())), 0.0f);
+    for (int64_t bi = 0; bi < b; ++bi)
+      for (int64_t i = 0; i < oy; ++i)
+        for (int64_t j = 0; j < ox; ++j) {
+          const float* src =
+              in.data() + ((bi * oy + i) * ox + j) * c;
+          for (int64_t p = 0; p < ky_; ++p)
+            for (int64_t q = 0; q < kx_; ++q) {
+              float* dst = acc.data()
+                  + ((bi * need_h + (p + sy_ * i)) * need_w
+                     + (q + sx_ * j)) * c;
+              for (int64_t e = 0; e < c; ++e)
+                dst[e] += src[e] * inv;
+            }
+        }
+    out->Reset({b, h, w, c});
+    for (int64_t bi = 0; bi < b; ++bi)
+      for (int64_t y = 0; y < h; ++y)
+        std::copy_n(acc.data() + (bi * need_h + y) * need_w * c,
+                    w * c, out->data() + (bi * h + y) * w * c);
+  }
+
+ private:
+  int64_t kx_ = 0, ky_ = 0, sy_ = 1, sx_ = 1;
+  std::vector<int64_t> out_shape_;
+};
+
+VELES_REGISTER_UNIT("depooling", Depooling)
+
 // -- transformer units (NEW beyond libZnicz: the LM exports too) ---------
 
 class Embedding : public Unit {

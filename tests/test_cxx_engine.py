@@ -266,3 +266,30 @@ def test_cxx_generate_matches_python(engine, tmp_path):
     want = generate(wf, prompt.astype(numpy.int32), 6,
                     temperature=0.0)
     assert (got == want).all(), (got, want)
+
+
+def test_autoencoder_matches_oracle(engine, tmp_path):
+    """The MnistAE path (conv → pooling → depooling → deconv) exports
+    and runs forward in C++, matching the numpy oracle."""
+    prng.seed_all(91)
+    from veles.znicz_tpu.models import mnist_ae
+    saved = root.mnist_ae.loader.to_dict()
+    saved_epochs = root.mnist_ae.decision.get("max_epochs")
+    root.mnist_ae.loader.update({"minibatch_size": 25, "n_train": 100,
+                                 "n_valid": 50})
+    root.mnist_ae.decision.max_epochs = 1
+    try:
+        wf = mnist_ae.create_workflow(name="CxxAE")
+        wf.initialize(device="numpy")
+        wf.run()
+    finally:
+        root.mnist_ae.loader.update(saved)
+        root.mnist_ae.decision.max_epochs = saved_epochs
+    archive = os.path.join(tmp_path, "ae_archive")
+    wf.export_inference(archive)
+    x = numpy.array(wf.loader.minibatch_data.map_read().mem,
+                    numpy.float32)
+    expected = _forward_oracle(wf, x)
+    got = _run_infer(engine, archive, x, str(tmp_path))
+    assert got.shape == expected.shape
+    numpy.testing.assert_allclose(got, expected, atol=1e-4)

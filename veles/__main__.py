@@ -185,9 +185,14 @@ class Main:
                 except ValueError as exc:
                     raise SystemExit("--generate-text: %s" % exc)
             else:
-                prompt = numpy.array(
-                    [[int(t) for t in args.generate.split(",")]],
-                    numpy.int32)
+                try:
+                    prompt = numpy.array(
+                        [[int(t) for t in args.generate.split(",")]],
+                        numpy.int32)
+                except ValueError:
+                    raise SystemExit(
+                        "--generate: expected comma-separated integer "
+                        "token ids, got %r" % args.generate)
             step = getattr(self.workflow, "xla_step", None)
             if step is not None:
                 step.sync_host()

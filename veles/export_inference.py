@@ -31,6 +31,7 @@ ENGINE_TYPES = frozenset({
     "activation_sigmoid",
     "embedding", "layernorm", "token_dense", "token_dense_relu",
     "transformer_ffn", "attention", "moe_ffn", "transformer_stack",
+    "deconv", "depooling",
 })
 
 
@@ -78,6 +79,7 @@ def _unit_spec(unit, path):
     from veles.znicz_tpu.ops.transformer_stack import (
         TransformerBlockStack)
     from veles.znicz_tpu.ops.conv import ConvBase
+    from veles.znicz_tpu.ops.deconv import Deconv, Depooling
     from veles.znicz_tpu.ops.embedding import EmbeddingForward
     from veles.znicz_tpu.ops.layernorm import LayerNormForward
     from veles.znicz_tpu.ops.pooling import (
@@ -106,6 +108,23 @@ def _unit_spec(unit, path):
             "padding": list(unit.padding),
         })
         _export_weighted(unit, path, spec)
+    elif isinstance(unit, Deconv):
+        spec["config"].update({
+            "n_kernels": int(unit.n_kernels),
+            "kx": int(unit.kx), "ky": int(unit.ky),
+            "sliding": list(unit.sliding),
+            "padding": list(unit.padding),
+            # the resolved output geometry (output_shape_source pins
+            # it at initialize time; the engine cannot re-derive it)
+            "out_shape": [int(d) for d in unit._oshape[1:]],
+        })
+        _save_extra(unit, path, spec, "weights")
+    elif isinstance(unit, Depooling):
+        spec["config"].update({
+            "kx": int(unit.kx), "ky": int(unit.ky),
+            "sliding": list(unit.sliding),
+            "out_shape": [int(d) for d in unit._oshape[1:]],
+        })
     elif isinstance(unit, StochasticPooling):
         raise ValueError(
             "%s: stochastic pooling has no deterministic inference "

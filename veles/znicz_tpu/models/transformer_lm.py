@@ -86,7 +86,14 @@ class TextLMLoader(FullBatchLoader):
         s = cfg.get("seq_len", 32)
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             text = f.read()
-        self.itos, self.stoi = text_vocab(path, text)
+        # _loader_factory stashes the vocab it already computed for
+        # this exact file; recompute only when absent/stale
+        cached = cfg.get("_vocab_cache")
+        if cached and cached[0] == path:
+            self.itos = list(cached[1])
+            self.stoi = {c: i for i, c in enumerate(self.itos)}
+        else:
+            self.itos, self.stoi = text_vocab(path, text)
         stream = numpy.fromiter(
             (self.stoi[c] for c in text), numpy.int32, len(text))
         n = (len(stream) - 1) // s
@@ -280,6 +287,7 @@ def _loader_factory():
     if cfg.get("text_file"):
         itos, _ = text_vocab(cfg.text_file)
         cfg.vocab = len(itos)
+        cfg._vocab_cache = (cfg.text_file, "".join(itos))
         cls = TextLMLoader
     else:
         cls = PeriodicLMLoader
