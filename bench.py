@@ -204,7 +204,7 @@ def lm_scale_tokens_per_sec(measure_chunks=1):
          "seq_len": 512, "vocab": 32, "max_period": 8},
         {"dim": 768, "heads": 12, "layers": 8, "ffn_hidden": 3072,
          "attn_block": 128},
-        "BenchLMScale", 1, measure_chunks)
+        "BenchLMScale", 4, measure_chunks)
 
 
 def main():
