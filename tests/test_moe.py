@@ -238,3 +238,66 @@ def test_moe_lm_trains_and_ep_matches_single_device():
     assert len(leaf.sharding.device_set) == 8
     spec = leaf.sharding.spec
     assert spec and spec[0] == "expert", spec
+
+
+def test_moe_lm_single_slave_matches_standalone():
+    """The elastic master/slave compat path ships EVERY forward
+    parameter (router/experts included): one-slave distributed
+    training of the MoE LM equals sequential SGD bitwise-ish."""
+    from veles.server import MasterServer
+    from veles.client import SlaveClient
+    from veles.loader.base import CLASS_TRAIN
+    from veles.znicz_tpu.models import transformer_lm
+
+    def make(name, seed=606):
+        prng.seed_all(seed)
+        root.lm.loader.update({"minibatch_size": 16, "n_train": 64,
+                               "n_valid": 16, "seq_len": 8,
+                               "vocab": 8, "max_period": 4})
+        root.lm.model.update({"dim": 16, "heads": 2, "layers": 1,
+                              "ffn_hidden": 32, "moe_experts": 2,
+                              "attn_block": None, "attn_impl": None,
+                              "stacked": False})
+        root.lm.decision.max_epochs = 2
+        root.lm.parallel.update({"seq": 1, "model": 1, "data": 1,
+                                 "expert": 1, "pipe": 1})
+        wf = transformer_lm.create_workflow(name=name)
+        wf.initialize(device="numpy")
+        wf.loader.shuffle_enabled = False
+        wf.loader._start_epoch(first=True)
+        return wf
+
+    try:
+        ref = make("MoERef")
+        loader = ref.loader
+        for _ in range(2 * loader.effective_batches_per_epoch):
+            loader.run()
+            for u in ref.forwards:
+                u.run()
+            ref.evaluator.run()
+            if loader.minibatch_class == CLASS_TRAIN:
+                for gd in reversed(ref.gds):
+                    gd.run()
+        moe_ref = [f for f in ref.forwards
+                   if type(f).__name__ == "MoEFFN"][0]
+        w_ref = {k: numpy.array(getattr(moe_ref, k).map_read().mem)
+                 for k in moe_ref.PARAMS}
+
+        master_wf = make("MoEMaster")
+        server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2)
+        server.start_background()
+        addr = "127.0.0.1:%d" % server.bound_address[1]
+        slave = make("MoESlave")
+        slave.is_slave = True
+        SlaveClient(slave, addr, name="moes1").run_forever()
+        assert server.done.is_set()
+        moe_m = [f for f in master_wf.forwards
+                 if type(f).__name__ == "MoEFFN"][0]
+        for k in moe_ref.PARAMS:   # router AND experts converged alike
+            numpy.testing.assert_allclose(
+                getattr(moe_m, k).map_read().mem, w_ref[k],
+                atol=1e-6, err_msg=k)
+    finally:
+        root.lm.model.moe_experts = 0
+        root.lm.parallel.update({"seq": 1, "model": 1, "data": 1,
+                                 "expert": 1})
