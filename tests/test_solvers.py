@@ -184,8 +184,10 @@ def test_lm_trains_with_adam_from_config():
 
 
 def test_warmup_cosine_policy():
-    """warmup_cosine: linear ramp to base at t=warmup, cosine decay to
-    min_ratio*base at t=total, flat after; numpy == traced values."""
+    """warmup_cosine: linear ramp (t+1)/warmup — NONZERO at t=0 so the
+    first optimizer step isn't a no-op — reaching base at t=warmup-1,
+    cosine decay to min_ratio*base at t=total, flat after; numpy ==
+    traced values."""
     import jax
     import jax.numpy as jnp
     from veles.znicz_tpu.lr_adjust import make_policy
@@ -193,8 +195,10 @@ def test_warmup_cosine_policy():
     pol = make_policy({"name": "warmup_cosine", "warmup": 10,
                        "total": 110, "min_ratio": 0.1})
     base = 0.4
-    assert abs(pol(numpy, base, 0) - 0.0) < 1e-7
-    assert abs(pol(numpy, base, 5) - 0.2) < 1e-6
+    assert abs(pol(numpy, base, 0) - base * 0.1) < 1e-7
+    assert pol(numpy, base, 0) > 0.0
+    assert abs(pol(numpy, base, 5) - base * 0.6) < 1e-6
+    assert abs(pol(numpy, base, 9) - base) < 1e-6
     assert abs(pol(numpy, base, 10) - base) < 1e-6
     mid = pol(numpy, base, 60)           # halfway through the decay
     assert abs(mid - base * 0.55) < 1e-6  # 0.1 + 0.9*0.5

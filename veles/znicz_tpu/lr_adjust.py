@@ -115,7 +115,9 @@ class WarmupCosinePolicy(LRPolicy):
     def __call__(self, xp, lr, t):
         tf = t.astype(numpy.float32) if hasattr(t, "astype") else \
             numpy.float32(t)
-        warm = tf / max(self.warmup, 1)
+        # (t+1)/warmup: the first step gets a nonzero lr instead of
+        # burning an Adam bias-correction step on a no-op update
+        warm = (tf + 1.0) / max(self.warmup, 1)
         frac = xp.clip((tf - self.warmup)
                        / (self.total - self.warmup), 0.0, 1.0)
         cos = self.min_ratio + (1.0 - self.min_ratio) * 0.5 \

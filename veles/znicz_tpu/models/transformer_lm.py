@@ -87,9 +87,25 @@ class TextLMLoader(FullBatchLoader):
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             text = f.read()
         # _loader_factory stashes the vocab it already computed for
-        # this exact file; recompute only when absent/stale
-        cached = cfg.get("_vocab_cache")
+        # this exact file; the file can change on disk between factory
+        # time (which sized cfg.vocab / the embedding) and now, so the
+        # cache is only trusted when it still covers the text we just
+        # read — a mismatch means the model was built for a different
+        # corpus, which is unrecoverable here
+        # NB: underscore names live as plain object attributes on the
+        # Config node (config.py:84), not in the _items tree that
+        # .get() consults — getattr is the only working read path
+        cached = getattr(cfg, "_vocab_cache", None)
         if cached and cached[0] == path:
+            vocab = set(cached[1])
+            extra = sorted(set(text) - vocab)
+            if extra:
+                raise ValueError(
+                    "%s changed on disk after the model was sized: "
+                    "%d characters (%r...) are not in the %d-char "
+                    "vocabulary the embedding was built for; restart "
+                    "the run" % (path, len(extra),
+                                 "".join(extra[:8]), len(vocab)))
             self.itos = list(cached[1])
             self.stoi = {c: i for i, c in enumerate(self.itos)}
         else:

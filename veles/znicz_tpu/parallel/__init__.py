@@ -167,14 +167,17 @@ def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True):
                           (getattr(fwd, key).mem.ndim - 1))))
             smap[(fwd.name, key)] = sh
             if gd is not None:
-                # momentum AND accumulation state shard like the param
+                # momentum, accumulation AND Adam second-moment state
+                # shard like the param
                 smap[(gd.name, "vel_" + key)] = sh
                 smap[(gd.name, "acc_" + key)] = sh
+                smap[(gd.name, "sq_" + key)] = sh
         rep = NamedSharding(mesh, P())
         smap[(fwd.name, "router")] = rep
         if gd is not None:
             smap[(gd.name, "vel_router")] = rep
             smap[(gd.name, "acc_router")] = rep
+            smap[(gd.name, "sq_router")] = rep
         touched += 1
     if not touched:
         raise ValueError("no MoE units to expert-parallelize")
@@ -235,6 +238,7 @@ def setup_pipeline_parallel(workflow, mesh, axis="pipe",
             if gd is not None:
                 smap[(gd.name, "vel_" + key)] = sh
                 smap[(gd.name, "acc_" + key)] = sh
+                smap[(gd.name, "sq_" + key)] = sh
         touched += 1
     if not touched:
         raise ValueError("no block-stack units to pipeline")
@@ -277,9 +281,11 @@ def setup_tensor_parallel(workflow, mesh, axis="model", refresh=True):
         def put(key, sh, vel_key=None):
             smap[(fwd.name, key)] = sh
             if gd is not None and vel_key:
-                # momentum AND accumulation state shard like the param
+                # momentum, accumulation AND Adam second-moment state
+                # shard like the param
                 smap[(gd.name, vel_key)] = sh
                 smap[(gd.name, vel_key.replace("vel_", "acc_"))] = sh
+                smap[(gd.name, vel_key.replace("vel_", "sq_"))] = sh
         if isinstance(fwd, MultiHeadAttention):
             if (fwd.heads % n) or fwd.seq_mesh is not None:
                 continue   # head split impossible / ring owns attention
