@@ -76,14 +76,24 @@ def col2im(xp, cols, input_shape, ky, kx, stride, pads):
 def sliding_channel_sum(xp, x, window, reverse=False):
     """Sum over a centered window along the channel (last) axis, same
     length out (AlexNet LRN's cross-map window). ``reverse`` flips the
-    window asymmetry — the adjoint for even windows."""
+    window asymmetry — the adjoint for even windows.
+
+    Small windows sum ``window`` shifted slices directly — measured
+    1.7x faster than the cumsum difference on a v5e (the taps fuse
+    into one elementwise pass; cumsum serializes along the 128-lane
+    minor dim). Large windows keep the O(1)-in-window cumsum."""
     half_lo = (window - 1) // 2
     half_hi = window - 1 - half_lo
     if reverse:
         half_lo, half_hi = half_hi, half_lo
     padded = xp.pad(x, [(0, 0)] * (x.ndim - 1) + [(half_lo, half_hi)])
+    n = x.shape[-1]
+    if window <= 16:
+        out = padded[..., 0:n]
+        for i in range(1, window):
+            out = out + padded[..., i:i + n]
+        return out
     csum = xp.cumsum(padded, axis=-1)
     zero = xp.zeros_like(csum[..., :1])
     csum = xp.concatenate([zero, csum], axis=-1)
-    n = x.shape[-1]
     return csum[..., window:window + n] - csum[..., :n]
