@@ -153,23 +153,22 @@ def xla_cifar_images_per_sec(measure_chunks=1):
 
 def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
                    measure_chunks):
-    """Shared LM bench scaffold: save/override/restore the LM config
-    AND the engine compute dtype, then time dispatch chunks.
+    """Shared LM bench scaffold: save/override/restore the LM config,
+    then time dispatch chunks.
 
-    Runs with float32 compute dtype: measured on v5e, the bf16 matmul
-    casts cost the transformer units ~4% at 57M scale and ~30% at toy
-    scale (cast traffic dominates small matmuls), while the conv stack
-    gains — so each bench pins the measured-best engine config, as a
-    user would via ``root.common.engine.compute_dtype``."""
+    Runs with the engine defaults (bf16 compute + bf16 activation
+    policy on TPU): since round 3's mixed-precision policy — bf16
+    tensors BETWEEN units, f32 master weights and solver state, f32
+    loss/softmax/stat math — bf16 WINS on the 57M LM too (205k vs
+    195k tok/s on a v5e; round 2's per-matmul-cast design lost ~4%
+    here, which is why it used to pin float32)."""
     from veles.loader.base import CLASS_TRAIN
     from veles.config import root
     from veles.znicz_tpu.models import transformer_lm
     saved_loader = root.lm.loader.to_dict()
     saved_model = root.lm.model.to_dict()
-    saved_dtype = root.common.engine.get("compute_dtype")
     root.lm.loader.update(loader_cfg)
     root.lm.model.update(model_cfg)
-    root.common.engine.compute_dtype = "float32"
     seq = root.lm.loader.seq_len
     try:
         return _xla_throughput(
@@ -183,7 +182,6 @@ def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
         # sample defaults, so Config.update round-trips cleanly
         root.lm.loader.update(saved_loader)
         root.lm.model.update(saved_model)
-        root.common.engine.compute_dtype = saved_dtype
 
 
 def lm_tokens_per_sec(measure_chunks=1):
@@ -221,12 +219,13 @@ def main():
         extra["cifar_conv_images_per_sec_error"] = str(exc)[:200]
     try:
         from bench_alexnet import alexnet_images_per_sec
-        extra["alexnet_synth_images_per_sec"] = round(
-            alexnet_images_per_sec(), 1)
+        med, best = alexnet_images_per_sec()
+        extra["alexnet_synth_images_per_sec"] = round(best, 1)
+        extra["alexnet_synth_images_per_sec_median"] = round(med, 1)
     except ImportError:
         pass
     except Exception as exc:
-        extra["alexnet_images_per_sec_error"] = str(exc)[:200]
+        extra["alexnet_synth_images_per_sec_error"] = str(exc)[:200]
     try:
         extra["lm_train_tokens_per_sec"] = round(
             lm_tokens_per_sec(), 1)

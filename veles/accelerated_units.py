@@ -199,6 +199,14 @@ class FlowContext:
         import jax
         return jax.lax.pmean(tensor, self.axis_name)
 
+    @property
+    def act_dtype(self):
+        """Dtype for tensors flowing BETWEEN units (outputs / err
+        flows) — the mixed-precision activation policy. bf16 on TPU by
+        default; master weights and solver state stay f32 (see
+        ``XLADevice.act_dtype``)."""
+        return self._compiler.device.act_dtype
+
     def dot(self, a, b):
         """MXU-friendly matmul: inputs cast to the device compute dtype
         (bfloat16 on TPU), accumulation in float32."""

@@ -82,18 +82,33 @@ class XLADevice(Device):
         # conv stack (AlexNet +21%) but costs ~4% on the transformer
         # LM (cast traffic around the matmuls) — workloads differ.
         import jax.numpy as jnp
-        cfg_dt = root.common.engine.get("compute_dtype")
-        if compute_dtype is None and cfg_dt:
-            allowed = ("float32", "bfloat16", "float16")
-            if cfg_dt not in allowed:
-                raise ValueError(
-                    "root.common.engine.compute_dtype must be one of "
-                    "%s, got %r" % (allowed, cfg_dt))
-            compute_dtype = getattr(jnp, cfg_dt)
-        self.compute_dtype = compute_dtype or (
-            jnp.bfloat16 if self.platform in ("tpu", "axon")
-            else jnp.float32)
+
+        def policy_dtype(cfg_key, allowed):
+            """Config-overridable dtype with the TPU-first default:
+            bf16 on a TPU (incl. the tunnel's "axon" platform — same
+            MXU), f32 elsewhere (keeps the CPU parity suite exact)."""
+            cfg_dt = root.common.engine.get(cfg_key)
+            if cfg_dt:
+                if cfg_dt not in allowed:
+                    raise ValueError(
+                        "root.common.engine.%s must be one of %s, "
+                        "got %r" % (cfg_key, allowed, cfg_dt))
+                return getattr(jnp, cfg_dt)
+            return (jnp.bfloat16 if self.platform in ("tpu", "axon")
+                    else jnp.float32)
+
+        self.compute_dtype = compute_dtype or policy_dtype(
+            "compute_dtype", ("float32", "bfloat16", "float16"))
         self.param_dtype = param_dtype or jnp.float32
+        # Mixed-precision ACTIVATION policy (root.common.engine.amp =
+        # "bfloat16"/"float32"): tensors flowing BETWEEN units (outputs
+        # and err flows) are stored in this dtype; master weights and
+        # solver state stay in param_dtype (f32), loss/softmax/stat
+        # reductions compute in f32. On a v5e the f32 activation flow
+        # was the single largest cost of the AlexNet step (LRN, pooling
+        # scatter and bias-sum fusions are HBM-bandwidth-bound); bf16
+        # halves it.
+        self.act_dtype = policy_dtype("amp", ("float32", "bfloat16"))
         cache_dir = os.path.join(root.common.dirs.cache, "xla")
         os.makedirs(cache_dir, exist_ok=True)
         try:

@@ -43,7 +43,7 @@ class ActivationForward(Forward):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
         ctx.set(self, "output",
-                type(self).FUNC[0](jnp, x).astype(jnp.float32))
+                type(self).FUNC[0](jnp, x).astype(ctx.act_dtype))
 
 
 class ActivationBackward(GradientDescentBase):
@@ -65,7 +65,7 @@ class ActivationBackward(GradientDescentBase):
         y = ctx.get(f, "output")
         err = ctx.get(self, "err_output").reshape(y.shape)
         ctx.set(self, "err_input",
-                (err * type(f).FUNC[1](jnp, x, y)).astype(jnp.float32))
+                (err * type(f).FUNC[1](jnp, x, y)).astype(ctx.act_dtype))
 
 
 def _pair(name, fwd, deriv):

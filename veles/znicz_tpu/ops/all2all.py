@@ -72,12 +72,17 @@ class All2AllBase(Forward):
 
     # -- traced --------------------------------------------------------
 
+    #: softmax keeps its f32 output (probabilities feed log() in the
+    #: evaluator; bf16's 8-bit mantissa would quantize small probs)
+    OUTPUT_F32 = False
+
     def xla_run(self, ctx):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
         p = ctx.unit_params(self)
         y = self._forward(jnp, x, p["weights"], p.get("bias"), ctx.dot)
-        ctx.set(self, "output", y.astype(jnp.float32))
+        dt = jnp.float32 if self.OUTPUT_F32 else ctx.act_dtype
+        ctx.set(self, "output", y.astype(dt))
 
 
 @forward_unit("all2all")
@@ -111,6 +116,7 @@ class All2AllSoftmax(All2AllBase):
     (reference ``max_idx`` [U])."""
 
     ACTIVATION = "softmax"
+    OUTPUT_F32 = True
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)

@@ -76,7 +76,7 @@ class TokenDenseBase(Forward):
         ctx.set(self, "output",
                 self._forward(jnp, x, p["weights"], p.get("bias"),
                               ctx.dot)
-                .astype(jnp.float32))
+                .astype(ctx.act_dtype))
 
 
 @forward_unit("token_dense")
@@ -98,7 +98,9 @@ class GDTokenDenseBase(GradientDescentBase):
         x2 = x.reshape(-1, x.shape[-1])
         dz2 = dz.reshape(-1, dz.shape[-1])
         grad_w = dot(x2.T, dz2)
-        grad_b = dz2.sum(axis=0) if self.include_bias else None
+        # bias grads accumulate in f32 even when dz flows bf16
+        grad_b = dz2.sum(axis=0, dtype=xp.float32) \
+            if self.include_bias else None
         dx = dot(dz, w.T) if self.need_err_input else None
         return dx, grad_w, grad_b
 
@@ -125,7 +127,7 @@ class GDTokenDenseBase(GradientDescentBase):
         dx, gw, gb = self._backward(
             jnp, x, y, ctx.unit_params(f)["weights"], err, ctx.dot)
         if dx is not None:
-            ctx.set(self, "err_input", dx.astype(jnp.float32))
+            ctx.set(self, "err_input", dx.astype(ctx.act_dtype))
         self.update_weights_xla(ctx, gw, gb)
 
 
@@ -199,7 +201,7 @@ class TransformerFFN(Forward):
         p = ctx.unit_params(self)
         y, hcur = self._forward(jnp, x, p["weights"], p["bias"],
                                 p["weights2"], p["bias2"], ctx.dot)
-        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "output", y.astype(ctx.act_dtype))
         ctx.set(self, "cache_h", hcur)
 
 
@@ -213,9 +215,9 @@ class GDTransformerFFN(GradientDescentBase):
         dh = dot(err, w2.T)
         dh = dh * A.ACTIVATIONS[f.ACTIVATION][1](xp, hcur)
         gw2 = dot(hcur.reshape(-1, f.hidden).T, err.reshape(-1, d))
-        gb2 = err.reshape(-1, d).sum(axis=0)
+        gb2 = err.reshape(-1, d).sum(axis=0, dtype=xp.float32)
         gw1 = dot(x.reshape(-1, d).T, dh.reshape(-1, f.hidden))
-        gb1 = dh.reshape(-1, f.hidden).sum(axis=0)
+        gb1 = dh.reshape(-1, f.hidden).sum(axis=0, dtype=xp.float32)
         dx = dot(dh, w1.T)
         if f.residual:
             dx = dx + err
@@ -245,7 +247,7 @@ class GDTransformerFFN(GradientDescentBase):
         dx, gw1, gb1, gw2, gb2 = self._backward(
             jnp, x, p["weights"], p["weights2"], hcur, err, ctx.dot)
         if self.need_err_input:
-            ctx.set(self, "err_input", dx.astype(jnp.float32))
+            ctx.set(self, "err_input", dx.astype(ctx.act_dtype))
         self.update_weights_xla(ctx, gw1, gb1)
         self.update_extra_xla(ctx, {"weights2": gw2, "bias2": gb2})
 
@@ -407,14 +409,16 @@ class MultiHeadAttention(Forward):
             y, cache = self._fwd_pallas(jnp, x, p, ctx.dot)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
         elif self.attn_block_size:
-            y, cache = self._fwd_blocked(jnp, x, p, ctx.dot)
+            y, cache = self._fwd_blocked(
+                jnp, x, p, ctx.dot,
+                cd=ctx._compiler.device.compute_dtype)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
         else:
             y, cache = self._fwd_core(
                 jnp, x, p["weights"], p.get("bias"), p["weights_out"],
                 p.get("bias_out"), ctx.dot)
             names = ("q", "k", "v", "probs", "merged")
-        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "output", y.astype(ctx.act_dtype))
         for name, t in zip(names, cache):
             ctx.set(self, "cache_" + name, t)
 
@@ -435,10 +439,16 @@ class MultiHeadAttention(Forward):
             y = y + x
         return y
 
-    def _fwd_blocked(self, xp, x, p, dot):
-        """Single-chip flash-style forward: O(S·block) score memory."""
+    def _fwd_blocked(self, xp, x, p, dot, cd=None):
+        """Single-chip flash-style forward: O(S·block) score memory.
+        q/k/v live in the compute dtype ``cd`` (bf16 on TPU): every
+        consumer is a matmul, the probs/ds tiles inside the scan
+        inherit it (halving their HBM traffic), and the backward
+        caches cost half the memory."""
         from veles.znicz_tpu.parallel import flash
         q, k, v = self._project_qkv(x, p, dot)
+        if cd is not None:
+            q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
         out_heads, lse = flash.blocked_attention_fwd(
             q, k, v, causal=self.causal, block=self.attn_block_size,
             dot=dot)
@@ -500,7 +510,7 @@ class GDMultiHeadAttention(GradientDescentBase):
         scale = numpy.float32(1.0 / numpy.sqrt(dh))
 
         gwo = dot(merged.reshape(-1, d).T, err.reshape(-1, d))
-        gbo = err.reshape(-1, d).sum(axis=0)
+        gbo = err.reshape(-1, d).sum(axis=0, dtype=xp.float32)
         dmerged = dot(err, wo.T)
         dctx = f._split(dmerged)                       # (B,H,S,dh)
         dq, dk, dv = dense_attention_core_bwd(
@@ -508,7 +518,7 @@ class GDMultiHeadAttention(GradientDescentBase):
         dqkv = xp.concatenate(
             [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
         gw = dot(x.reshape(-1, d).T, dqkv.reshape(-1, 3 * d))
-        gb = dqkv.reshape(-1, 3 * d).sum(axis=0)
+        gb = dqkv.reshape(-1, 3 * d).sum(axis=0, dtype=xp.float32)
         dx = dot(dqkv, w.T)
         if f.residual:
             dx = dx + err
@@ -542,14 +552,14 @@ class GDMultiHeadAttention(GradientDescentBase):
             ctx.get(f, "cache_" + n)
             for n in ("q", "k", "v", "out_heads", "lse", "merged"))
         gwo = dot(merged.reshape(-1, d).T, err.reshape(-1, d))
-        gbo = err.reshape(-1, d).sum(axis=0)
+        gbo = err.reshape(-1, d).sum(axis=0, dtype=xp.float32)
         dmerged = dot(err, p["weights_out"].T)
         dctx = f._split(dmerged)
         dq, dk, dv = attn_bwd(q, k, v, out_heads, lse, dctx)
         dqkv = xp.concatenate(
             [f._merge(dq), f._merge(dk), f._merge(dv)], axis=-1)
         gw = dot(x.reshape(-1, d).T, dqkv.reshape(-1, 3 * d))
-        gb = dqkv.reshape(-1, 3 * d).sum(axis=0)
+        gb = dqkv.reshape(-1, 3 * d).sum(axis=0, dtype=xp.float32)
         dx = dot(dqkv, p["weights"].T)
         if f.residual:
             dx = dx + err
@@ -570,10 +580,11 @@ class GDMultiHeadAttention(GradientDescentBase):
         """Single-chip flash-style backward (block recomputation)."""
         from veles.znicz_tpu.parallel import flash
         f = self.forward
+        cd = ctx._compiler.device.compute_dtype
         return self._bwd_outer(
             xp, x, p, ctx, err,
             lambda q, k, v, o, lse, dctx: flash.blocked_attention_bwd(
-                q, k, v, o, lse, dctx, causal=f.causal,
+                q, k, v, o, lse, dctx.astype(cd), causal=f.causal,
                 block=f.attn_block_size, dot=ctx.dot))
 
     def _bwd_pallas(self, xp, x, p, ctx, err):
@@ -608,7 +619,7 @@ class GDMultiHeadAttention(GradientDescentBase):
                 jnp, x, p["weights"], p["weights_out"], cache, err,
                 ctx.dot)
         if self.need_err_input:
-            ctx.set(self, "err_input", dx.astype(jnp.float32))
+            ctx.set(self, "err_input", dx.astype(ctx.act_dtype))
         self.update_weights_xla(ctx, gw, gb if f.include_bias else None)
         self.update_extra_xla(ctx, {
             "weights_out": gwo,

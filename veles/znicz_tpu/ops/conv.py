@@ -92,7 +92,7 @@ class ConvBase(Forward):
             v = v + p["bias"]
         ctx.set(self, "output",
                 A.ACTIVATIONS[self.ACTIVATION][0](jnp, v)
-                .astype(jnp.float32))
+                .astype(ctx.act_dtype))
 
 
 @forward_unit("conv")

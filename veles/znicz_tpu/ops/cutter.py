@@ -84,9 +84,10 @@ class GDCutter(GradientDescentBase):
         # the host-initialized Array shape)
         err = err.reshape((-1,) + f.output.shape[1:])
         ishape = (err.shape[0],) + f.input.shape[1:]
-        ei = jnp.zeros(ishape, jnp.float32)
+        ei = jnp.zeros(ishape, ctx.act_dtype)
         ei = ei.at[:, f.y:f.y + err.shape[1],
-                   f.x:f.x + err.shape[2], :].set(err)
+                   f.x:f.x + err.shape[2], :].set(
+                       err.astype(ctx.act_dtype))
         ctx.set(self, "err_input", ei)
 
 

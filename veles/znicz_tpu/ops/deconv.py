@@ -99,7 +99,7 @@ class Deconv(Forward):
             lhs_dilation=(sy, sx),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             preferred_element_type=jnp.float32)
-        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "output", y.astype(ctx.act_dtype))
 
 
 @gradient_for(Deconv)
@@ -142,7 +142,7 @@ class GDDeconv(GradientDescentBase):
                 padding=((top, bottom), (left, right)),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 preferred_element_type=jnp.float32)
-            ctx.set(self, "err_input", ei)
+            ctx.set(self, "err_input", ei.astype(ctx.act_dtype))
         sy, sx = f.sliding
         if sy == 1 and sx == 1:
             gw = jax.lax.conv_general_dilated(
@@ -224,7 +224,7 @@ class Depooling(Forward):
         import jax.numpy as jnp
         ctx.set(self, "output",
                 self._spread(jnp, ctx.get(self, "input"))
-                .astype(jnp.float32))
+                .astype(ctx.act_dtype))
 
 
 @gradient_for(Depooling)
@@ -261,4 +261,4 @@ class GDDepooling(GradientDescentBase):
         err = ctx.get(self, "err_output").reshape(
             (-1,) + f.output.shape[1:])
         ctx.set(self, "err_input",
-                self._gather(jnp, err).astype(jnp.float32))
+                self._gather(jnp, err).astype(ctx.act_dtype))

@@ -67,9 +67,9 @@ class DropoutForward(Forward):
             return
         keep = 1.0 - self.dropout_ratio
         u = jax.random.uniform(ctx.fold_key(self), x.shape)
-        mask = (u < keep).astype(jnp.float32) / keep
+        mask = (u < keep).astype(ctx.act_dtype) / keep
         ctx.set(self, "mask", mask)
-        ctx.set(self, "output", (x * mask).astype(jnp.float32))
+        ctx.set(self, "output", (x * mask).astype(ctx.act_dtype))
 
 
 @gradient_for(DropoutForward)
@@ -87,4 +87,5 @@ class DropoutBackward(GradientDescentBase):
         f = self.forward
         err = ctx.get(self, "err_output")
         mask = ctx.get(f, "mask")
-        ctx.set(self, "err_input", (err.reshape(mask.shape) * mask))
+        ctx.set(self, "err_input", (err.reshape(mask.shape) * mask)
+                .astype(ctx.act_dtype))

@@ -66,7 +66,7 @@ class EmbeddingForward(Forward):
         ids = ctx.get(self, "input").astype(jnp.int32)
         table = ctx.unit_params(self)["weights"]
         ctx.set(self, "output",
-                self._forward(jnp, ids, table).astype(jnp.float32))
+                self._forward(jnp, ids, table).astype(ctx.act_dtype))
 
 
 @gradient_for(EmbeddingForward)

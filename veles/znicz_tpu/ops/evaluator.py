@@ -126,13 +126,14 @@ class EvaluatorSoftmax(EvaluatorBase):
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
-        probs = ctx.get(self, "input")
+        # loss math in f32 regardless of the activation policy
+        probs = ctx.get(self, "input").astype(jnp.float32)
         labels = ctx.get(self, "labels").astype(jnp.int32)
         max_idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)
         valid = ctx.get(self, "batch_size")  # traced int scalar
         err, loss, wrong, max_err, max_err_idx, conf = self._compute(
             jnp, probs, labels, max_idx, valid)
-        ctx.set(self, "err_output", err)
+        ctx.set(self, "err_output", err.astype(ctx.act_dtype))
         ctx.export("loss", loss)
         ctx.export("n_err", wrong.astype(jnp.int32))
         ctx.export("max_err", max_err)
@@ -180,11 +181,13 @@ class EvaluatorMSE(EvaluatorBase):
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
-        y = ctx.get(self, "input")
-        t = ctx.get(self, "target")
+        # loss math in f32 regardless of the activation policy
+        y = ctx.get(self, "input").astype(jnp.float32)
+        t = ctx.get(self, "target").astype(jnp.float32)
         valid = ctx.get(self, "batch_size").astype(jnp.float32)
         err, mse, max_err, max_err_idx = self._compute(jnp, y, t, valid)
-        ctx.set(self, "err_output", err.reshape(y.shape))
+        ctx.set(self, "err_output",
+                err.reshape(y.shape).astype(ctx.act_dtype))
         ctx.export("loss", mse)
         ctx.export("n_err", jnp.int32(0))
         ctx.export("max_err", max_err)
@@ -230,10 +233,11 @@ class EvaluatorLM(EvaluatorBase):
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
-        logits = ctx.get(self, "input")
+        # loss math in f32 regardless of the activation policy
+        logits = ctx.get(self, "input").astype(jnp.float32)
         labels = ctx.get(self, "labels").astype(jnp.int32)
         valid = ctx.get(self, "batch_size")
         err, loss, wrong = self._compute(jnp, logits, labels, valid)
-        ctx.set(self, "err_output", err)
+        ctx.set(self, "err_output", err.astype(ctx.act_dtype))
         ctx.export("loss", loss)
         ctx.export("n_err", wrong.astype(jnp.int32))

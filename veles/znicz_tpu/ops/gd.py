@@ -72,10 +72,13 @@ class GDBase(GradientDescentBase):
         x2 = x.reshape(x.shape[0], -1)
         if self.need_err_input:
             ei = ctx.dot(dz, w if self.weights_transposed else w.T)
-            ctx.set(self, "err_input", ei.reshape(x.shape))
+            ctx.set(self, "err_input",
+                    ei.reshape(x.shape).astype(ctx.act_dtype))
         grad_w = ctx.dot(dz.T, x2) if self.weights_transposed \
             else ctx.dot(x2.T, dz)
-        grad_b = dz.sum(axis=0) if self.include_bias else None
+        # bias grad accumulates in f32 even when dz flows bf16
+        grad_b = dz.sum(axis=0, dtype=jnp.float32) \
+            if self.include_bias else None
         self.update_weights_xla(ctx, grad_w, grad_b)
 
 

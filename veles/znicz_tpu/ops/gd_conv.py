@@ -83,7 +83,7 @@ class GDConvBase(GradientDescentBase):
                 lhs_dilation=(sy, sx),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 preferred_element_type=jnp.float32)
-            ctx.set(self, "err_input", ei)
+            ctx.set(self, "err_input", ei.astype(ctx.act_dtype))
 
         # grad_w[k, ky*kx*C]: conv with batch as the contraction dim;
         # the forward stride becomes rhs_dilation. This form holds for
@@ -102,7 +102,9 @@ class GDConvBase(GradientDescentBase):
             preferred_element_type=jnp.float32)   # -> (C,ky,kx,K)
         grad_w = gw.transpose(3, 1, 2, 0) \
             .reshape(f.n_kernels, f.ky * f.kx * c)
-        grad_b = dz.sum(axis=(0, 1, 2)) if self.include_bias else None
+        # bias grad accumulates in f32 even when dz flows bf16
+        grad_b = dz.sum(axis=(0, 1, 2), dtype=jnp.float32) \
+            if self.include_bias else None
         self.update_weights_xla(ctx, grad_w, grad_b)
 
     @property

@@ -59,7 +59,7 @@ class GDPoolingBase(GradientDescentBase):
         err = ctx.get(self, "err_output").reshape(
             (-1,) + f.output.shape[1:])
         ctx.set(self, "err_input",
-                self._route(jnp, err, ctx).astype(jnp.float32))
+                self._route(jnp, err, ctx).astype(ctx.act_dtype))
 
     def _route(self, xp, err, ctx):
         raise NotImplementedError

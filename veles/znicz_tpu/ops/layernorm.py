@@ -74,11 +74,12 @@ class LayerNormForward(Forward):
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
-        x = ctx.get(self, "input")
+        # normalization statistics in f32 under the bf16 policy
+        x = ctx.get(self, "input").astype(jnp.float32)
         p = ctx.unit_params(self)
         ctx.set(self, "output",
                 self._forward(jnp, x, p["weights"], p["bias"])
-                .astype(jnp.float32))
+                .astype(ctx.act_dtype))
 
 
 @gradient_for(LayerNormForward)
@@ -99,12 +100,13 @@ class GDLayerNorm(GradientDescentBase):
         self.update_weights_numpy(dg, db)
 
     def xla_run(self, ctx):
-        f = self.forward
-        x = ctx.get(f, "input")
-        err = ctx.get(self, "err_output").reshape(x.shape)
         import jax.numpy as jnp
+        f = self.forward
+        x = ctx.get(f, "input").astype(jnp.float32)
+        err = ctx.get(self, "err_output").reshape(x.shape) \
+            .astype(jnp.float32)
         dx, dg, db = self._backward(
             jnp, x, ctx.unit_params(f)["weights"], err)
         if self.need_err_input:
-            ctx.set(self, "err_input", dx.astype(jnp.float32))
+            ctx.set(self, "err_input", dx.astype(ctx.act_dtype))
         self.update_weights_xla(ctx, dg, db)

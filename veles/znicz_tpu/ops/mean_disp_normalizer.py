@@ -45,4 +45,4 @@ class MeanDispNormalizer(Forward):
         mean = ctx.get(self, "mean")
         rdisp = ctx.get(self, "rdisp")
         ctx.set(self, "output",
-                ((x - mean) * rdisp).astype(jnp.float32))
+                ((x - mean) * rdisp).astype(ctx.act_dtype))

@@ -164,7 +164,7 @@ class MoEFFN(Forward):
         x = ctx.get(self, "input")
         y, cache = self._forward(jnp, x, ctx.unit_params(self),
                                  ctx.einsum)
-        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "output", y.astype(ctx.act_dtype))
         for k, v in cache.items():
             ctx.set(self, "cache_" + k, v)
 
@@ -259,6 +259,6 @@ class GDMoEFFN(GradientDescentBase):
         dx, grads = self._backward(jnp, x, p, cache, err,
                                    h["aux_weight"], ctx.einsum)
         if self.need_err_input:
-            ctx.set(self, "err_input", dx.astype(jnp.float32))
+            ctx.set(self, "err_input", dx.astype(ctx.act_dtype))
         self.update_weights_xla(ctx, grads["weights"], grads["bias"])
         self.update_extra_xla(ctx, grads)

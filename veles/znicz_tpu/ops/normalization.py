@@ -56,9 +56,12 @@ class LRNormalizerForward(Forward):
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
-        x = ctx.get(self, "input")
+        # the window statistic d accumulates squares — keep it f32
+        # under the bf16 activation policy (intermediates fuse; only
+        # the bf16 input read and output write touch HBM)
+        x = ctx.get(self, "input").astype(jnp.float32)
         y, _ = self._forward(jnp, x)
-        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "output", y.astype(ctx.act_dtype))
 
 
 @gradient_for(LRNormalizerForward)
@@ -84,7 +87,8 @@ class LRNormalizerBackward(GradientDescentBase):
     def xla_run(self, ctx):
         import jax.numpy as jnp
         f = self.forward
-        x = ctx.get(f, "input")
-        err = ctx.get(self, "err_output").reshape(x.shape)
+        x = ctx.get(f, "input").astype(jnp.float32)
+        err = ctx.get(self, "err_output").reshape(x.shape) \
+            .astype(jnp.float32)
         ctx.set(self, "err_input",
-                self._backward(jnp, x, err).astype(jnp.float32))
+                self._backward(jnp, x, err).astype(ctx.act_dtype))

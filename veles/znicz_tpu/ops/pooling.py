@@ -76,7 +76,7 @@ class PoolingBase(Forward):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
         y = self._run_generic(jnp, x, ctx)
-        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "output", y.astype(ctx.act_dtype))
 
     def _run_generic(self, xp, x, ctx):
         raise NotImplementedError

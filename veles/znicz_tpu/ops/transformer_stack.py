@@ -133,7 +133,7 @@ class TransformerBlockStack(Forward):
         else:
             y, caches = PL.stack_fwd(p, x, self.heads, self.causal,
                                      self.eps, ctx.dot)
-        ctx.set(self, "output", y.astype(jnp.float32))
+        ctx.set(self, "output", y.astype(ctx.act_dtype))
         ctx.set(self, "cache_stack", caches)
 
 
@@ -185,6 +185,6 @@ class GDTransformerBlockStack(GradientDescentBase):
             dx, grads = PL.stack_bwd(p, caches, err, f.heads, f.eps,
                                      ctx.dot, ctx.einsum)
         if self.need_err_input:
-            ctx.set(self, "err_input", dx.astype(jnp.float32))
+            ctx.set(self, "err_input", dx.astype(ctx.act_dtype))
         self.update_weights_xla(ctx, grads["weights"], grads["bias"])
         self.update_extra_xla(ctx, grads)
