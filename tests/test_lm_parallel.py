@@ -72,6 +72,9 @@ def test_lm_ring_from_config(dense_wf):
     assert ring[-1] < ring[0]
     for a, b in zip(ring, dense):
         assert abs(a - b) < 0.05, (ring, dense)
+    # the ring's neighbour hops must survive into the partitioned HLO
+    from veles.znicz_tpu import parallel
+    parallel.assert_collectives(wf.xla_step, ["collective-permute"])
 
 
 def test_lm_tensor_parallel_from_config(dense_wf):
@@ -93,6 +96,9 @@ def test_lm_tensor_parallel_from_config(dense_wf):
     assert tp[-1] < tp[0]
     for a, b in zip(tp, dense):
         assert abs(a - b) < 0.05, (tp, dense)
+    # row-sharded contractions must all-reduce in the partitioned HLO
+    from veles.znicz_tpu import parallel
+    parallel.assert_collectives(wf.xla_step, ["all-reduce"])
 
 
 def test_lm_dp_plus_tp(dense_wf):
@@ -101,6 +107,8 @@ def test_lm_dp_plus_tp(dense_wf):
     step = wf.xla_step
     assert step.batch_sharding is not None
     assert step.param_sharding_map
+    from veles.znicz_tpu import parallel
+    parallel.assert_collectives(step, ["all-reduce"])
     hist, dense = _history(wf), _history(dense_wf)
     assert hist[-1] < hist[0]
     for a, b in zip(hist, dense):
@@ -117,6 +125,9 @@ def test_lm_sp_plus_dp(dense_wf):
     assert mha.seq_mesh is not None
     assert mha.seq_batch_axis == "data"
     assert dict(mha.seq_mesh.shape) == {"data": 2, "seq": 4}
+    from veles.znicz_tpu import parallel
+    parallel.assert_collectives(
+        wf.xla_step, ["collective-permute", "all-reduce"])
     hist, dense = _history(wf), _history(dense_wf)
     assert hist[-1] < hist[0]
     for a, b in zip(hist, dense):

@@ -238,6 +238,12 @@ def test_moe_lm_trains_and_ep_matches_single_device():
     assert len(leaf.sharding.device_set) == 8
     spec = leaf.sharding.spec
     assert spec and spec[0] == "expert", spec
+    # gather-based EP in the partitioned HLO: tokens reach the
+    # one-expert-per-device shards via all-gather (GSPMD's lowering of
+    # the one-hot dispatch einsum at these shapes), gradients
+    # all-reduce over data — proves distribution, not replication
+    from veles.znicz_tpu import parallel
+    parallel.assert_collectives(step, ["all-gather", "all-reduce"])
 
 
 def test_moe_lm_single_slave_matches_standalone():

@@ -162,3 +162,8 @@ def test_stacked_lm_trains_and_pp_matches_single_device():
     leaf = step.params[stacks[0].name]["weights"]
     assert len(leaf.sharding.device_set) == 8
     assert leaf.sharding.spec[0] == "pipe", leaf.sharding.spec
+    # stage hops must survive into the partitioned HLO as
+    # collective-permute; gradient sync over data as all-reduce
+    from veles.znicz_tpu import parallel
+    parallel.assert_collectives(
+        step, ["collective-permute", "all-reduce"])

@@ -288,13 +288,18 @@ class XLAStep(Unit):
                             - decision._epochs_since_best)
         return max(1, chunk)
 
-    def _dispatch_epoch(self):
-        """Run a CHUNK of whole epochs (every class segment, serving
-        order) as one compiled program; fetch all stacked metrics in
-        one host round-trip."""
+    def _epoch_program(self, n_epochs=None):
+        """(fn, args, n_epochs, serves_per_epoch, classes): the EXACT
+        compiled program and arguments the next scan-mode dispatch
+        will run. Shared by ``_dispatch_epoch`` and the HLO
+        introspection path (``lowered_epoch_hlo``) so what gets
+        inspected can never drift from what gets executed.
+        Side-effect free: ``peek_epoch_orders`` is cached/idempotent
+        and ``jax.jit(...).lower`` neither executes nor donates."""
         import jax
         loader = self.loader
-        n_epochs = self._epochs_per_dispatch()
+        if n_epochs is None:
+            n_epochs = self._epochs_per_dispatch()
         orders = loader.peek_epoch_orders(n_epochs)
         n_epochs = len(orders)
         full = loader.device_full_arrays(
@@ -336,7 +341,31 @@ class XLAStep(Unit):
         offsets = numpy.int32(
             self.step_index
             + serves_per_epoch * numpy.arange(n_epochs, dtype=numpy.int64))
-        key = self.base_key
+        args = (self.params, self.state, full, idxs, valids,
+                self._gather_hyper(), self.base_key, offsets)
+        return fn, args, n_epochs, serves_per_epoch, classes
+
+    def lowered_epoch_hlo(self, optimized=True, n_epochs=1):
+        """HLO text of the next scan-mode dispatch's program, lowered
+        with the REAL sharded arguments. ``optimized=True`` returns the
+        post-GSPMD-partitioning module — the one whose collective ops
+        (all-reduce / all-to-all / collective-permute / all-gather /
+        reduce-scatter) prove how work is actually distributed on the
+        mesh (SURVEY.md §4 "TPU build translation"; VERDICT r2 #5)."""
+        fn, args, _, _, _ = self._epoch_program(n_epochs)
+        lowered = fn.lower(*args)
+        if not optimized:
+            return lowered.as_text()
+        return lowered.compile().as_text()
+
+    def _dispatch_epoch(self):
+        """Run a CHUNK of whole epochs (every class segment, serving
+        order) as one compiled program; fetch all stacked metrics in
+        one host round-trip."""
+        import jax
+        loader = self.loader
+        fn, args, n_epochs, serves_per_epoch, classes = \
+            self._epoch_program()
         # Stash a CONSISTENT epoch-entry view (params + optimizer state
         # + step counter — the point the epoch's validation metric
         # describes, since valid is served before train): improved-
@@ -351,9 +380,7 @@ class XLAStep(Unit):
             self._pre_epoch_step_index = self.step_index
         self.step_index += serves_per_epoch * n_epochs
         t0 = time.perf_counter()
-        self.params, self.state, outs = fn(
-            self.params, self.state, full, idxs, valids,
-            self._gather_hyper(), key, offsets)
+        self.params, self.state, outs = fn(*args)
         host_outs = _fetch_tree(outs)
         dt = time.perf_counter() - t0
         if n_epochs in self._seen_chunk_lengths:
