@@ -152,7 +152,7 @@ def test_stacked_lm_trains_and_pp_matches_single_device():
     h1 = [e["validation"]["metric"] for e in wf1.decision.history]
     assert h1[-1] < h1[0], h1
     wf8 = _run_stacked_lm("xla", {"pipe": 4, "data": 2,
-                                  "microbatches": 2})
+                                  "microbatches": 4})
     h8 = [e["validation"]["metric"] for e in wf8.decision.history]
     assert numpy.allclose(h1, h8, atol=1e-2), (h1, h8)
     step = wf8.xla_step
@@ -167,3 +167,99 @@ def test_stacked_lm_trains_and_pp_matches_single_device():
     from veles.znicz_tpu import parallel
     parallel.assert_collectives(
         step, ["collective-permute", "all-reduce"])
+
+
+def test_1f1b_schedule_properties():
+    """Static-schedule invariants: every stage finishes M forwards and
+    M backwards; causality holds (consume strictly after neighbour
+    production); peak stash per stage is min(M, P - s) — the 1F1B
+    memory bound — and total ticks match GPipe's 2(M + P - 1)."""
+    for P, M in [(2, 2), (2, 8), (4, 4), (4, 8), (3, 5)]:
+        actions, fidx, bidx = PL.build_1f1b_schedule(P, M)
+        T = actions.shape[0]
+        assert T == 2 * (M + P - 1), (P, M, T)
+        for s in range(P):
+            f_ticks = {int(fidx[t, s]): t for t in range(T)
+                       if actions[t, s] == 1}
+            b_ticks = {int(bidx[t, s]): t for t in range(T)
+                       if actions[t, s] == 2}
+            assert sorted(f_ticks) == list(range(M))
+            assert sorted(b_ticks) == list(range(M))
+            # stash bound: live caches (fwd done, bwd not yet)
+            peak = 0
+            for t in range(T):
+                live = sum(1 for m in range(M)
+                           if f_ticks[m] <= t < b_ticks[m])
+                peak = max(peak, live)
+            assert peak <= min(M, max(P - s, 1)), (P, M, s, peak)
+            if s > 0:
+                prev_f = {int(fidx[t, s - 1]): t for t in range(T)
+                          if actions[t, s - 1] == 1}
+                for m in range(M):
+                    assert f_ticks[m] > prev_f[m], (P, M, s, m)
+            if s < P - 1:
+                nxt_b = {int(bidx[t, s + 1]): t for t in range(T)
+                         if actions[t, s + 1] == 2}
+                for m in range(M):
+                    assert b_ticks[m] > nxt_b[m], (P, M, s, m)
+
+
+@pytest.mark.parametrize("axes,batch_axis,n_micro", [
+    ({"pipe": 4}, None, 4),
+    ({"pipe": 2}, None, 6),
+    ({"data": 2, "pipe": 4}, "data", 2),
+], ids=["pp4m4", "pp2m6", "dp2xpp4"])
+def test_1f1b_matches_scan(axes, batch_axis, n_micro):
+    """The interleaved 1F1B schedule is a pure re-ordering: y, dx,
+    grads and loss must equal stack_fwd + err_fn + stack_bwd."""
+    import jax
+    import jax.numpy as jnp
+
+    prng.seed_all(78)
+    gen = prng.get("pp1f1b")
+    L, B, S, D, H, heads = 4, 24, 6, 8, 16, 2
+    mesh = _mesh(axes)
+    x = gen.normal(0, 1.0, (B, S, D)).astype(numpy.float32)
+    tgt = gen.normal(0, 1.0, (B, S, D)).astype(numpy.float32)
+    params = {}
+    shapes = {"weights": (L, D, 3 * D), "bias": (L, 3 * D),
+              "weights_out": (L, D, D), "bias_out": (L, D),
+              "ln1_g": (L, D), "ln1_b": (L, D),
+              "ffn_w1": (L, D, H), "ffn_b1": (L, H),
+              "ffn_w2": (L, H, D), "ffn_b2": (L, D),
+              "ln2_g": (L, D), "ln2_b": (L, D)}
+    for k, shp in shapes.items():
+        if k.endswith("_g"):
+            params[k] = numpy.ones(shp, numpy.float32)
+        elif "bias" in k or k.endswith("_b"):
+            params[k] = numpy.zeros(shp, numpy.float32)
+        else:
+            params[k] = gen.normal(0, 0.3, shp).astype(numpy.float32)
+
+    def err_fn(y_mb, t_mb):
+        # simple differentiable head: mse grad + scalar loss
+        d = y_mb - t_mb
+        return 2.0 * d / d.size, jnp.sum(d * d) / d.size
+
+    y_ref, caches_ref = jax.jit(
+        lambda p, xx: PL.stack_fwd(p, xx, heads, True, 1e-5))(params, x)
+    derr_ref, loss_ref = err_fn(y_ref, jnp.asarray(tgt))
+    dx_ref, g_ref = jax.jit(
+        lambda p, c, e: PL.stack_bwd(p, c, e, heads, 1e-5))(
+        params, caches_ref, derr_ref)
+
+    y, dx, grads, loss = PL.pipeline_1f1b_step(
+        params, x, tgt, err_fn, mesh, batch_axis=batch_axis,
+        n_micro=n_micro, heads=heads, causal=True)
+    assert numpy.allclose(numpy.asarray(y), numpy.asarray(y_ref),
+                          atol=2e-5)
+    # per-microbatch loss normalizes by the microbatch size; rescale
+    dp = axes.get("data", 1)
+    scale = n_micro * dp
+    assert numpy.allclose(float(loss) / scale, float(loss_ref),
+                          atol=1e-5)
+    assert numpy.allclose(numpy.asarray(dx) / scale,
+                          numpy.asarray(dx_ref), atol=2e-4)
+    for k in g_ref:
+        assert numpy.allclose(numpy.asarray(grads[k]) / scale,
+                              numpy.asarray(g_ref[k]), atol=2e-4), k

@@ -341,3 +341,248 @@ def pipeline_bwd(params, caches, err, mesh, axis="pipe",
     sm = _shard_map()
     return sm(fn, mesh=mesh, in_specs=(pspec, cspecs, xspec),
               out_specs=(xspec, pspec))(params, caches, err)
+
+
+# ---------------------------------------------------------------------------
+# the 1F1B schedule (PipeDream-flush)
+
+
+def build_1f1b_schedule(n_stage, n_micro):
+    """Host-side static schedule: (actions, fidx, bidx) as (T, P)
+    int arrays — at tick t stage s performs actions[t, s] (0 idle,
+    1 forward, 2 backward) on microbatch fidx/bidx[t, s].
+
+    Classic non-interleaved 1F1B: stage s runs ``P - s`` warmup
+    forwards, then strictly alternates backward/forward, then drains
+    backwards. Compared to GPipe the bubble is the same 2(P-1) ticks
+    (T = 2(M + P - 1) for both at one-F-or-B-per-tick granularity) but
+    the peak activation stash per stage is ``min(M, P - s)``
+    microbatches instead of ``M`` — the reason 1F1B exists.
+
+    Built by simulation with explicit causality (an F/B consumes its
+    neighbour's output from a STRICTLY earlier tick), so the traced
+    schedule cannot deadlock by construction."""
+    P, M = int(n_stage), int(n_micro)
+    f_done = [[-1] * M for _ in range(P)]   # tick stage s finished F#m
+    b_done = [[-1] * M for _ in range(P)]
+    f_cnt = [0] * P
+    b_cnt = [0] * P
+    actions, fidx, bidx = [], [], []
+    t = 0
+    while any(b < M for b in b_cnt):
+        act_t, f_t, b_t = [], [], []
+        for s in range(P):
+            f, b = f_cnt[s], b_cnt[s]
+            can_f = f < M and (s == 0 or f_done[s - 1][f] >= 0) \
+                and (f - b) < max(P - s, 1)
+            can_b = b < M and (
+                (s == P - 1 and f_done[s][b] >= 0)
+                or (s < P - 1 and b_done[s + 1][b] >= 0))
+            # 1F1B priority: once warm, prefer draining a backward
+            warm = (f - b) >= max(P - s, 1) or f == M
+            if can_b and (warm or not can_f):
+                act_t.append(2)
+                f_t.append(0)
+                b_t.append(b)
+            elif can_f:
+                act_t.append(1)
+                f_t.append(f)
+                b_t.append(0)
+            else:
+                act_t.append(0)
+                f_t.append(0)
+                b_t.append(0)
+        # commit AFTER scheduling every stage (same-tick outputs must
+        # not be consumed this tick)
+        for s in range(P):
+            if act_t[s] == 1:
+                f_done[s][f_t[s]] = t
+                f_cnt[s] += 1
+            elif act_t[s] == 2:
+                b_done[s][b_t[s]] = t
+                b_cnt[s] += 1
+        actions.append(act_t)
+        fidx.append(f_t)
+        bidx.append(b_t)
+        t += 1
+        if t > 4 * (M + P):
+            raise RuntimeError("1F1B schedule did not converge")
+    return (numpy.asarray(actions, numpy.int32),
+            numpy.asarray(fidx, numpy.int32),
+            numpy.asarray(bidx, numpy.int32))
+
+
+def _pipeline_1f1b_local(params, x_loc, tgt_loc, schedule, err_fn,
+                         *, axis_name, n_stage, n_micro, heads,
+                         causal, eps, batch_axis=None, dot=None,
+                         es=None):
+    """Per-device 1F1B train-segment: forwards AND backwards interleave
+    per the static schedule; the LAST stage turns each finished
+    forward into its loss gradient via ``err_fn(y_mb, tgt_mb)`` so a
+    microbatch's backward starts P-s ticks after its forward instead
+    of after the whole forward phase. Returns (y_loc, dx_loc, grads,
+    loss_sum)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    actions, fidx, bidx = schedule
+    stage = lax.axis_index(axis_name)
+    b, s, d = x_loc.shape
+    bm = b // n_micro
+    x_mb = x_loc.reshape(n_micro, bm, s, d)
+    tgt_mb = tgt_loc.reshape((n_micro, bm) + tgt_loc.shape[1:])
+    run = functools.partial(_chunk_fwd, params, heads=heads,
+                            causal=causal, eps=eps, dot=dot)
+    depth = n_stage  # ring depth >= max stash/in-flight per stage
+    y_shape, cache_shape = jax.eval_shape(
+        run, jax.ShapeDtypeStruct((bm, s, d), jnp.float32))
+    caches0 = jax.tree_util.tree_map(
+        lambda sd: jnp.zeros((depth,) + sd.shape, sd.dtype),
+        cache_shape)
+    permF = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+    permB = [(i, (i - 1) % n_stage) for i in range(n_stage)]
+    gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def tick(carry, xs):
+        (ringF, ringB, derrs, caches, gacc, outs, dxs, loss) = carry
+        act_all, f_all, b_all, sentF_all, sentB_all = xs
+        act = act_all[stage]
+        fm = f_all[stage]
+        bmi = b_all[stage]
+
+        def do_idle(carry):
+            return carry
+
+        def do_f(carry):
+            ringF, ringB, derrs, caches, gacc, outs, dxs, loss = carry
+            feed = lax.dynamic_index_in_dim(x_mb, fm, 0,
+                                            keepdims=False)
+            recv = lax.dynamic_index_in_dim(ringF, fm % depth, 0,
+                                            keepdims=False)
+            xin = jnp.where(stage == 0, feed, recv)
+            y, cache = run(xin)
+            caches = jax.tree_util.tree_map(
+                lambda buf, c: lax.dynamic_update_index_in_dim(
+                    buf, c, fm % depth, 0),
+                caches, cache)
+            # last stage: the microbatch's loss gradient, immediately
+            tgt = lax.dynamic_index_in_dim(tgt_mb, fm, 0,
+                                           keepdims=False)
+            derr, mb_loss = err_fn(y, tgt)
+            derrs = lax.dynamic_update_index_in_dim(
+                derrs, derr, fm % depth, 0)
+            is_last = stage == n_stage - 1
+            outs = jnp.where(
+                is_last,
+                lax.dynamic_update_index_in_dim(outs, y, fm, 0), outs)
+            loss = loss + jnp.where(is_last, mb_loss, 0.0)
+            return (ringF, ringB, derrs, caches, gacc, outs, dxs,
+                    loss, y)
+
+        def do_b(carry):
+            ringF, ringB, derrs, caches, gacc, outs, dxs, loss = carry
+            recv = lax.dynamic_index_in_dim(ringB, bmi % depth, 0,
+                                            keepdims=False)
+            own = lax.dynamic_index_in_dim(derrs, bmi % depth, 0,
+                                           keepdims=False)
+            din = jnp.where(stage == n_stage - 1, own, recv)
+            cache_m = jax.tree_util.tree_map(
+                lambda buf: lax.dynamic_index_in_dim(
+                    buf, bmi % depth, 0, keepdims=False),
+                caches)
+            dx, grads = stack_bwd(params, cache_m, din, heads, eps,
+                                  dot, es)
+            gacc = jax.tree_util.tree_map(lambda a, g: a + g,
+                                          gacc, grads)
+            dxs = jnp.where(
+                stage == 0,
+                lax.dynamic_update_index_in_dim(dxs, dx, bmi, 0), dxs)
+            return (ringF, ringB, derrs, caches, gacc, outs, dxs,
+                    loss, dx)
+
+        zero_y = jnp.zeros((bm, s, d), jnp.float32)
+        carry_in = (ringF, ringB, derrs, caches, gacc, outs, dxs,
+                    loss)
+        (ringF, ringB, derrs, caches, gacc, outs, dxs, loss,
+         produced) = lax.switch(
+            act, [lambda c: do_idle(c) + (zero_y,), do_f, do_b],
+            carry_in)
+        # collectives OUTSIDE the branches — every device permutes
+        # every tick; receivers store into the ring slot keyed by the
+        # SENDER's microbatch index (shipped via the schedule arrays)
+        sendF = jnp.where(act == 1, produced, 0.0)
+        sendB = jnp.where(act == 2, produced, 0.0)
+        gotF = lax.ppermute(sendF, axis_name, permF)
+        gotB = lax.ppermute(sendB, axis_name, permB)
+        # neighbour's action/index this tick (static arrays)
+        prevS = (stage - 1) % n_stage
+        nextS = (stage + 1) % n_stage
+        pF = f_all[prevS]
+        nB = b_all[nextS]
+        ringF = jnp.where(
+            sentF_all[prevS],
+            lax.dynamic_update_index_in_dim(ringF, gotF, pF % depth,
+                                            0),
+            ringF)
+        ringB = jnp.where(
+            sentB_all[nextS],
+            lax.dynamic_update_index_in_dim(ringB, gotB, nB % depth,
+                                            0),
+            ringB)
+        return (ringF, ringB, derrs, caches, gacc, outs, dxs,
+                loss), None
+
+    zmb = jnp.zeros((depth, bm, s, d), jnp.float32)
+    carry0 = (zmb, zmb, zmb, caches0, gacc0,
+              jnp.zeros((n_micro, bm, s, d), jnp.float32),
+              jnp.zeros((n_micro, bm, s, d), jnp.float32),
+              jnp.float32(0.0))
+    sentF = (actions == 1)
+    sentB = (actions == 2)
+    (ringF, ringB, derrs, caches, gacc, outs, dxs, loss), _ = \
+        lax.scan(tick, carry0,
+                 (actions, fidx, bidx, sentF, sentB))
+    out = lax.psum(jnp.where(stage == n_stage - 1, outs, 0.0),
+                   axis_name)
+    dx = lax.psum(jnp.where(stage == 0, dxs, 0.0), axis_name)
+    loss = lax.psum(jnp.where(stage == n_stage - 1, loss, 0.0),
+                    axis_name)
+    if batch_axis is not None:
+        # sum stage-local grads and loss across data shards (same
+        # convention as the GPipe backward)
+        gacc = lax.psum(gacc, batch_axis)
+        loss = lax.psum(loss, batch_axis)
+    return (out.reshape(b, s, d), dx.reshape(b, s, d), gacc, loss)
+
+
+def pipeline_1f1b_step(params, x, targets, err_fn, mesh, axis="pipe",
+                       batch_axis=None, n_micro=4, heads=4,
+                       causal=True, eps=1e-5, dot=None, es=None):
+    """One 1F1B training segment over ``mesh[axis]``: forward, per-
+    microbatch loss gradient (``err_fn(y_mb, tgt_mb) -> (derr_mb,
+    loss_scalar)`` — traced on every stage, consumed on the last), and
+    interleaved backward in ONE schedule. Returns (y, dx, grads,
+    loss_sum); grads leaves (L, ...) stage-sharded like params.
+
+    Peak stash: ``n_stage`` microbatch caches per stage vs GPipe's
+    ``n_micro`` — the 1F1B memory bound (docs/PARALLELISM.md has the
+    bubble/memory table). Parity: tests/test_pipeline.py checks y, dx,
+    grads and loss leaf-for-leaf against stack_fwd + err_fn +
+    stack_bwd."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    n_stage = mesh.shape[axis]
+    schedule = build_1f1b_schedule(n_stage, n_micro)
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), params)
+    xspec = P(batch_axis, None, None)
+    tspec = P(*([batch_axis] + [None] * (targets.ndim - 1)))
+    fn = functools.partial(
+        _pipeline_1f1b_local, schedule=schedule, err_fn=err_fn,
+        axis_name=axis, n_stage=n_stage, n_micro=n_micro, heads=heads,
+        causal=causal, eps=eps, batch_axis=batch_axis, dot=dot, es=es)
+    sm = _shard_map()
+    return sm(
+        fn, mesh=mesh, in_specs=(pspec, xspec, tspec),
+        out_specs=(xspec, xspec, pspec, P()))(params, x, targets)
