@@ -167,3 +167,89 @@ def test_cli_optimize_smoke(tmp_path):
     doc = json.loads(r.stdout.strip().splitlines()[-1])
     assert numpy.isfinite(doc["best_fitness"])
     assert doc["evaluations"] >= 3
+
+
+def test_ga_parallel_matches_sequential(tmp_path):
+    """A ProcessPoolMap generation scores EXACTLY like a sequential
+    one (results in population order, per-individual seeding), and the
+    search is deterministic given the seed — the rebuild's answer to
+    the reference farming GA individuals to slaves."""
+    from veles.genetics import (
+        GeneticOptimizer, ProcessPoolMap, SubprocessTrainer,
+        find_tunables)
+    from veles.config import Tune, root
+
+    cfg = tmp_path / "ga_config.py"
+    cfg.write_text(
+        "from veles.config import root, Tune\n"
+        "for layer in root.mnist.layers:\n"
+        "    if '<-' in layer:\n"
+        "        layer['<-']['learning_rate'] = "
+        "Tune(0.02, 0.005, 0.1)\n"
+        "root.mnist.loader.n_train = 120\n"
+        "root.mnist.loader.n_valid = 40\n"
+        "root.mnist.loader.minibatch_size = 40\n"
+        "root.mnist.decision.max_epochs = 1\n")
+    wf_path = os.path.join(REPO, "veles/znicz_tpu/models/mnist.py")
+    # tunables must match what the workers will see: workflow module
+    # first (its defaults create root.mnist.layers), config on top —
+    # Main.run ordering
+    import veles.__main__ as vmain
+    vmain.import_file(wf_path, "ga_wf_probe")
+    saved = root.mnist.layers
+    vmain.import_file(str(cfg), "ga_cfg_probe")
+    tunables = find_tunables(root)
+    assert tunables, "config file produced no Tune leaves"
+
+    def search(map_fn):
+        evaluate = SubprocessTrainer(
+            wf_path, str(cfg), seed=5, device="numpy")
+        opt = GeneticOptimizer(
+            evaluate, dict(tunables), generations=1,
+            population_size=3, elite=1, seed=5, map_fn=map_fn)
+        opt.run()
+        return opt
+
+    try:
+        seq = search(None)
+        with ProcessPoolMap(2) as pmap:
+            par = search(pmap)
+    finally:
+        root.mnist.layers = saved
+    assert seq.evaluations == par.evaluations >= 4
+    assert numpy.isfinite(par.best_fitness)
+    # parallel == sequential: same champions, same fitness history
+    assert [f for f, _ in seq.history] == [f for f, _ in par.history]
+    assert seq.best_fitness == par.best_fitness
+    assert seq.best_values == par.best_values
+
+
+def test_cli_optimize_parallel_smoke(tmp_path):
+    """--optimize GENSxPOPxWORKERS end-to-end through velescli."""
+    cfg = tmp_path / "ga_config.py"
+    cfg.write_text(
+        "from veles.config import root, Tune\n"
+        "for layer in root.mnist.layers:\n"
+        "    if '<-' in layer:\n"
+        "        layer['<-']['learning_rate'] = "
+        "Tune(0.02, 0.005, 0.1)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "veles",
+         os.path.join(REPO, "veles/znicz_tpu/models/mnist.py"),
+         str(cfg),
+         "root.mnist.loader.n_train=120",
+         "root.mnist.loader.n_valid=40",
+         "root.mnist.loader.minibatch_size=40",
+         "root.mnist.decision.max_epochs=1",
+         "-d", "numpy", "--seed", "5", "--no-stats",
+         "--optimize", "1x3x2"],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert numpy.isfinite(doc["best_fitness"])
+    assert doc["evaluations"] >= 4
+    assert doc["workers"] == 2

@@ -89,10 +89,13 @@ def build_argparser():
     p.add_argument("--export-inference", default=None, metavar="DIR",
                    help="after the run, export the C++-engine archive "
                         "(contents.json + .npy) to DIR")
-    p.add_argument("--optimize", default=None, metavar="GENSxPOP",
+    p.add_argument("--optimize", default=None,
+                   metavar="GENSxPOP[xWORKERS]",
                    help="genetic search over the config's Tune leaves "
-                        "(e.g. 6x12: 6 generations, population 12); "
-                        "fitness = best validation metric")
+                        "(e.g. 6x12: 6 generations, population 12; "
+                        "6x12x4 evaluates 4 individuals concurrently "
+                        "in spawned worker processes); fitness = best "
+                        "validation metric")
     p.add_argument("--ensemble", type=int, default=None, metavar="N",
                    help="train N differently-seeded instances and "
                         "report ensemble vs member validation error")
@@ -224,10 +227,19 @@ class Main:
         return float(self.workflow.decision.best_metric)
 
     def optimize(self, module):
-        """``--optimize``: GA over every Tune leaf in root."""
+        """``--optimize``: GA over every Tune leaf in root;
+        GENSxPOPxWORKERS distributes each generation's individuals
+        over spawned worker processes (the reference farmed them to
+        slaves; SURVEY.md §2.7)."""
         from veles.genetics import optimize_config
-        gens, _, pop = self.args.optimize.partition("x")
+        parts = self.args.optimize.split("x")
+        gens = parts[0]
+        pop = parts[1] if len(parts) > 1 and parts[1] else 12
+        workers = int(parts[2]) if len(parts) > 2 else 1
         seed = self.args.seed if self.args.seed is not None else 1
+        if workers > 1:
+            return self._optimize_parallel(int(gens), int(pop),
+                                           workers, seed)
 
         def run_one():
             prng.seed_all(seed)   # identical universe per individual
@@ -240,6 +252,29 @@ class Main:
             "best_fitness": opt.best_fitness,
             "best_values": opt.best_values,
             "evaluations": opt.evaluations,
+        }))
+        return opt
+
+    def _optimize_parallel(self, gens, pop, workers, seed):
+        from veles.genetics import (
+            GeneticOptimizer, ProcessPoolMap, SubprocessTrainer,
+            apply_values, find_tunables)
+        evaluate = SubprocessTrainer(
+            self.args.workflow, self.args.config,
+            overrides=self.args.overrides, seed=seed,
+            device=self.args.device or "numpy")
+        with ProcessPoolMap(workers) as pmap:
+            opt = GeneticOptimizer(
+                evaluate, find_tunables(root), generations=gens,
+                population_size=pop, seed=seed, map_fn=pmap)
+            best_values, _ = opt.run()
+        if best_values is not None:
+            apply_values(root, best_values)
+        print(json.dumps({
+            "best_fitness": opt.best_fitness,
+            "best_values": opt.best_values,
+            "evaluations": opt.evaluations,
+            "workers": workers,
         }))
         return opt
 

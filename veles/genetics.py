@@ -44,6 +44,112 @@ def find_tunables(node, prefix=""):
     return out
 
 
+class _SafeEval:
+    """Picklable failure-absorbing wrapper around the fitness
+    callable: a crashed individual scores inf instead of killing the
+    search (reference behaviour — a diverged run is just unfit)."""
+
+    def __init__(self, evaluate):
+        self.evaluate = evaluate
+
+    def __call__(self, values):
+        try:
+            return float(self.evaluate(values))
+        except Exception:
+            return float("inf")
+
+
+class ProcessPoolMap:
+    """``map_fn`` evaluating a whole population concurrently in worker
+    processes — the rebuild's answer to the reference distributing GA
+    individuals over slaves (SURVEY.md §2.7 "Genetics"): one short
+    training run per individual, N at a time. Uses the ``spawn``
+    context so each worker gets a fresh interpreter (fresh jax/XLA
+    state, no fork-after-threads hazards). The callable shipped to
+    workers must be picklable (``SubprocessTrainer`` is).
+
+    Determinism: results are returned in population order and every
+    individual carries its own seed, so a parallel generation scores
+    exactly like a sequential one."""
+
+    def __init__(self, n_workers=None):
+        import os
+        self.n_workers = int(n_workers or min(os.cpu_count() or 1, 8))
+        self._pool = None
+
+    def _ensure(self):
+        if self._pool is None:
+            import multiprocessing
+            ctx = multiprocessing.get_context("spawn")
+            self._pool = ctx.Pool(self.n_workers)
+        return self._pool
+
+    def __call__(self, f, xs):
+        xs = list(xs)
+        if not xs:
+            return []
+        if len(xs) == 1:   # no point paying a worker round-trip
+            return [f(xs[0])]
+        return self._ensure().map(f, xs)
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SubprocessTrainer:
+    """Picklable GA fitness: train ``workflow_path`` with the given
+    config/overrides plus the individual's values, return the best
+    validation metric. Runs inside ProcessPoolMap workers (each one a
+    fresh spawned interpreter), standalone from the CLI Main object."""
+
+    def __init__(self, workflow_path, config_path=None, overrides=(),
+                 seed=1, device="numpy", max_epochs=None):
+        self.workflow_path = workflow_path
+        self.config_path = config_path
+        self.overrides = tuple(overrides)
+        self.seed = int(seed)
+        self.device = device
+        self.max_epochs = max_epochs
+
+    def __call__(self, values):
+        import veles.prng as prng
+        from veles.config import root
+        from veles.__main__ import import_file
+        # workflow module FIRST: its module-level defaults land in
+        # root before the config file / overrides (Main.run ordering)
+        module = import_file(self.workflow_path)
+        if self.config_path:
+            import_file(self.config_path, "veles_config_module")
+        for override in self.overrides:
+            root.apply_override(override)
+        apply_values(root, values)
+        prng.seed_all(self.seed)   # identical universe per individual
+        holder = {}
+
+        def load(WorkflowClass, **kwargs):
+            holder["wf"] = WorkflowClass(None, **kwargs)
+            return holder["wf"]
+
+        def main(**kwargs):
+            wf = holder["wf"]
+            if self.max_epochs is not None and                     getattr(wf, "decision", None) is not None:
+                wf.decision.max_epochs = int(self.max_epochs)
+            wf.initialize(device=self.device)
+            wf.run()
+
+        module.run(load, main)
+        return float(holder["wf"].decision.best_metric)
+
+
 class GeneticOptimizer(Logger):
     """Minimizes ``evaluate(values)`` over the box defined by
     ``tunables`` (a ``{path: Tune}`` dict from ``Config.tunables()``).
@@ -124,16 +230,17 @@ class GeneticOptimizer(Logger):
 
     def _fitness_of(self, pop):
         vals = [self._decode(g) for g in pop]
-        out = numpy.asarray(self.map_fn(self._safe_eval, vals), float)
+        # _SafeEval is a module-level picklable wrapper so a parallel
+        # map_fn (ProcessPoolMap) can ship it to worker processes —
+        # the evaluate callable itself must then be picklable too
+        # (e.g. SubprocessTrainer)
+        out = numpy.asarray(
+            self.map_fn(_SafeEval(self.evaluate), vals), float)
         self.evaluations += len(vals)
+        bad = int((~numpy.isfinite(out)).sum())
+        if bad:
+            self.warning("%d individual(s) failed this round", bad)
         return numpy.where(numpy.isfinite(out), out, numpy.inf)
-
-    def _safe_eval(self, values):
-        try:
-            return float(self.evaluate(values))
-        except Exception as exc:
-            self.warning("individual failed (%s): %r", exc, values)
-            return numpy.inf
 
     def run(self):
         pop = self._initial_population()
