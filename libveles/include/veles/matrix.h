@@ -4,8 +4,11 @@
 //
 // One gemm serves both the dense layers and the im2col'd convolutions,
 // exactly the reference's structure (§2.5: one tiled GEMM reused by
-// all2all AND conv). AVX2+FMA is used when the compiler targets it
-// (-march native/haswell+); the scalar path is always correct.
+// all2all AND conv). ISA paths: AVX2+FMA (selected at RUNTIME via
+// cpuid — one binary carries scalar + AVX2), NEON on ARM, portable
+// scalar everywhere. Rows are parallelized over a persistent thread
+// pool for large products. Env knobs: VELES_SIMD=scalar|avx2|neon
+// forces a path; VELES_NUM_THREADS sizes (or =1 disables) the pool.
 #pragma once
 
 #include <cstdint>
@@ -17,6 +20,11 @@ namespace veles {
 // Row-major, c is overwritten.
 void Gemm(const float* a, const float* b, float* c,
           int64_t m, int64_t k, int64_t n, bool b_transposed);
+
+// Active ISA path ("avx2" / "neon" / "scalar") and pool width —
+// diagnostics for tests and `veles_infer --version`-style output.
+const char* GemmBackendName();
+int GemmThreads();
 
 // y[i] += bias broadcast over rows: y is (m, n), bias is (n,)
 void AddBias(float* y, const float* bias, int64_t m, int64_t n);

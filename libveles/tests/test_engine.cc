@@ -79,6 +79,67 @@ void TestGemm() {
   for (int i = 0; i < m * n; ++i) CHECK_NEAR(rc[i], rd[i], 1e-4);
 }
 
+void TestGemmBackendsAgree() {
+  // every reachable ISA path and the threaded split must agree with
+  // the forced-scalar single-thread result bit-tightly
+  const int m = 96, k = 130, n = 72;   // odd tails exercise remainders
+  std::vector<float> a(m * k), b(k * n);
+  unsigned state = 777;
+  auto rnd = [&state]() {
+    state = state * 1664525u + 1013904223u;
+    return static_cast<float>((state >> 16) & 0xffff) / 65536.0f - 0.5f;
+  };
+  for (auto& v : a) v = rnd();
+  for (auto& v : b) v = rnd();
+
+  setenv("VELES_SIMD", "scalar", 1);
+  std::vector<float> ref(m * n), refT(m * n);
+  veles::Gemm(a.data(), b.data(), ref.data(), m, k, n, false);
+  std::vector<float> bt(n * k);
+  for (int j = 0; j < n; ++j)
+    for (int p = 0; p < k; ++p) bt[j * k + p] = b[p * n + j];
+  veles::Gemm(a.data(), bt.data(), refT.data(), m, k, n, true);
+
+  for (const char* isa : {"avx2", "neon", ""}) {
+    if (isa[0]) setenv("VELES_SIMD", isa, 1);
+    else unsetenv("VELES_SIMD");
+    std::vector<float> c(m * n), cT(m * n);
+    veles::Gemm(a.data(), b.data(), c.data(), m, k, n, false);
+    veles::Gemm(a.data(), bt.data(), cT.data(), m, k, n, true);
+    for (int i = 0; i < m * n; ++i) {
+      CHECK_NEAR(c[i], ref[i], 1e-4);
+      CHECK_NEAR(cT[i], refT[i], 1e-4);
+    }
+  }
+  unsetenv("VELES_SIMD");
+  std::printf("gemm backend after dispatch: %s, %d threads\n",
+              veles::GemmBackendName(), veles::GemmThreads());
+}
+
+void TestGemmThreadedAgrees() {
+  // big enough to cross the threading threshold (2*m*k*n > 8 MFLOP)
+  const int m = 128, k = 192, n = 192;
+  std::vector<float> a(m * k), b(k * n);
+  unsigned state = 4242;
+  auto rnd = [&state]() {
+    state = state * 1664525u + 1013904223u;
+    return static_cast<float>((state >> 16) & 0xffff) / 65536.0f - 0.5f;
+  };
+  for (auto& v : a) v = rnd();
+  for (auto& v : b) v = rnd();
+  std::vector<float> serial(m * n), threaded(m * n);
+  setenv("VELES_NUM_THREADS", "1", 1);
+  veles::Gemm(a.data(), b.data(), serial.data(), m, k, n, false);
+  // NB: the pool is a process singleton sized at first use; =1 above
+  // also suppressed threading via WorthThreading, so clearing the
+  // env re-enables the split on the SAME pool
+  unsetenv("VELES_NUM_THREADS");
+  veles::Gemm(a.data(), b.data(), threaded.data(), m, k, n, false);
+  // row-split changes no arithmetic order within a row: exact match
+  for (int i = 0; i < m * n; ++i)
+    CHECK_NEAR(threaded[i], serial[i], 0.0);
+}
+
 void TestJson() {
   auto v = veles::json::Parse(
       "{\"a\": [1, 2.5, -3e2], \"s\": \"x\\ny\", \"b\": true, "
@@ -235,7 +296,10 @@ void RunFixtures(const std::string& root) {
 
 int main(int argc, char** argv) {
   std::string tmpdir = argc > 2 ? argv[2] : "/tmp";
+  setenv("VELES_NUM_THREADS", "4", 0);
   TestGemm();
+  TestGemmBackendsAgree();
+  TestGemmThreadedAgrees();
   TestJson();
   TestNpyRoundTrip(tmpdir);
   TestMalformedInputs(tmpdir);
