@@ -263,3 +263,89 @@ def test_drop_slave_requeues():
     loader.drop_slave(7)
     assert len(loader._pending_jobs) == total
     assert loader._pending_jobs[0] == job
+
+
+def test_cli_background_daemon(tmp_path):
+    """--background detaches: the foreground process returns
+    immediately with the daemon pid; the daemon finishes the run and
+    writes the result file + log (SURVEY.md §2.7 CLI row)."""
+    result = tmp_path / "result.json"
+    log = tmp_path / "daemon.log"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "veles",
+         os.path.join(REPO, "veles/znicz_tpu/models/mnist.py"),
+         "--seed", "99", "-d", "numpy", "--no-stats",
+         "root.mnist.decision.max_epochs=1",
+         "root.mnist.loader.n_train=120",
+         "root.mnist.loader.n_valid=40",
+         "root.mnist.loader.minibatch_size=40",
+         "--result-file", str(result),
+         "--background", "--log-file", str(log)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout.strip().splitlines()[-1])
+    pid = doc["daemon_pid"]
+    assert pid > 0
+    # the daemon runs on after the foreground returned: poll for its
+    # result file
+    deadline = time.time() + 180
+    while time.time() < deadline and not result.exists():
+        time.sleep(0.5)
+    assert result.exists(), "daemon never wrote the result file"
+    data = json.loads(result.read_text())
+    assert len(data["history"]) == 1
+
+
+def test_master_dashboard_shows_slaves():
+    """The master's web dashboard reports cluster topology from the
+    live server registry: joined slaves with job counts (§5.5)."""
+    import urllib.request
+    from veles.server import MasterServer
+    from veles.client import SlaveClient
+    from veles.web_status import WebStatus
+
+    master_wf = make_wf("DashMasterWf", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2)
+    status = WebStatus(port=0)
+    try:
+        # what Launcher._run_master registers
+        status.register("cluster", server.status)
+        server.start_background()
+        addr = "127.0.0.1:%d" % server.bound_address[1]
+        slave_wf = make_wf("DashSlaveWf")
+        slave_wf.is_slave = True
+        seen = {}
+
+        def run_slave():
+            client = SlaveClient(slave_wf, addr, name="dash-slave")
+            client.run_forever()
+
+        t = threading.Thread(target=run_slave)
+        t.start()
+        # poll the dashboard WHILE the run is live
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/status.json" % status.port,
+                    timeout=5) as resp:
+                seen = json.loads(resp.read().decode())["cluster"]
+            if seen.get("n_slaves", 0) >= 1 and any(
+                    s.get("jobs", 0) > 0
+                    for s in seen.get("slaves", {}).values()):
+                break
+            time.sleep(0.05)
+        t.join(timeout=120)
+        assert seen.get("n_slaves", 0) >= 1, seen
+        assert any(s.get("name") == "dash-slave"
+                   for s in seen["slaves"].values()), seen
+        assert any(s.get("jobs", 0) > 0
+                   for s in seen["slaves"].values()), seen
+        # page renders too (no provider crash)
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/" % status.port, timeout=5) as r:
+            assert b"cluster" in r.read()
+    finally:
+        status.close()
+        server.done.set()

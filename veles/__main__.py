@@ -82,6 +82,13 @@ def build_argparser():
                    help="write a jax.profiler trace of the run here "
                         "(kernel-level timeline; view in TensorBoard "
                         "or Perfetto)")
+    p.add_argument("--background", action="store_true",
+                   help="daemonize before running: fork, detach from "
+                        "the terminal (setsid), redirect stdio to "
+                        "--log-file (default /dev/null), print the "
+                        "daemon pid and return immediately")
+    p.add_argument("--log-file", default=None, metavar="PATH",
+                   help="with --background: append stdout/stderr here")
     p.add_argument("--web-status", type=int, default=None,
                    metavar="PORT",
                    help="serve the status dashboard on this port "
@@ -323,8 +330,42 @@ class Main:
         return 0
 
 
+def daemonize(log_file=None):
+    """Classic double-fork detach (reference ``--background`` [U],
+    SURVEY.md §2.7 CLI row): the caller's process prints the daemon
+    pid and exits; the grandchild runs the workflow with stdio
+    redirected. Called BEFORE any backend/threads initialize."""
+    pid = os.fork()
+    if pid > 0:
+        # wait for the intermediate child so it never zombifies, then
+        # report the daemon from the original foreground process
+        os.waitpid(pid, 0)
+        return False
+    os.setsid()
+    pid2 = os.fork()
+    if pid2 > 0:
+        print(json.dumps({"daemon_pid": pid2}), flush=True)
+        os._exit(0)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+    os.close(devnull)
+    out = os.open(log_file, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                  0o644) if log_file else os.open(os.devnull,
+                                                  os.O_WRONLY)
+    os.dup2(out, 1)
+    os.dup2(out, 2)
+    os.close(out)
+    return True
+
+
 def main(argv=None):
-    return Main(argv).run()
+    m = Main(argv)
+    if getattr(m.args, "background", False):
+        if not daemonize(m.args.log_file):
+            return 0        # foreground parent: daemon pid printed
+    return m.run()
 
 
 if __name__ == "__main__":

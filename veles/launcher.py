@@ -138,6 +138,11 @@ class Launcher(Logger):
     def _run_master(self):
         from veles.server import MasterServer
         server = MasterServer(self.workflow, self.listen_address)
+        self.master_server = server
+        if self.web_status is not None:
+            # cluster topology on the dashboard: connected slaves and
+            # their job counts straight from the server registry
+            self.web_status.register("cluster", server.status)
         server.serve_forever()
 
     def _run_slave(self):

@@ -188,6 +188,22 @@ class MasterServer(Logger):
                 self.registry.drop_slave(slave_id)
                 del self.slaves[slave_id]
 
+    def status(self):
+        """Cluster topology snapshot for the dashboard (SURVEY.md
+        §5.5): connected slaves with their served-job counts, plus
+        master progress."""
+        with self.lock:
+            return {
+                "mode": "master",
+                "epoch": self.epoch,
+                "max_epochs": self.max_epochs,
+                "complete": self.done.is_set(),
+                "n_slaves": len(self.slaves),
+                "slaves": {
+                    str(sid): dict(info)
+                    for sid, info in self.slaves.items()},
+            }
+
     # -- socket plumbing ----------------------------------------------
 
     def serve_forever(self, poll=0.05):
