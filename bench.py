@@ -236,6 +236,12 @@ def main():
             lm_scale_tokens_per_sec(), 1)
     except Exception as exc:
         extra["lm_57M_tokens_per_sec_error"] = str(exc)[:200]
+    # which data fed each number: real on-disk datasets or the
+    # synthetic stand-ins (zero-egress environments have no choice,
+    # but the record keeps every figure honest — VERDICT r2 item 4)
+    from veles.znicz_tpu.models.datasets import data_provenance
+    extra["data"] = {k: v.get("source", "?")
+                     for k, v in data_provenance().items()}
     print(json.dumps({
         "metric": "mnist_train_steps_per_sec",
         "value": round(fast, 2),

@@ -211,6 +211,7 @@ def _real_tree():
 
 
 def make_loader(wf):
+    from veles.znicz_tpu.models.datasets import _record
     cfg = root.imagenet.loader
     kwargs = dict(name="loader",
                   minibatch_size=cfg.minibatch_size,
@@ -218,7 +219,10 @@ def make_loader(wf):
                   mirror="random")
     base, n = _real_tree()
     if base:
+        _record("imagenet", "real", dir=base, classes=n,
+                checksum="structural (image-dir tree)")
         return AutoLabelFileImageLoader(wf, base_dir=base, **kwargs)
+    _record("imagenet", "synthetic")
     return SyntheticImageLoader(
         wf, n_classes=cfg.n_classes, n_train=cfg.n_train,
         n_valid=cfg.n_valid, **kwargs)
