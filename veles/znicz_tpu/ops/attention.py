@@ -398,6 +398,24 @@ class MultiHeadAttention(Forward):
         self.output.mem[...] = y
         self._cache = cache
 
+    #: blocked-attention auto policy: with ``attn_impl=None`` the
+    #: Pallas kernels take over on a real TPU once S reaches this
+    #: bound — measured end-to-end on a v5e 57M LM (2026-07-30):
+    #: scan wins at S=2048 (127k vs 111k tok/s, pallas_call's fusion
+    #: boundary dominates), pallas wins 1.9x at S=4096 (91k vs 49k)
+    #: and 2.6x at S=8192 (57k vs 22k — its causal loop bound SKIPS
+    #: fully-masked K blocks, which the scan schedule cannot).
+    #: ``attn_impl="scan"`` forces the scan at any S.
+    PALLAS_AUTO_MIN_S = 4096
+
+    def _effective_impl(self, ctx, s):
+        if self.attn_impl is not None:
+            return self.attn_impl
+        if s >= self.PALLAS_AUTO_MIN_S and \
+                ctx._compiler.device.platform in ("tpu", "axon"):
+            return "pallas"
+        return "scan"
+
     def xla_run(self, ctx):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
@@ -405,8 +423,17 @@ class MultiHeadAttention(Forward):
         if self.seq_mesh is not None:
             y, cache = self._fwd_ring(jnp, x, p, ctx.dot)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
+        elif self.attn_block_size and self._effective_impl(
+                ctx, x.shape[1]) == "pallas":
+            y, cache = self._fwd_pallas(
+                jnp, x, p, ctx.dot,
+                cd=ctx._compiler.device.compute_dtype)
+            names = ("q", "k", "v", "out_heads", "lse", "merged")
         elif self.attn_impl == "pallas":
-            y, cache = self._fwd_pallas(jnp, x, p, ctx.dot)
+            # pallas without attn_block_size: kernel picks its block
+            y, cache = self._fwd_pallas(
+                jnp, x, p, ctx.dot,
+                cd=ctx._compiler.device.compute_dtype)
             names = ("q", "k", "v", "out_heads", "lse", "merged")
         elif self.attn_block_size:
             y, cache = self._fwd_blocked(
@@ -471,11 +498,16 @@ class MultiHeadAttention(Forward):
         return max(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
                    if s % b == 0)
 
-    def _fwd_pallas(self, xp, x, p, dot):
-        """Flash forward on the hand-written Pallas TPU kernel."""
+    def _fwd_pallas(self, xp, x, p, dot, cd=None):
+        """Flash forward on the hand-written Pallas TPU kernel.
+        q/k/v in the compute dtype (bf16 on TPU): half the kernel's
+        VMEM (K/V ride whole rows — the difference between S=8k
+        fitting and a scoped-vmem OOM) and matched MXU input dtypes."""
         from veles.znicz_tpu.parallel import pallas_attention as PA
         blk = self._pallas_block()
         q, k, v = self._project_qkv(x, p, dot)
+        if cd is not None:
+            q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
         out_heads, lse = PA.flash_attention_fwd(
             q, k, v, causal=self.causal, block_q=blk, block_k=blk)
         merged = self._merge(out_heads)
@@ -592,10 +624,11 @@ class GDMultiHeadAttention(GradientDescentBase):
         from veles.znicz_tpu.parallel import pallas_attention as PA
         f = self.forward
         blk = f._pallas_block()
+        cd = ctx._compiler.device.compute_dtype
         return self._bwd_outer(
             xp, x, p, ctx, err,
             lambda q, k, v, o, lse, dctx: PA.flash_attention_bwd(
-                q, k, v, o, lse, dctx, causal=f.causal,
+                q, k, v, o, lse, dctx.astype(cd), causal=f.causal,
                 block_q=blk, block_k=blk))
 
     def xla_run(self, ctx):
@@ -606,7 +639,11 @@ class GDMultiHeadAttention(GradientDescentBase):
         p = ctx.unit_params(f)
         if f.seq_mesh is not None:
             dx, gw, gb, gwo, gbo = self._bwd_ring(jnp, x, p, ctx, err)
-        elif f.attn_impl == "pallas":
+        elif f.attn_impl == "pallas" or (
+                f.attn_block_size and f._effective_impl(
+                    ctx, x.shape[1]) == "pallas"):
+            # MUST mirror the forward's effective-impl choice: the
+            # pallas cache is (out_heads, lse) in the kernel's layout
             dx, gw, gb, gwo, gbo = self._bwd_pallas(
                 jnp, x, p, ctx, err)
         elif f.attn_block_size:

@@ -1,19 +1,33 @@
-"""Hand-written Pallas TPU flash-attention kernels (opt-in).
+"""Hand-written Pallas TPU flash-attention kernels — the LONG-CONTEXT
+fast path.
 
-The framework's Pallas proof point and escape hatch (SURVEY.md §2.5,
-§7 stage 6): real TPU kernels keeping the (m, l, acc) online-softmax
-state in VMEM across K/V blocks, with causal early-exit skipping
-fully-masked blocks. Measured head-to-head against the ``lax.scan``
-flash formulation (``parallel/flash.py``) on a real v5e chip
-(2026-07-30, 57.5M-param LM training step, attn_block=128): scan wins
-end-to-end — 163k vs 115k tokens/s at S=512, 71k vs 55–62k at S=2048
-— because ``pallas_call`` is a fusion boundary (the qkv projection and
-surrounding elementwise work can no longer fuse into the attention
-loop), while XLA compiles the scan into the same block schedule this
-kernel hand-writes. The scan path therefore stays the default
-(``attn_impl=None``); these kernels stay the documented, TESTED
-escape hatch for regimes XLA handles badly, and the profiling
-evidence for §2.5's "XLA fusion suffices" claim.
+Real TPU kernels keeping the (m, l, acc) online-softmax state in VMEM
+across K/V blocks (SURVEY.md §2.5, §7 stage 6). Where they win, and
+why (measured on a v5e, 2026-07-30, bf16 inputs, 57.5M LM training
+step, readback timing):
+
+* SHORT sequences (S<=2048): the XLA scan (``parallel/flash.py``)
+  wins end-to-end (127k vs 111k tok/s at S=2048) — ``pallas_call`` is
+  a fusion boundary, so the qkv projection and surrounding elementwise
+  work can no longer fuse into the attention loop, and at short S
+  that overhead dominates.
+* LONG sequences: these kernels win END-TO-END — 1.9x at S=4096 (91k
+  vs 49k tok/s) and 2.6x at S=8192 (57k vs 22k) — because the causal
+  ``fori_loop`` bound SKIPS fully-masked K blocks entirely, halving
+  the quadratic work, which the scan schedule cannot do (a lax.cond
+  block-skip was measured SLOWER: TPU conditionals break scan
+  pipelining; inside a Pallas kernel the loop bound is a plain scalar
+  and costs nothing).
+
+``MultiHeadAttention`` therefore auto-selects: ``attn_impl=None``
+uses the scan below ``PALLAS_AUTO_MIN_S`` (4096) and these kernels at
+or above it on a real TPU; ``attn_impl="scan"|"pallas"`` forces
+either. Inputs ride in the compute dtype (bf16 on TPU): half the
+VMEM — at S=8192 the difference between fitting and a scoped-vmem
+OOM — and matched MXU input dtypes. Per-row lse/delta tensors are
+shipped as (BH, 1, S) with the sequence on the LANE dim: a (BH, S, 1)
+layout pads its trailing singleton to 128 lanes and explodes VMEM
+(S·128·4 bytes per ref — the original S=8k backward compile failure).
 
 Exact math (same online softmax as flash.py / ring.py; verified
 against both in tests — interpret mode on CPU, real kernels on TPU):
@@ -73,8 +87,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
         p = jnp.exp(s - m_new)
         coef = jnp.exp(m - m_new)
         l_new = l * coef + p.sum(axis=-1, keepdims=True)
+        # p in the storage dtype (bf16 on TPU) for the PV matmul —
+        # exp stays f32, the MXU gets matched input dtypes
         acc_new = acc * coef + jnp.dot(
-            p, vb, preferred_element_type=jnp.float32)
+            p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
@@ -84,7 +101,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
     # skip them entirely instead of computing and masking
     hi = pl.cdiv((qi + 1) * block_q, block_k) if causal else n_kb
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    o_ref[0] = acc / l
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)                     # (bq, 1)
 
 
@@ -115,13 +132,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(cols > rows, jnp.float32(-1e9), s)
         p = jnp.exp(s - lse)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(kb.dtype)
         return dq + jnp.dot(ds, kb,
                             preferred_element_type=jnp.float32)
 
     hi = pl.cdiv((qi + 1) * block_q, block_k) if causal else n_kb
     dq_ref[0] = jax.lax.fori_loop(
-        0, hi, body, jnp.zeros((block_q, dh), jnp.float32))
+        0, hi, body,
+        jnp.zeros((block_q, dh), jnp.float32)).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -143,8 +161,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         qb = q_ref[0, pl.ds(j * block_q, block_q), :]
         dob = do_ref[0, pl.ds(j * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(j * block_q, block_q), :]
-        delta = delta_ref[0, pl.ds(j * block_q, block_q), :]
+        # lse/delta ride as (1, 1, S) — sequence on the LANE dim; a
+        # (1, S, 1) full block would pad its trailing singleton to 128
+        # lanes (S*128*4 bytes of VMEM each: the S=8k compile OOM)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)][:, None]
         s = jnp.dot(qb, kb.T,
                     preferred_element_type=jnp.float32) * scale
         if causal:
@@ -152,10 +173,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             s = jnp.where(cols > rows, jnp.float32(-1e9), s)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T, dob,
+        dv = dv + jnp.dot(p.astype(dob.dtype).T, dob,
                           preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(qb.dtype)
         dk = dk + jnp.dot(ds.T, qb,
                           preferred_element_type=jnp.float32)
         return dk, dv
@@ -166,8 +187,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros((bk, dh), jnp.float32)
     dv0 = jnp.zeros((bk, dh), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo, n_qb, body, (dk0, dv0))
-    dk_ref[0] = dk
-    dv_ref[0] = dv
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _specs(block_rows, s, dh):
@@ -180,7 +201,9 @@ def _specs(block_rows, s, dh):
                            lambda bh, i: (bh, i, 0))
     full = pl.BlockSpec((1, s, dh), lambda bh, i: (bh, 0, 0))
     vec = pl.BlockSpec((1, block_rows, 1), lambda bh, i: (bh, i, 0))
-    full_vec = pl.BlockSpec((1, s, 1), lambda bh, i: (bh, 0, 0))
+    # per-row scalars as (BH, 1, S): sequence on the lane dim, so the
+    # full-rows variant costs S*4 bytes, not S*128*4 (see _dkv_kernel)
+    full_vec = pl.BlockSpec((1, 1, s), lambda bh, i: (bh, 0, 0))
     return blocked, full, vec, full_vec
 
 
@@ -210,7 +233,7 @@ def flash_attention_fwd(q, k, v, causal=True, block_q=128,
         grid=(b * h, s // block_q),
         in_specs=[blocked, full, full],
         out_specs=[blocked, vec],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, dh), jnp.float32),
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, dh), q.dtype),
                    jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)],
         interpret=interpret,
     )(qf, k.reshape(b * h, s, dh), v.reshape(b * h, s, dh))
@@ -236,7 +259,11 @@ def flash_attention_bwd(q, k, v, out, lse, dout, causal=True,
     flat = (b * h, s, dh)
     qf, kf, vf, dof = (t.reshape(flat) for t in (q, k, v, dout))
     lsef = lse.reshape(b * h, s, 1)
-    delta = (dout * out).sum(axis=-1).reshape(b * h, s, 1)
+    lse_lanes = lse.reshape(b * h, 1, s)
+    delta_rows = (dout.astype(jnp.float32)
+                  * out.astype(jnp.float32)).sum(axis=-1)
+    delta = delta_rows.reshape(b * h, s, 1)
+    delta_lanes = delta_rows.reshape(b * h, 1, s)
     qblocked, qfull, qvec, qfull_vec = _specs(block_q, s, dh)
     kblocked, _, _, _ = _specs(block_k, s, dh)
 
@@ -247,7 +274,7 @@ def flash_attention_bwd(q, k, v, out, lse, dout, causal=True,
         grid=(b * h, s // block_q),
         in_specs=[qblocked, qfull, qfull, qblocked, qvec, qvec],
         out_specs=qblocked,
-        out_shape=jax.ShapeDtypeStruct(flat, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(flat, q.dtype),
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, delta)
 
@@ -259,10 +286,10 @@ def flash_attention_bwd(q, k, v, out, lse, dout, causal=True,
         in_specs=[qfull, kblocked, kblocked, qfull, qfull_vec,
                   qfull_vec],
         out_specs=[kblocked, kblocked],
-        out_shape=[jax.ShapeDtypeStruct(flat, jnp.float32),
-                   jax.ShapeDtypeStruct(flat, jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct(flat, q.dtype),
+                   jax.ShapeDtypeStruct(flat, q.dtype)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lse_lanes, delta_lanes)
 
     shape = (b, h, s, dh)
     return (dq.reshape(shape), dk.reshape(shape), dv.reshape(shape))
