@@ -196,7 +196,6 @@ def test_ga_parallel_matches_sequential(tmp_path):
     # Main.run ordering
     import veles.__main__ as vmain
     vmain.import_file(wf_path, "ga_wf_probe")
-    saved = root.mnist.layers
     vmain.import_file(str(cfg), "ga_cfg_probe")
     tunables = find_tunables(root)
     assert tunables, "config file produced no Tune leaves"
@@ -215,7 +214,11 @@ def test_ga_parallel_matches_sequential(tmp_path):
         with ProcessPoolMap(2) as pmap:
             par = search(pmap)
     finally:
-        root.mnist.layers = saved
+        # the sequential path evaluates IN-PROCESS (config file + Tune
+        # application mutate root.mnist, including the layer dicts in
+        # place): re-executing the sample module restores its defaults
+        # wholesale so later test modules see a clean tree
+        vmain.import_file(wf_path, "ga_wf_probe")
     assert seq.evaluations == par.evaluations >= 4
     assert numpy.isfinite(par.best_fitness)
     # parallel == sequential: same champions, same fitness history
