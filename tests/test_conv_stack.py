@@ -229,3 +229,40 @@ def test_stochastic_pooling_modes():
     ei_x, _ = xla_backward(comp, feed, fwd, gd, comp.gather_params(),
                            comp.gather_state(), x, err)
     assert numpy.asarray(ei_x).shape == x.shape
+
+
+def test_max_pooling_tie_routing_parity():
+    """The traced reduce_window/select-and-scatter fast path must
+    route TIES exactly like the oracle's argmax-first-wins winner
+    offsets: quantized input forces many equal values per window, and
+    err_input must match element-for-element (the continuous-data
+    parametrized cases above essentially never tie)."""
+    wf, feed, fwd, gd, x, err, comp = build(
+        MaxPooling, input_shape=(4, 9, 9, 3), gd_kwargs={},
+        kx=3, ky=3, sliding=2)
+    gen = prng.get("tie")
+    xq = (gen.randint(0, 3, x.shape) * 0.5).astype(numpy.float32)
+    fwd.input.map_write()
+    fwd.input.mem[...] = xq
+    fwd.numpy_run()
+    errq = gen.normal(0, 1.0, fwd.output.shape) \
+        .astype(numpy.float32)
+    gd.err_output.map_write()
+    gd.err_output.mem[...] = errq
+    gd.numpy_run()
+    ei_oracle = numpy.array(gd.err_input.mem)
+
+    params = comp.gather_params()
+    state = comp.gather_state()
+    y_x = xla_forward(comp, feed, fwd, params, xq)
+    assert numpy.array_equal(numpy.asarray(y_x), fwd.output.mem)
+    ei_x, _ = xla_backward(comp, feed, fwd, gd, params, state, xq,
+                           errq)
+    ei_x = numpy.asarray(ei_x)
+    # the ROUTING must be identical (which cells receive gradient);
+    # cells fed by several overlapping windows may differ by summation
+    # order, so values compare to float tolerance
+    assert numpy.array_equal(ei_oracle == 0.0, ei_x == 0.0), \
+        "tie routing differs between select-and-scatter and the " \
+        "winner-offset oracle"
+    assert numpy.allclose(ei_oracle, ei_x, atol=1e-5)

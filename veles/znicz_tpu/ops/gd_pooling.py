@@ -22,11 +22,8 @@ class GDPoolingBase(GradientDescentBase):
 
     def _window_geometry(self):
         f = self.forward
-        oshape = f.output.shape
-        sy, sx = f.sliding
-        need_h = (oshape[1] - 1) * sy + f.ky
-        need_w = (oshape[2] - 1) * sx + f.kx
-        return oshape, need_h, need_w
+        need_h, need_w = f.padded_hw(f.input.shape)
+        return f.output.shape, need_h, need_w
 
     def _scatter(self, xp, err_patches):
         """(B,oy,ox,kk,C) window errors -> input-shaped tensor.
@@ -84,7 +81,19 @@ class GDMaxPoolingBase(GDPoolingBase):
 
 @gradient_for(MaxPooling)
 class GDMaxPooling(GDMaxPoolingBase):
-    pass
+    def _route(self, xp, err, ctx):
+        f = self.forward
+        if ctx is not None and f.XLA_NATIVE_WINDOW:
+            # XLA select-and-scatter (the VJP of the forward's
+            # reduce_window): verified identical to the winner-offset
+            # scatter INCLUDING ties (first max wins in window order,
+            # matching argmax), without materializing patch tensors
+            import jax
+            x = ctx.get(f, "input")
+            _, vjp = jax.vjp(f.xla_reduce_window, x)
+            (dx,) = vjp(err.astype(x.dtype))
+            return dx
+        return super()._route(xp, err, ctx)
 
 
 @gradient_for(MaxAbsPooling)

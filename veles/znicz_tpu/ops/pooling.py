@@ -49,13 +49,21 @@ class PoolingBase(Forward):
         if not self.output or self.output.shape != oshape:
             self.output.reset(numpy.zeros(oshape, numpy.float32))
 
+    def padded_hw(self, ishape):
+        """(need_h, need_w): input extent padded so every ceil-mode
+        window is full — THE one definition of the edge geometry,
+        shared by the patch path, the reduce_window fast path and the
+        backward's scatter (they must never disagree)."""
+        oshape = self.output_shape_for(ishape)
+        sy, sx = self.sliding
+        return ((oshape[1] - 1) * sy + self.ky,
+                (oshape[2] - 1) * sx + self.kx)
+
     # pad so every window is full; the pad value never wins/matters
     def _padded_patches(self, xp, x, pad_value):
         b, h, w, c = x.shape
         oshape = self.output_shape_for(x.shape)
-        sy, sx = self.sliding
-        need_h = (oshape[1] - 1) * sy + self.ky
-        need_w = (oshape[2] - 1) * sx + self.kx
+        need_h, need_w = self.padded_hw(x.shape)
         if need_h > h or need_w > w:
             x = xp.pad(x, ((0, 0), (0, need_h - h), (0, need_w - w),
                            (0, 0)), constant_values=pad_value)
@@ -84,7 +92,19 @@ class PoolingBase(Forward):
 
 @forward_unit("max_pooling")
 class MaxPooling(PoolingBase):
-    """Max pooling; records winner offsets for the backward."""
+    """Max pooling; records winner offsets for the backward.
+
+    The TRACED plain-max path uses ``lax.reduce_window`` (and its
+    backward uses XLA's select-and-scatter): semantics verified
+    identical to the argmax/first-wins patch formulation INCLUDING
+    ties, while avoiding the (B, oy, ox, ky*kx, C) patch
+    materialization — the patch path stays for the numpy oracle and
+    the maxabs/stochastic variants whose winner rule reduce_window
+    cannot express."""
+
+    #: the traced path may use reduce_window/select-scatter (plain
+    #: max only; subclasses with custom winner rules must opt out)
+    XLA_NATIVE_WINDOW = True
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -94,7 +114,26 @@ class MaxPooling(PoolingBase):
         """Window index to propagate (argmax; first wins on ties)."""
         return xp.argmax(patches, axis=3)
 
+    def _window_dims(self, x):
+        need_h, need_w = self.padded_hw(x.shape)
+        return [(0, 0), (0, need_h - x.shape[1]),
+                (0, need_w - x.shape[2]), (0, 0)]
+
+    def xla_reduce_window(self, x):
+        """Ceil-semantics max pool as one XLA windowed reduction."""
+        import jax
+        # init as a python literal: jax's reduce_window autodiff rule
+        # (select-and-scatter) only pattern-matches a known init value
+        return jax.lax.reduce_window(
+            x, -float("inf"), jax.lax.max,
+            (1, self.ky, self.kx, 1),
+            (1,) + tuple(self.sliding) + (1,), self._window_dims(x))
+
     def _run_generic(self, xp, x, ctx):
+        if ctx is not None and self.XLA_NATIVE_WINDOW:
+            # winner offsets are not recorded on this path: the traced
+            # backward recomputes the routing via select-and-scatter
+            return self.xla_reduce_window(x)
         patches = self._padded_patches(xp, x, -numpy.inf)
         sel = self._select(xp, patches)               # (B,oy,ox,C)
         onehot = (xp.arange(self.ky * self.kx)[None, None, None, :, None]
@@ -110,6 +149,8 @@ class MaxPooling(PoolingBase):
 @forward_unit("maxabs_pooling")
 class MaxAbsPooling(MaxPooling):
     """Propagates the element with the largest |value| (sign kept)."""
+
+    XLA_NATIVE_WINDOW = False   # |value| winner rule needs the patches
 
     def _padded_patches(self, xp, x, pad_value):
         return super()._padded_patches(xp, x, 0.0)
