@@ -109,3 +109,97 @@ def test_bench_json_carries_data_tag(staged_datasets):
     tags = {k: v.get("source")
             for k, v in datasets.data_provenance().items()}
     assert tags.get("mnist") == "real"
+
+
+def _png_bytes(gen):
+    """A tiny valid PNG (the loaders only need decodable files)."""
+    import io
+    from PIL import Image
+    img = Image.fromarray(
+        gen.integers(0, 255, (8, 8, 3), dtype=numpy.uint8))
+    buf = io.BytesIO()
+    img.save(buf, "PNG")
+    return buf.getvalue()
+
+
+def test_imagenet_prep_stages_ilsvrc_archives(tmp_path, monkeypatch):
+    """imagenet_prep turns raw-ILSVRC-shaped archives (train tar of
+    per-class tars; flat val tar + ground truth + synsets) into the
+    class tree models/imagenet.py auto-ingests."""
+    import io
+    import tarfile
+    from veles.znicz_tpu.models import imagenet_prep
+
+    gen = numpy.random.Generator(numpy.random.PCG64(1))
+    wnids = ["n01440764", "n01443537", "n01484850"]
+
+    def add_bytes(tar, name, payload):
+        info = tarfile.TarInfo(name)
+        info.size = len(payload)
+        tar.addfile(info, io.BytesIO(payload))
+
+    # train: outer tar of per-class tars, 2 images each
+    train_tar = tmp_path / "train.tar"
+    with tarfile.open(train_tar, "w") as outer:
+        for wnid in wnids:
+            inner_buf = io.BytesIO()
+            with tarfile.open(fileobj=inner_buf, mode="w") as inner:
+                for i in range(2):
+                    add_bytes(inner, "%s_%d.JPEG" % (wnid, i),
+                              _png_bytes(gen))
+            add_bytes(outer, wnid + ".tar", inner_buf.getvalue())
+    # val: flat tar + 1-based ids in sorted-filename order + synsets
+    val_tar = tmp_path / "val.tar"
+    with tarfile.open(val_tar, "w") as tar:
+        for i in range(4):
+            add_bytes(tar, "ILSVRC2012_val_%08d.JPEG" % (i + 1),
+                      _png_bytes(gen))
+    labels = tmp_path / "gt.txt"
+    labels.write_text("1\n3\n2\n1\n")
+    synsets = tmp_path / "synsets.txt"
+    synsets.write_text("".join("%s desc %d\n" % (w, i)
+                               for i, w in enumerate(wnids)))
+
+    out = tmp_path / "datasets" / "ImageNet"
+    n = imagenet_prep.stage_train(str(train_tar), str(out),
+                                  log=lambda *a: None)
+    assert n == 3
+    # resume: second run stages nothing new
+    assert imagenet_prep.stage_train(str(train_tar), str(out),
+                                     log=lambda *a: None) == 0
+    staged = imagenet_prep.stage_val(str(val_tar), str(labels),
+                                     str(synsets), str(out),
+                                     log=lambda *a: None)
+    assert staged == 4
+    for wnid, count in [("n01440764", 4), ("n01443537", 3),
+                        ("n01484850", 3)]:   # 2 train + val share
+        files = list((out / wnid).iterdir())
+        assert len(files) == count, (wnid, files)
+
+    # the staged tree is exactly what models/imagenet.py auto-ingests
+    monkeypatch.setattr(root.common.dirs, "datasets",
+                        str(tmp_path / "datasets"))
+    from veles.znicz_tpu.models import imagenet
+    base, classes = imagenet._real_tree()
+    assert base == str(out)
+    assert classes == 3
+
+
+def test_imagenet_prep_rejects_mismatched_ground_truth(tmp_path):
+    import io
+    import tarfile
+    from veles.znicz_tpu.models import imagenet_prep
+    gen = numpy.random.Generator(numpy.random.PCG64(2))
+    val_tar = tmp_path / "val.tar"
+    with tarfile.open(val_tar, "w") as tar:
+        payload = _png_bytes(gen)
+        info = tarfile.TarInfo("ILSVRC2012_val_00000001.JPEG")
+        info.size = len(payload)
+        tar.addfile(info, io.BytesIO(payload))
+    (tmp_path / "gt.txt").write_text("1\n2\n")     # 2 labels, 1 image
+    (tmp_path / "synsets.txt").write_text("n01440764 fish\n")
+    with pytest.raises(ValueError, match="1 images but"):
+        imagenet_prep.stage_val(
+            str(val_tar), str(tmp_path / "gt.txt"),
+            str(tmp_path / "synsets.txt"), str(tmp_path / "out"),
+            log=lambda *a: None)

@@ -1,0 +1,125 @@
+"""ImageNet staging tool: raw distribution archives → the class-dir
+tree the loaders auto-ingest.
+
+The reference's ImageNet sample assumed a prepared directory layout
+(SURVEY.md §2.3 "ImageNet pipeline"); the raw ILSVRC distribution is
+not shaped like that — train images arrive as one tar of per-class
+tars, validation as a flat image directory plus a ground-truth label
+list. This tool builds the ``<base>/<wnid>/*.JPEG`` tree that
+``AutoLabelFileImageLoader`` / ``models/imagenet.py`` pick up with
+zero config (see ``_real_tree``):
+
+    python -m veles.znicz_tpu.models.imagenet_prep \
+        --train-tar ILSVRC2012_img_train.tar \
+        --val-tar ILSVRC2012_img_val.tar \
+        --val-labels ILSVRC2012_validation_ground_truth.txt \
+        --synsets synset_words.txt \
+        --out $DATASETS/ImageNet
+
+Runs incrementally (already-extracted classes are skipped), so an
+interrupted staging resumes. Extraction uses streaming tarfile reads —
+no tar is ever fully loaded into memory. Tested against synthetic
+fixture archives with the real ILSVRC structure
+(tests/test_real_data.py::test_imagenet_prep_*)."""
+
+import argparse
+import os
+import sys
+import tarfile
+
+
+def stage_train(train_tar, out_dir, log=print):
+    """Outer tar of per-class tars -> ``out/<wnid>/*``; returns the
+    number of classes staged (skips classes already present)."""
+    os.makedirs(out_dir, exist_ok=True)
+    staged = 0
+    with tarfile.open(train_tar) as outer:
+        for member in outer:
+            if not member.isfile() or not member.name.endswith(".tar"):
+                continue
+            wnid = os.path.splitext(os.path.basename(member.name))[0]
+            cls_dir = os.path.join(out_dir, wnid)
+            if os.path.isdir(cls_dir) and os.listdir(cls_dir):
+                continue                      # resume support
+            os.makedirs(cls_dir, exist_ok=True)
+            inner_f = outer.extractfile(member)
+            with tarfile.open(fileobj=inner_f) as inner:
+                for img in inner:
+                    if not img.isfile():
+                        continue
+                    name = os.path.basename(img.name)
+                    with open(os.path.join(cls_dir, name), "wb") as w:
+                        w.write(inner.extractfile(img).read())
+            staged += 1
+            log("staged class %s" % wnid)
+    return staged
+
+
+def stage_val(val_tar, labels_file, synsets_file, out_dir, log=print):
+    """Flat validation tar + ground-truth ILSVRC ids + synset list ->
+    the same ``out/<wnid>/`` layout (so train and val trees load with
+    the same class mapping); returns images staged.
+
+    ``labels_file``: one 1-based ILSVRC class id per line, in the
+    sorted-filename order of the archive. ``synsets_file``: one
+    ``wnid ...description`` per line, line N = class id N."""
+    with open(synsets_file) as f:
+        wnids = [line.split()[0] for line in f if line.strip()]
+    with open(labels_file) as f:
+        labels = [int(line) for line in f if line.strip()]
+    os.makedirs(out_dir, exist_ok=True)
+    staged = 0
+    with tarfile.open(val_tar) as tar:
+        members = sorted(
+            (m for m in tar.getmembers() if m.isfile()),
+            key=lambda m: os.path.basename(m.name))
+        if len(members) != len(labels):
+            raise ValueError(
+                "validation tar holds %d images but the ground truth "
+                "lists %d labels" % (len(members), len(labels)))
+        for member, label in zip(members, labels):
+            if not 1 <= label <= len(wnids):
+                raise ValueError("class id %d out of range" % label)
+            wnid = wnids[label - 1]
+            cls_dir = os.path.join(out_dir, wnid)
+            os.makedirs(cls_dir, exist_ok=True)
+            dst = os.path.join(cls_dir, os.path.basename(member.name))
+            if os.path.exists(dst):
+                continue
+            with open(dst, "wb") as w:
+                w.write(tar.extractfile(member).read())
+            staged += 1
+    log("staged %d validation images into %d classes"
+        % (staged, len(set(labels))))
+    return staged
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--train-tar", default=None,
+                   help="ILSVRC train archive (tar of per-class tars)")
+    p.add_argument("--val-tar", default=None,
+                   help="ILSVRC validation archive (flat images)")
+    p.add_argument("--val-labels", default=None,
+                   help="ground-truth class ids, one per line")
+    p.add_argument("--synsets", default=None,
+                   help="synset list, line N = class id N")
+    p.add_argument("--out", required=True,
+                   help="output tree root (point "
+                        "root.common.dirs.datasets/ImageNet here)")
+    args = p.parse_args(argv)
+    if not args.train_tar and not args.val_tar:
+        p.error("nothing to do: pass --train-tar and/or --val-tar")
+    if args.train_tar:
+        n = stage_train(args.train_tar, args.out)
+        print("train: %d classes staged" % n)
+    if args.val_tar:
+        if not (args.val_labels and args.synsets):
+            p.error("--val-tar needs --val-labels and --synsets")
+        stage_val(args.val_tar, args.val_labels, args.synsets,
+                  args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
