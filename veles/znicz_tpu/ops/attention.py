@@ -408,11 +408,18 @@ class MultiHeadAttention(Forward):
     #: ``attn_impl="scan"`` forces the scan at any S.
     PALLAS_AUTO_MIN_S = 4096
 
-    def _effective_impl(self, ctx, s):
-        if self.attn_impl is not None:
-            return self.attn_impl
-        if s >= self.PALLAS_AUTO_MIN_S and \
-                ctx._compiler.device.platform in ("tpu", "axon"):
+    def _traced_mode(self, ctx, s):
+        """ONE dispatch resolver for the traced forward AND backward
+        (they must agree — the cache layout follows the mode):
+        "ring" | "pallas" | "scan" (blocked) | "dense"."""
+        if self.seq_mesh is not None:
+            return "ring"
+        if self.attn_impl == "pallas":
+            return "pallas"
+        if not self.attn_block_size:
+            return "dense"
+        if self.attn_impl is None and s >= self.PALLAS_AUTO_MIN_S \
+                and ctx._compiler.device.platform in ("tpu", "axon"):
             return "pallas"
         return "scan"
 
@@ -420,26 +427,18 @@ class MultiHeadAttention(Forward):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
         p = ctx.unit_params(self)
-        if self.seq_mesh is not None:
+        mode = self._traced_mode(ctx, x.shape[1])
+        names = ("q", "k", "v", "out_heads", "lse", "merged")
+        if mode == "ring":
             y, cache = self._fwd_ring(jnp, x, p, ctx.dot)
-            names = ("q", "k", "v", "out_heads", "lse", "merged")
-        elif self.attn_block_size and self._effective_impl(
-                ctx, x.shape[1]) == "pallas":
+        elif mode == "pallas":
             y, cache = self._fwd_pallas(
                 jnp, x, p, ctx.dot,
                 cd=ctx._compiler.device.compute_dtype)
-            names = ("q", "k", "v", "out_heads", "lse", "merged")
-        elif self.attn_impl == "pallas":
-            # pallas without attn_block_size: kernel picks its block
-            y, cache = self._fwd_pallas(
-                jnp, x, p, ctx.dot,
-                cd=ctx._compiler.device.compute_dtype)
-            names = ("q", "k", "v", "out_heads", "lse", "merged")
-        elif self.attn_block_size:
+        elif mode == "scan":
             y, cache = self._fwd_blocked(
                 jnp, x, p, ctx.dot,
                 cd=ctx._compiler.device.compute_dtype)
-            names = ("q", "k", "v", "out_heads", "lse", "merged")
         else:
             y, cache = self._fwd_core(
                 jnp, x, p["weights"], p.get("bias"), p["weights_out"],
@@ -637,16 +636,13 @@ class GDMultiHeadAttention(GradientDescentBase):
         x = ctx.get(f, "input")
         err = ctx.get(self, "err_output").reshape(x.shape)
         p = ctx.unit_params(f)
-        if f.seq_mesh is not None:
+        mode = f._traced_mode(ctx, x.shape[1])
+        if mode == "ring":
             dx, gw, gb, gwo, gbo = self._bwd_ring(jnp, x, p, ctx, err)
-        elif f.attn_impl == "pallas" or (
-                f.attn_block_size and f._effective_impl(
-                    ctx, x.shape[1]) == "pallas"):
-            # MUST mirror the forward's effective-impl choice: the
-            # pallas cache is (out_heads, lse) in the kernel's layout
+        elif mode == "pallas":
             dx, gw, gb, gwo, gbo = self._bwd_pallas(
                 jnp, x, p, ctx, err)
-        elif f.attn_block_size:
+        elif mode == "scan":
             dx, gw, gb, gwo, gbo = self._bwd_blocked(
                 jnp, x, p, ctx, err)
         else:
