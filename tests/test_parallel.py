@@ -157,3 +157,26 @@ def test_mha_unit_ring_path_matches_dense():
             numpy.asarray(params_ring[fwd.name][pname]),
             numpy.asarray(params_dense[fwd.name][pname]),
             atol=3e-4), pname
+
+
+def test_init_multihost_arg_plumbing(monkeypatch):
+    """init_multihost has never run against a real pod (single-chip
+    environment — see docs/PARALLELISM.md caveat); at minimum its
+    argument plumbing into jax.distributed.initialize must be right,
+    including the auto-detect (no-args) path."""
+    import jax
+    from veles.znicz_tpu import parallel
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax, "process_index", lambda: 3)
+    monkeypatch.setattr(jax, "process_count", lambda: 8)
+
+    rank, count = parallel.init_multihost("10.0.0.1:1234", 8, 3)
+    assert calls[-1] == {"coordinator_address": "10.0.0.1:1234",
+                         "num_processes": 8, "process_id": 3}
+    assert (rank, count) == (3, 8)
+    # cloud-TPU auto-detect: nothing passed through
+    parallel.init_multihost()
+    assert calls[-1] == {}
