@@ -167,14 +167,27 @@ def test_imagenet_prep_stages_ilsvrc_archives(tmp_path, monkeypatch):
     # resume: second run stages nothing new
     assert imagenet_prep.stage_train(str(train_tar), str(out),
                                      log=lambda *a: None) == 0
+    # a PARTIAL class (interrupted extraction) must be re-staged, not
+    # skipped as complete
+    import shutil
+    shutil.move(str(out / "n01440764"),
+                str(out / "n01440764.partial"))
+    (out / "n01440764.partial" / "n01440764_1.JPEG").unlink()
+    assert imagenet_prep.stage_train(str(train_tar), str(out),
+                                     log=lambda *a: None) == 1
+    assert len(list((out / "n01440764").iterdir())) == 2
+    # validation stages into a SEPARATE tree: official val images must
+    # not leak into the training split the loader carves from --out
+    val_out = tmp_path / "datasets" / "ImageNet-val"
     staged = imagenet_prep.stage_val(str(val_tar), str(labels),
-                                     str(synsets), str(out),
+                                     str(synsets), str(val_out),
                                      log=lambda *a: None)
     assert staged == 4
-    for wnid, count in [("n01440764", 4), ("n01443537", 3),
-                        ("n01484850", 3)]:   # 2 train + val share
-        files = list((out / wnid).iterdir())
-        assert len(files) == count, (wnid, files)
+    for wnid, count in [("n01440764", 2), ("n01443537", 2),
+                        ("n01484850", 2)]:
+        assert len(list((out / wnid).iterdir())) == count
+    assert sum(len(list(d.iterdir()))
+               for d in val_out.iterdir()) == 4
 
     # the staged tree is exactly what models/imagenet.py auto-ingests
     monkeypatch.setattr(root.common.dirs, "datasets",

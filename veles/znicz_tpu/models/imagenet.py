@@ -202,6 +202,8 @@ def _real_tree():
         return None, 0
     n = 0
     for entry in os.listdir(base):
+        if entry.endswith(".partial"):
+            continue   # interrupted imagenet_prep staging, not a class
         sub = os.path.join(base, entry)
         if os.path.isdir(sub) and any(
                 f.lower().endswith(IMAGE_EXTS)

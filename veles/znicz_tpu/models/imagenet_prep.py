@@ -30,7 +30,11 @@ import tarfile
 
 def stage_train(train_tar, out_dir, log=print):
     """Outer tar of per-class tars -> ``out/<wnid>/*``; returns the
-    number of classes staged (skips classes already present)."""
+    number of classes staged (skips classes already present).
+
+    Atomic per class: each class extracts into ``<wnid>.partial`` and
+    renames into place only when complete, so an interrupted run never
+    leaves a truncated class that a resume would silently skip."""
     os.makedirs(out_dir, exist_ok=True)
     staged = 0
     with tarfile.open(train_tar) as outer:
@@ -39,17 +43,22 @@ def stage_train(train_tar, out_dir, log=print):
                 continue
             wnid = os.path.splitext(os.path.basename(member.name))[0]
             cls_dir = os.path.join(out_dir, wnid)
-            if os.path.isdir(cls_dir) and os.listdir(cls_dir):
-                continue                      # resume support
-            os.makedirs(cls_dir, exist_ok=True)
+            if os.path.isdir(cls_dir):
+                continue                      # complete (rename is last)
+            tmp_dir = cls_dir + ".partial"
+            if os.path.isdir(tmp_dir):        # leftover from a kill
+                for f in os.listdir(tmp_dir):
+                    os.unlink(os.path.join(tmp_dir, f))
+            os.makedirs(tmp_dir, exist_ok=True)
             inner_f = outer.extractfile(member)
             with tarfile.open(fileobj=inner_f) as inner:
                 for img in inner:
                     if not img.isfile():
                         continue
                     name = os.path.basename(img.name)
-                    with open(os.path.join(cls_dir, name), "wb") as w:
+                    with open(os.path.join(tmp_dir, name), "wb") as w:
                         w.write(inner.extractfile(img).read())
+            os.rename(tmp_dir, cls_dir)
             staged += 1
             log("staged class %s" % wnid)
     return staged
@@ -86,8 +95,11 @@ def stage_val(val_tar, labels_file, synsets_file, out_dir, log=print):
             dst = os.path.join(cls_dir, os.path.basename(member.name))
             if os.path.exists(dst):
                 continue
-            with open(dst, "wb") as w:
+            # write-then-rename: a kill mid-write must not leave a
+            # truncated image a resume would skip
+            with open(dst + ".tmp", "wb") as w:
                 w.write(tar.extractfile(member).read())
+            os.rename(dst + ".tmp", dst)
             staged += 1
     log("staged %d validation images into %d classes"
         % (staged, len(set(labels))))
@@ -105,8 +117,16 @@ def main(argv=None):
     p.add_argument("--synsets", default=None,
                    help="synset list, line N = class id N")
     p.add_argument("--out", required=True,
-                   help="output tree root (point "
+                   help="output tree root for TRAIN classes (point "
                         "root.common.dirs.datasets/ImageNet here)")
+    p.add_argument("--val-out", default=None,
+                   help="output tree root for VALIDATION classes "
+                        "(default: <out>-val). Kept SEPARATE from "
+                        "--out on purpose: AutoLabelFileImageLoader "
+                        "makes its own held-out split over whatever "
+                        "tree it is pointed at, so staging official "
+                        "val images into the train tree would leak "
+                        "most of them into training")
     args = p.parse_args(argv)
     if not args.train_tar and not args.val_tar:
         p.error("nothing to do: pass --train-tar and/or --val-tar")
@@ -117,7 +137,7 @@ def main(argv=None):
         if not (args.val_labels and args.synsets):
             p.error("--val-tar needs --val-labels and --synsets")
         stage_val(args.val_tar, args.val_labels, args.synsets,
-                  args.out)
+                  args.val_out or args.out + "-val")
     return 0
 
 
