@@ -211,7 +211,7 @@ def lm_scale_tokens_per_sec(measure_chunks=1):
     batch 8 / attn_block 256 (248k median tok/s vs 220k at the old
     batch 16 / block 128)."""
     return _lm_throughput(
-        {"minibatch_size": 8, "n_train": 256, "n_valid": 32,
+        {"minibatch_size": 8, "n_train": 512, "n_valid": 32,
          "seq_len": 512, "vocab": 32, "max_period": 8},
         {"dim": 768, "heads": 12, "layers": 8, "ffn_hidden": 3072,
          "attn_block": 256},
