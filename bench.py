@@ -17,11 +17,17 @@ one XLA program (see ``XLAStep._dispatch_epoch``); timing starts after
 the first chunk (covers compilation), each subsequent chunk is timed
 individually (its metric fetch is the synchronization point — the
 remote tunnel's block_until_ready does not block, BASELINE.md round
-3), and BOTH the best and the median chunk rate are reported: best is
-the stable device-side figure under the tunnel's multi-second
-dispatch jitter, median keeps the reporting honest. Every timed chunk
-carries its full share of dispatch + metric-fetch cost; nothing is
-served from pre-computed results.
+3), and BOTH the best and the median chunk rate are reported.
+
+Key convention (since round 4, ADVICE r3): every PRIMARY key — the
+headline ``value`` and ``extra`` keys like ``lm_57M_tokens_per_sec`` —
+carries the MEDIAN chunk rate, the figure comparable with rounds 1-2's
+average-rate timing; the fastest chunk (the stable device-side figure
+under the tunnel's multi-second dispatch jitter) is recorded under the
+explicit ``*_best`` suffix. Round 3 alone put best under the primary
+keys — compare r3 primary keys against r4's ``*_best``, not r4's
+primaries. Every timed chunk carries its full share of dispatch +
+metric-fetch cost; nothing is served from pre-computed results.
 """
 
 import json
@@ -134,7 +140,7 @@ def _xla_throughput(create_workflow, cfg, count, epochs_per_dispatch,
                     name, measure_chunks=1):
     """Shared build-and-time scaffold: seed, size the dataset via the
     sample's config section, init on the XLA device, time whole
-    dispatch chunks; -> count units per second."""
+    dispatch chunks; -> (best, median) count units per second."""
     import veles.prng as prng
     prng.seed_all(99)
     cfg.decision.max_epochs = 1024
@@ -142,12 +148,12 @@ def _xla_throughput(create_workflow, cfg, count, epochs_per_dispatch,
     wf.initialize(device="xla")
     loader, step = wf.loader, wf.xla_step
     step.epochs_per_dispatch = epochs_per_dispatch
-    best, _median = _timed_chunks(loader, step, count,
-                                  measure_chunks)
-    return best
+    best, median = _timed_chunks(loader, step, count,
+                                 measure_chunks)
+    return best, median
 
 
-def xla_cifar_images_per_sec(measure_chunks=1):
+def xla_cifar_images_per_sec(measure_chunks=3):
     """Conv-stack throughput (images/sec) on the XLA device."""
     from veles.loader.base import CLASS_TRAIN
     from veles.config import root
@@ -195,7 +201,7 @@ def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
         root.lm.model.update(saved_model)
 
 
-def lm_tokens_per_sec(measure_chunks=1):
+def lm_tokens_per_sec(measure_chunks=3):
     """Transformer-LM training throughput (tokens/sec) on the XLA
     device — the north star's NEW config (BASELINE config #5)."""
     return _lm_throughput(
@@ -203,7 +209,7 @@ def lm_tokens_per_sec(measure_chunks=1):
          "seq_len": 128}, {}, "BenchLM", 8, measure_chunks)
 
 
-def lm_scale_tokens_per_sec(measure_chunks=1):
+def lm_scale_tokens_per_sec(measure_chunks=3):
     """Transformer-LM throughput at REAL model scale (57.5M params:
     dim 768, 12 heads, 8 layers, ffn 3072, S=512) — the recorded
     large-model number (BASELINE.md 'Transformer LM at scale').
@@ -218,7 +224,7 @@ def lm_scale_tokens_per_sec(measure_chunks=1):
         "BenchLMScale", 4, measure_chunks)
 
 
-def lm_longctx_tokens_per_sec(measure_chunks=1):
+def lm_longctx_tokens_per_sec(measure_chunks=3):
     """57.5M-param LM at S=8192 (long-context row): blocked attention
     with the AUTO impl policy — the Pallas flash kernels take over at
     this length (measured 2.6x over the XLA scan end-to-end on a v5e;
@@ -231,43 +237,39 @@ def lm_longctx_tokens_per_sec(measure_chunks=1):
         "BenchLMLongCtx", 1, measure_chunks)
 
 
+def _record(extra, key, fn):
+    """Run one bench row; primary key = median, ``_best`` = fastest
+    chunk (see the module docstring's key convention)."""
+    try:
+        best, median = fn()
+        extra[key] = round(median, 1)
+        extra[key + "_best"] = round(best, 1)
+    except Exception as exc:   # keep the primary metric robust
+        extra[key + "_error"] = str(exc)[:200]
+
+
 def main():
     base = numpy_steps_per_sec()
     fast, fast_median, grad_bytes = xla_mnist_bench(measure_chunks=3)
     extra = {
         "mnist_numpy_steps_per_sec": round(base, 2),
-        "mnist_train_steps_per_sec_median": round(fast_median, 2),
+        "mnist_train_steps_per_sec_best": round(fast, 2),
         "grad_sync_bytes_per_step": int(grad_bytes),
     }
-    try:
-        extra["cifar_conv_images_per_sec"] = round(
-            xla_cifar_images_per_sec(), 1)
-    except Exception as exc:   # keep the primary metric robust
-        extra["cifar_conv_images_per_sec_error"] = str(exc)[:200]
-    try:
+    _record(extra, "cifar_conv_images_per_sec", xla_cifar_images_per_sec)
+
+    def alexnet_row():
+        # import inside so ANY failure (import or run) lands in the
+        # row's _error key instead of killing the remaining rows
         from bench_alexnet import alexnet_images_per_sec
-        med, best = alexnet_images_per_sec()
-        extra["alexnet_synth_images_per_sec"] = round(best, 1)
-        extra["alexnet_synth_images_per_sec_median"] = round(med, 1)
-    except ImportError:
-        pass
-    except Exception as exc:
-        extra["alexnet_synth_images_per_sec_error"] = str(exc)[:200]
-    try:
-        extra["lm_train_tokens_per_sec"] = round(
-            lm_tokens_per_sec(), 1)
-    except Exception as exc:
-        extra["lm_train_tokens_per_sec_error"] = str(exc)[:200]
-    try:
-        extra["lm_57M_tokens_per_sec"] = round(
-            lm_scale_tokens_per_sec(), 1)
-    except Exception as exc:
-        extra["lm_57M_tokens_per_sec_error"] = str(exc)[:200]
-    try:
-        extra["lm_57M_s8k_tokens_per_sec"] = round(
-            lm_longctx_tokens_per_sec(), 1)
-    except Exception as exc:
-        extra["lm_57M_s8k_tokens_per_sec_error"] = str(exc)[:200]
+        median, best = alexnet_images_per_sec()
+        return best, median           # _record wants (best, median)
+
+    _record(extra, "alexnet_synth_images_per_sec", alexnet_row)
+    _record(extra, "lm_train_tokens_per_sec", lm_tokens_per_sec)
+    _record(extra, "lm_57M_tokens_per_sec", lm_scale_tokens_per_sec)
+    _record(extra, "lm_57M_s8k_tokens_per_sec",
+            lm_longctx_tokens_per_sec)
     # which data fed each number: real on-disk datasets or the
     # synthetic stand-ins (zero-egress environments have no choice,
     # but the record keeps every figure honest — VERDICT r2 item 4)
@@ -276,9 +278,9 @@ def main():
                      for k, v in data_provenance().items()}
     print(json.dumps({
         "metric": "mnist_train_steps_per_sec",
-        "value": round(fast, 2),
+        "value": round(fast_median, 2),
         "unit": "steps/s",
-        "vs_baseline": round(fast / base, 3),
+        "vs_baseline": round(fast_median / base, 3),
         "extra": extra,
     }))
 

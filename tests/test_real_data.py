@@ -156,9 +156,12 @@ def test_imagenet_prep_stages_ilsvrc_archives(tmp_path, monkeypatch):
                       _png_bytes(gen))
     labels = tmp_path / "gt.txt"
     labels.write_text("1\n3\n2\n1\n")
+    # devkit ILSVRC2012_ID ordering is NOT alphabetical-by-wnid;
+    # the fixture mirrors that (and stage_val rejects sorted lists)
+    devkit_order = [wnids[1], wnids[0], wnids[2]]
     synsets = tmp_path / "synsets.txt"
     synsets.write_text("".join("%s desc %d\n" % (w, i)
-                               for i, w in enumerate(wnids)))
+                               for i, w in enumerate(devkit_order)))
 
     out = tmp_path / "datasets" / "ImageNet"
     n = imagenet_prep.stage_train(str(train_tar), str(out),
@@ -179,10 +182,23 @@ def test_imagenet_prep_stages_ilsvrc_archives(tmp_path, monkeypatch):
     # validation stages into a SEPARATE tree: official val images must
     # not leak into the training split the loader carves from --out
     val_out = tmp_path / "datasets" / "ImageNet-val"
+    # an alphabetically-sorted synset list is the signature of the
+    # wnid-sorted synset_words.txt, whose line order does NOT match
+    # the devkit ids the ground truth indexes — refuse it loudly
+    sorted_synsets = tmp_path / "synsets_sorted.txt"
+    sorted_synsets.write_text("".join("%s desc\n" % w for w in wnids))
+    with pytest.raises(ValueError, match="alphabetical order"):
+        imagenet_prep.stage_val(str(val_tar), str(labels),
+                                str(sorted_synsets), str(val_out),
+                                log=lambda *a: None)
     staged = imagenet_prep.stage_val(str(val_tar), str(labels),
                                      str(synsets), str(val_out),
                                      log=lambda *a: None)
     assert staged == 4
+    # ids resolve through the DEVKIT order: id 1 -> devkit_order[0]
+    assert len(list((val_out / devkit_order[0]).iterdir())) == 2
+    assert len(list((val_out / devkit_order[2]).iterdir())) == 1
+    assert len(list((val_out / devkit_order[1]).iterdir())) == 1
     for wnid, count in [("n01440764", 2), ("n01443537", 2),
                         ("n01484850", 2)]:
         assert len(list((out / wnid).iterdir())) == count

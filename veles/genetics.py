@@ -47,16 +47,21 @@ def find_tunables(node, prefix=""):
 class _SafeEval:
     """Picklable failure-absorbing wrapper around the fitness
     callable: a crashed individual scores inf instead of killing the
-    search (reference behaviour — a diverged run is just unfit)."""
+    search (reference behaviour — a diverged run is just unfit).
+
+    Returns ``(fitness, error_or_None)`` — the error string rides back
+    through the (possibly cross-process) map so ``_fitness_of`` can
+    say WHY individuals failed; a bare inf from a worker would lose
+    the traceback entirely."""
 
     def __init__(self, evaluate):
         self.evaluate = evaluate
 
     def __call__(self, values):
         try:
-            return float(self.evaluate(values))
-        except Exception:
-            return float("inf")
+            return float(self.evaluate(values)), None
+        except Exception as exc:
+            return float("inf"), "%s: %s" % (type(exc).__name__, exc)
 
 
 class ProcessPoolMap:
@@ -141,7 +146,8 @@ class SubprocessTrainer:
 
         def main(**kwargs):
             wf = holder["wf"]
-            if self.max_epochs is not None and                     getattr(wf, "decision", None) is not None:
+            if (self.max_epochs is not None
+                    and getattr(wf, "decision", None) is not None):
                 wf.decision.max_epochs = int(self.max_epochs)
             wf.initialize(device=self.device)
             wf.run()
@@ -234,12 +240,17 @@ class GeneticOptimizer(Logger):
         # map_fn (ProcessPoolMap) can ship it to worker processes —
         # the evaluate callable itself must then be picklable too
         # (e.g. SubprocessTrainer)
-        out = numpy.asarray(
-            self.map_fn(_SafeEval(self.evaluate), vals), float)
+        # list() first: a lazy caller-supplied map_fn (builtin map)
+        # must not be exhausted by the fitness pass before the error
+        # pass reads it
+        pairs = list(self.map_fn(_SafeEval(self.evaluate), vals))
+        out = numpy.asarray([fit for fit, _ in pairs], float)
+        errors = [msg for _, msg in pairs if msg]
         self.evaluations += len(vals)
         bad = int((~numpy.isfinite(out)).sum())
         if bad:
-            self.warning("%d individual(s) failed this round", bad)
+            self.warning("%d individual(s) failed this round (first: %s)",
+                         bad, errors[0] if errors else "non-finite fitness")
         return numpy.where(numpy.isfinite(out), out, numpy.inf)
 
     def run(self):

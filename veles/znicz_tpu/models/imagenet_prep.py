@@ -13,8 +13,17 @@ zero config (see ``_real_tree``):
         --train-tar ILSVRC2012_img_train.tar \
         --val-tar ILSVRC2012_img_val.tar \
         --val-labels ILSVRC2012_validation_ground_truth.txt \
-        --synsets synset_words.txt \
+        --synsets devkit_ilsvrc2012_id_order.txt \
         --out $DATASETS/ImageNet
+
+WARNING on --synsets ordering: the ground-truth file's class ids follow
+the devkit's ILSVRC2012_ID ordering (meta.mat / meta_clsloc), which is
+NOT the wnid-sorted line order of the commonly distributed
+``synset_words.txt``. Passing a wnid-sorted list silently stages every
+validation image under the wrong class — the ids all range-check fine.
+Derive the list from the devkit (line N = wnid whose ILSVRC2012_ID is
+N); ``stage_val`` refuses alphabetically-sorted synset lists unless
+``allow_sorted_synsets=True`` (``--allow-sorted-synsets``).
 
 Runs incrementally (already-extracted classes are skipped), so an
 interrupted staging resumes. Extraction uses streaming tarfile reads —
@@ -64,16 +73,32 @@ def stage_train(train_tar, out_dir, log=print):
     return staged
 
 
-def stage_val(val_tar, labels_file, synsets_file, out_dir, log=print):
+def stage_val(val_tar, labels_file, synsets_file, out_dir, log=print,
+              allow_sorted_synsets=False):
     """Flat validation tar + ground-truth ILSVRC ids + synset list ->
     the same ``out/<wnid>/`` layout (so train and val trees load with
     the same class mapping); returns images staged.
 
     ``labels_file``: one 1-based ILSVRC class id per line, in the
     sorted-filename order of the archive. ``synsets_file``: one
-    ``wnid ...description`` per line, line N = class id N."""
+    ``wnid ...description`` per line, line N = the wnid whose devkit
+    ILSVRC2012_ID is N (meta.mat ordering — NOT the wnid-sorted order
+    of the common ``synset_words.txt``; see the module docstring).
+
+    Because a wrongly-ordered synset list still range-checks, an
+    alphabetically-sorted wnid list — the signature of the wnid-sorted
+    ``synset_words.txt`` — is rejected unless ``allow_sorted_synsets``
+    (the devkit ILSVRC2012_ID order is not alphabetical)."""
     with open(synsets_file) as f:
         wnids = [line.split()[0] for line in f if line.strip()]
+    if len(wnids) > 2 and wnids == sorted(wnids) and not allow_sorted_synsets:
+        raise ValueError(
+            "--synsets lists wnids in alphabetical order, which matches "
+            "the wnid-sorted synset_words.txt, not the devkit "
+            "ILSVRC2012_ID ordering the ground-truth ids index into; "
+            "staging would file every validation image under the wrong "
+            "class. Supply the devkit (meta.mat) ordering, or pass "
+            "--allow-sorted-synsets if this ordering really is correct.")
     with open(labels_file) as f:
         labels = [int(line) for line in f if line.strip()]
     os.makedirs(out_dir, exist_ok=True)
@@ -115,7 +140,12 @@ def main(argv=None):
     p.add_argument("--val-labels", default=None,
                    help="ground-truth class ids, one per line")
     p.add_argument("--synsets", default=None,
-                   help="synset list, line N = class id N")
+                   help="synset list, line N = class id N in the DEVKIT "
+                        "(meta.mat ILSVRC2012_ID) ordering — not the "
+                        "wnid-sorted synset_words.txt")
+    p.add_argument("--allow-sorted-synsets", action="store_true",
+                   help="accept an alphabetically-sorted synset list "
+                        "(normally rejected as a mis-ordering symptom)")
     p.add_argument("--out", required=True,
                    help="output tree root for TRAIN classes (point "
                         "root.common.dirs.datasets/ImageNet here)")
@@ -137,7 +167,8 @@ def main(argv=None):
         if not (args.val_labels and args.synsets):
             p.error("--val-tar needs --val-labels and --synsets")
         stage_val(args.val_tar, args.val_labels, args.synsets,
-                  args.val_out or args.out + "-val")
+                  args.val_out or args.out + "-val",
+                  allow_sorted_synsets=args.allow_sorted_synsets)
     return 0
 
 

@@ -565,6 +565,20 @@ def pipeline_1f1b_step(params, x, targets, err_fn, mesh, axis="pipe",
     interleaved backward in ONE schedule. Returns (y, dx, grads,
     loss_sum); grads leaves (L, ...) stage-sharded like params.
 
+    SCALING CONVENTION — sums, never means: grads and loss are summed
+    over the ``n_micro`` microbatches and (with ``batch_axis``) psum'd
+    over the data shards; dx is concatenated per-sample (never summed
+    or psum'd — each sample keeps its own input gradient). With an
+    ``err_fn`` that mean-normalizes per microbatch, EVERY output still
+    carries the factor ``n_micro * n_data_shards`` relative to the
+    full-batch single-chip values — grads/loss through the summation,
+    dx through the microbatch-local mean denominator (1/bm vs 1/B).
+    Divide by that factor (or fold ``1/(n_micro*dp)`` into ``err_fn``)
+    before feeding an optimizer; tests/test_pipeline.py's 1F1B parity
+    check shows the exact rescale. Kept as a sum because the right
+    normalization lives with the loss definition, not the schedule —
+    same convention as ``pipeline_train_step`` (GPipe).
+
     Peak stash: ``n_stage`` microbatch caches per stage vs GPipe's
     ``n_micro`` — the 1F1B memory bound (docs/PARALLELISM.md has the
     bubble/memory table). Parity: tests/test_pipeline.py checks y, dx,
