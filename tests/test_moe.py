@@ -192,7 +192,8 @@ def test_extra_param_accumulation_and_bias_hypers(kind, wlike, blike):
                               getattr(fwd, n).mem, atol=1e-6), n
 
 
-def _run_moe_lm(backend, parallel_spec=None, seed=515):
+def _run_moe_lm(backend, parallel_spec=None, seed=515,
+                capacity_factor=2.0):
     prng.seed_all(seed)
     from veles.znicz_tpu.models import transformer_lm
     root.lm.loader.update({"minibatch_size": 32, "n_train": 512,
@@ -200,11 +201,11 @@ def _run_moe_lm(backend, parallel_spec=None, seed=515):
                            "max_period": 4})
     root.lm.model.update({"dim": 32, "heads": 2, "layers": 1,
                           "ffn_hidden": 64, "moe_experts": 4,
-                          "moe_capacity_factor": 2.0,
+                          "moe_capacity_factor": capacity_factor,
                           "moe_aux_weight": 0.01, "attn_block": None})
     root.lm.decision.max_epochs = 6
     root.lm.parallel.update({"seq": 1, "model": 1, "data": 1,
-                             "expert": 1})
+                             "expert": 1, "ep_routing": "gather"})
     if parallel_spec:
         root.lm.parallel.update(parallel_spec)
     wf = transformer_lm.create_workflow(
@@ -214,7 +215,7 @@ def _run_moe_lm(backend, parallel_spec=None, seed=515):
     # don't leak MoE/EP config into other test modules
     root.lm.model.moe_experts = 0
     root.lm.parallel.update({"seq": 1, "model": 1, "data": 1,
-                             "expert": 1})
+                             "expert": 1, "ep_routing": "gather"})
     return wf
 
 
@@ -244,6 +245,53 @@ def test_moe_lm_trains_and_ep_matches_single_device():
     # all-reduce over data — proves distribution, not replication
     from veles.znicz_tpu import parallel
     parallel.assert_collectives(step, ["all-gather", "all-reduce"])
+
+
+def test_moe_lm_ep_alltoall_matches_single_device():
+    """The explicit shard_map all-to-all EP (parallel/expert.py) is a
+    layout choice too: with a capacity factor high enough that no
+    token overflows a per-shard quota, EP4 and EP4xDP2 reproduce the
+    single-device run, and the exchange really lowers to all-to-all
+    ops in the partitioned HLO (the gather mode's O(E)-bandwidth
+    all-gather must be gone from the token path)."""
+    from veles.znicz_tpu import parallel
+
+    wf1 = _run_moe_lm("xla", capacity_factor=8.0)
+    h1 = [e["validation"]["metric"] for e in wf1.decision.history]
+    wf4 = _run_moe_lm("xla", {"expert": 4, "ep_routing": "alltoall"},
+                      capacity_factor=8.0)
+    h4 = [e["validation"]["metric"] for e in wf4.decision.history]
+    assert numpy.allclose(h1, h4, atol=1e-3), (h1, h4)
+    counts = parallel.assert_collectives(wf4.xla_step, ["all-to-all"])
+    # ...and the O(E) token replication really is gone: this program
+    # has no all-gather at all (the gather mode shows several)
+    assert not counts.get("all-gather"), counts
+    # DP on top: tokens shard over (data, expert); grads all-reduce
+    wf8 = _run_moe_lm("xla", {"expert": 4, "data": 2,
+                              "ep_routing": "alltoall"},
+                      capacity_factor=8.0)
+    h8 = [e["validation"]["metric"] for e in wf8.decision.history]
+    assert numpy.allclose(h1, h8, atol=1e-3), (h1, h8)
+    counts8 = parallel.assert_collectives(wf8.xla_step,
+                                          ["all-to-all", "all-reduce"])
+    assert not counts8.get("all-gather"), counts8
+    # params stay expert-sharded exactly like gather mode
+    moe_units = [f for f in wf8.forwards
+                 if type(f).__name__ == "MoEFFN"]
+    leaf = wf8.xla_step.params[moe_units[0].name]["weights"]
+    spec = leaf.sharding.spec
+    assert spec and spec[0] == "expert", spec
+
+
+def test_moe_lm_ep_alltoall_trains_with_drops():
+    """At the default tight capacity (per-SHARD quotas differ from the
+    single-chip global quota, so no exact parity claim) the a2a path
+    still trains: error drops and the HLO carries the exchange."""
+    from veles.znicz_tpu import parallel
+    wf = _run_moe_lm("xla", {"expert": 4, "ep_routing": "alltoall"})
+    h = [e["validation"]["metric"] for e in wf.decision.history]
+    assert h[-1] < h[0], h
+    parallel.assert_collectives(wf.xla_step, ["all-to-all"])
 
 
 def test_moe_lm_single_slave_matches_standalone():

@@ -49,8 +49,12 @@ root.lm.update({
     # shards the transformer matmuls Megatron-style via GSPMD; data
     # > 1 shards the batch. All from config alone — e.g.
     #   velescli ... root.lm.parallel.seq=8
+    # ep_routing: "gather" (GSPMD-partitioned dense dispatch; O(E)
+    # token bandwidth, fine on small meshes) or "alltoall" (explicit
+    # shard_map lax.all_to_all exchange, O(tokens) — the at-scale EP;
+    # parallel/expert.py)
     "parallel": {"seq": 1, "model": 1, "data": 1, "expert": 1,
-                 "pipe": 1, "microbatches": 4},
+                 "pipe": 1, "microbatches": 4, "ep_routing": "gather"},
 })
 
 
@@ -285,7 +289,10 @@ class TransformerLMWorkflow(StandardWorkflow):
             # skips attention units already owned by the ring path
             parallel.setup_tensor_parallel(self, mesh, refresh=False)
         if expert > 1:
-            parallel.setup_expert_parallel(self, mesh, refresh=False)
+            parallel.setup_expert_parallel(
+                self, mesh, refresh=False,
+                routing=str(spec.get("ep_routing", "gather")),
+                batch_axis="data" if data > 1 else None)
         if pipe > 1:
             parallel.setup_pipeline_parallel(
                 self, mesh,

@@ -6,11 +6,24 @@ expert parallelism is part of this rebuild's first-class distributed
 story. The design is the TPU-native GShard/Switch formulation: top-1
 ("switch") routing with a fixed per-expert capacity, dispatch/combine
 expressed as dense one-hot einsums so the whole layer is static-shaped
-and jit-compilable — no gather/scatter, no data-dependent shapes. With
-the expert dimension of the parameters sharded over an ``expert`` mesh
-axis (:func:`veles.znicz_tpu.parallel.setup_expert_parallel`), XLA's
-partitioner turns the dispatch einsum into the canonical ``all_to_all``
-token exchange over ICI.
+and jit-compilable — no gather/scatter, no data-dependent shapes.
+
+Two expert-parallel lowerings, selected by
+:func:`veles.znicz_tpu.parallel.setup_expert_parallel`'s ``routing``:
+
+* ``"gather"`` (default): only the expert dim of the parameters is
+  sharded; GSPMD partitions the dense dispatch einsum itself. At the
+  shapes we run, it lowers to an **all-gather of the token block**
+  onto every expert shard (measured in the partitioned HLO —
+  ``tests/test_moe.py``, ``__graft_entry__.py``). Compute and expert
+  memory are fully distributed, but token bandwidth is O(E): fine on
+  a small mesh, wrong at scale.
+* ``"alltoall"``: the canonical GShard-style exchange, written
+  explicitly with ``shard_map`` + ``lax.all_to_all``
+  (``parallel/expert.py``): each device routes its local tokens,
+  ships exactly the per-expert slot buffers to the expert's owner,
+  and receives its experts' tokens — O(tokens) bandwidth, asserted
+  as ``all-to-all`` in the partitioned HLO.
 
 Semantics (Switch Transformer, Fedus et al. 2021 — formulation only):
 
@@ -41,6 +54,37 @@ def _one_hot(xp, idx, n):
     return (xp.arange(n) == idx[..., None]).astype(numpy.float32)
 
 
+def route_tokens(xp, xt, router, experts, cap):
+    """Top-1 routing for flat tokens (T, D) -> (probs, onehot_e, gate,
+    dispatch). ``dispatch`` (T, E, C) is the one-hot token→(expert,
+    slot) assignment; the slot index is the token's rank among the
+    tokens routed to the same expert (cumsum trick), and ranks beyond
+    ``cap`` zero out (dropped tokens). Module-level so the explicit
+    all-to-all EP path (``parallel/expert.py``) shares the exact
+    formula with the unit's oracle/traced runs."""
+    logits = xt @ router
+    probs = A.softmax(xp, logits)
+    eidx = xp.argmax(logits, axis=-1)
+    onehot_e = _one_hot(xp, eidx, experts)            # (T, E)
+    gate = (probs * onehot_e).sum(axis=-1)            # (T,)
+    # rank of each token within its expert queue
+    pos = (xp.cumsum(onehot_e, axis=0) - 1.0)         # (T, E)
+    pos_t = (pos * onehot_e).sum(axis=-1)             # (T,)
+    keep = (pos_t < cap).astype(numpy.float32)
+    slot = _one_hot(xp, pos_t.astype(numpy.int32), cap)
+    dispatch = (onehot_e[:, :, None] * slot[:, None, :]
+                * keep[:, None, None])                # (T, E, C)
+    return probs, onehot_e, gate, dispatch
+
+
+def experts_fwd(xp, xe, w1, b1, w2, b2, activation, es):
+    """Batched expert FFN over (E, C, D) slot buffers -> (h, ye)."""
+    h = A.ACTIVATIONS[activation][0](
+        xp, es("ecd,edh->ech", xe, w1) + b1[:, None, :])
+    ye = es("ech,ehd->ecd", h, w2) + b2[:, None, :]
+    return h, ye
+
+
 @forward_unit("moe_ffn")
 class MoEFFN(Forward):
     """y = [x +] combine · expert_ffn(dispatch · x), top-1 routed.
@@ -65,6 +109,12 @@ class MoEFFN(Forward):
         self.router = Array()
         self.weights2 = Array()
         self.bias2 = Array()
+        # explicit all-to-all EP (parallel/expert.py); set by
+        # setup_expert_parallel(routing="alltoall"), None = GSPMD
+        # gather lowering
+        self.ep_mesh = None
+        self.ep_axis = None
+        self.ep_batch_axis = None
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -103,35 +153,14 @@ class MoEFFN(Forward):
     # shared formula set ----------------------------------------------
 
     def _route(self, xp, xt, router):
-        """(probs, onehot_e, gate, dispatch) for flat tokens (T, D).
-
-        ``dispatch`` (T, E, C) is the one-hot token→(expert, slot)
-        assignment; the slot index is the token's rank among the
-        tokens routed to the same expert (cumsum trick), and ranks
-        beyond capacity zero out (dropped tokens).
-        """
-        n_tokens = xt.shape[0]
-        cap = self.capacity(n_tokens)
-        logits = xt @ router
-        probs = A.softmax(xp, logits)
-        eidx = xp.argmax(logits, axis=-1)
-        onehot_e = _one_hot(xp, eidx, self.experts)       # (T, E)
-        gate = (probs * onehot_e).sum(axis=-1)            # (T,)
-        # rank of each token within its expert queue
-        pos = (xp.cumsum(onehot_e, axis=0) - 1.0)         # (T, E)
-        pos_t = (pos * onehot_e).sum(axis=-1)             # (T,)
-        keep = (pos_t < cap).astype(numpy.float32)
-        slot = _one_hot(xp, pos_t.astype(numpy.int32), cap)
-        dispatch = (onehot_e[:, :, None] * slot[:, None, :]
-                    * keep[:, None, None])                # (T, E, C)
-        return probs, onehot_e, gate, dispatch
+        """(probs, onehot_e, gate, dispatch) for flat tokens (T, D);
+        see :func:`route_tokens`."""
+        return route_tokens(xp, xt, router, self.experts,
+                            self.capacity(xt.shape[0]))
 
     def _experts_fwd(self, xp, xe, w1, b1, w2, b2, es):
         """Batched expert FFN over (E, C, D) slot buffers."""
-        h = A.ACTIVATIONS[self.ACTIVATION][0](
-            xp, es("ecd,edh->ech", xe, w1) + b1[:, None, :])
-        ye = es("ech,ehd->ecd", h, w2) + b2[:, None, :]
-        return h, ye
+        return experts_fwd(xp, xe, w1, b1, w2, b2, self.ACTIVATION, es)
 
     def _forward(self, xp, x, p, es=None):
         es = es or xp.einsum
@@ -162,8 +191,13 @@ class MoEFFN(Forward):
     def xla_run(self, ctx):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
-        y, cache = self._forward(jnp, x, ctx.unit_params(self),
-                                 ctx.einsum)
+        if self.ep_mesh is not None:
+            from veles.znicz_tpu.parallel import expert as ep
+            y, cache = ep.moe_a2a_fwd(x, ctx.unit_params(self), self,
+                                      ctx.einsum)
+        else:
+            y, cache = self._forward(jnp, x, ctx.unit_params(self),
+                                     ctx.einsum)
         ctx.set(self, "output", y.astype(ctx.act_dtype))
         for k, v in cache.items():
             ctx.set(self, "cache_" + k, v)
@@ -256,8 +290,13 @@ class GDMoEFFN(GradientDescentBase):
                  for k in ("probs", "onehot_e", "gate", "dispatch",
                            "xe", "h", "ye")}
         h = ctx.hyper[self.name]
-        dx, grads = self._backward(jnp, x, p, cache, err,
-                                   h["aux_weight"], ctx.einsum)
+        if f.ep_mesh is not None:
+            from veles.znicz_tpu.parallel import expert as ep
+            dx, grads = ep.moe_a2a_bwd(x, err, p, cache,
+                                       h["aux_weight"], f, ctx.einsum)
+        else:
+            dx, grads = self._backward(jnp, x, p, cache, err,
+                                       h["aux_weight"], ctx.einsum)
         if self.need_err_input:
             ctx.set(self, "err_input", dx.astype(ctx.act_dtype))
         self.update_weights_xla(ctx, grads["weights"], grads["bias"])

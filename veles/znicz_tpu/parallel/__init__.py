@@ -179,17 +179,33 @@ def setup_sequence_parallel(workflow, mesh, axis="seq",
     return mesh
 
 
-def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True):
-    """Expert parallelism for MoE units, the GSPMD way: the leading
-    (expert) dim of every stacked expert parameter — and its momentum
-    state — is sharded over ``axis``, so each device holds E/n experts.
-    The dispatch/combine einsums (``ops/moe.py``) then contract a
-    replicated token tensor against expert-sharded buffers, and XLA's
-    partitioner materialises the canonical ``all_to_all`` token
-    exchange over ICI. The router stays replicated (every device
-    routes every token — the (D,E) matmul is negligible)."""
+def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True,
+                          routing="gather", batch_axis=None):
+    """Expert parallelism for MoE units: the leading (expert) dim of
+    every stacked expert parameter — and its momentum state — is
+    sharded over ``axis``, so each device holds E/n experts. The
+    router stays replicated (every device routes every token — the
+    (D,E) matmul is negligible).
+
+    ``routing`` picks how tokens reach their expert's device:
+
+    * ``"gather"`` (default): parameters shard, the dense
+      dispatch/combine einsums stay as written, and GSPMD partitions
+      them — which at our shapes lowers to an **all-gather of the
+      token block** onto every expert shard. Fully distributed compute
+      and expert memory, but O(E) token bandwidth: the small-mesh
+      choice.
+    * ``"alltoall"``: the canonical GShard exchange, explicit
+      ``shard_map`` + ``lax.all_to_all`` (``parallel/expert.py``) —
+      O(tokens) bandwidth, the at-scale choice. Pass ``batch_axis``
+      when composing with DP on the same mesh so the token specs
+      match the batch sharding. Capacity/aux become per-data-shard at
+      DP>1 (see ``parallel/expert.py`` docstring)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from veles.znicz_tpu.ops.moe import MoEFFN
+    if routing not in ("gather", "alltoall"):
+        raise ValueError("routing must be 'gather' or 'alltoall', "
+                         "got %r" % (routing,))
     step = workflow.xla_step
     if step is None:
         raise ValueError("workflow has no xla_step (numpy backend?)")
@@ -203,6 +219,23 @@ def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True):
             raise ValueError(
                 "%s: %s axis size %d does not divide expert count %d"
                 % (fwd.name, axis, n, fwd.experts))
+        if routing == "alltoall":
+            extra = [a for a in mesh.axis_names
+                     if a not in (axis, batch_axis)]
+            if extra:
+                # loud error, not a silent fallback: the exchange
+                # shards tokens over (batch_axis, expert) only, so any
+                # further mesh axis would replicate the whole token
+                # exchange across its ranks — the O(replication)
+                # traffic alltoall mode exists to eliminate
+                raise ValueError(
+                    "alltoall EP composes with a data axis only; mesh "
+                    "axes %r would silently replicate the token "
+                    "exchange — use routing='gather' with them or "
+                    "drop them" % (extra,))
+            fwd.ep_mesh = mesh
+            fwd.ep_axis = axis
+            fwd.ep_batch_axis = batch_axis
         gd = workflow.gds[i] if i < len(workflow.gds) else None
         for key in ("weights", "bias", "weights2", "bias2"):
             sh = NamedSharding(

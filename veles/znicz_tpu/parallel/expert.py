@@ -1,0 +1,260 @@
+"""Explicit all-to-all expert parallelism for the MoE units.
+
+The GShard/Switch token exchange, hand-written with ``shard_map`` +
+``lax.all_to_all`` (SURVEY.md §5.8 "TPU-native equivalent" — no
+reference counterpart; upstream VELES has no MoE). This is the
+at-scale EP lowering: the default GSPMD partitioning of the dense
+dispatch einsum (``ops/moe.py`` "gather" mode) replicates the token
+block onto every expert shard — O(E) bandwidth — while this path ships
+each token once to the device owning its expert — O(tokens).
+
+Token layout — the crucial choice: inside the exchange the batch is
+sharded over the COMBINED (data, expert) axes, GShard-style, so every
+device owns a distinct token shard. (Merely replicating tokens along
+the expert axis — the outer program's layout — would make each of the
+n expert-axis peers ship the SAME tokens, handing every expert n
+duplicate copies and scaling its weight gradients by n; the shard_map
+in/out specs therefore split the batch dim over ``(batch_axis, axis)``
+and GSPMD inserts the cheap reshard at the boundary.)
+
+Dataflow per device (local tokens T_loc = B·S/(dp·n), global experts
+E, local experts E/n, per-(expert, source-shard) capacity C):
+
+1. route local tokens with the SHARED formula (``moe.route_tokens``)
+   → dispatch one-hots (T_loc, E, C);
+2. pack per-expert slot buffers xe (E, C, D) and ``all_to_all`` over
+   the expert axis: split the E dim, concatenate received buffers on
+   the capacity dim → (E/n, n·C, D) — each device now holds exactly
+   the tokens routed to ITS experts from every peer;
+3. run the expert FFN on the local expert block;
+4. reverse ``all_to_all`` returns expert outputs to the tokens' home
+   shards; combine with the gate weights.
+
+The backward unit mirrors the exchange (the transpose of an
+all-to-all is the reverse all-to-all); expert-weight gradients psum
+over the data axis (each expert's tokens from other data shards live
+there), router gradients psum over every token-sharding axis.
+
+Parity semantics vs the single-chip / gather formulation: the
+load-balancing auxiliary gradient uses the GLOBAL routing frequency
+(``pmean`` over the token axes — exactly the single-chip term), so
+the only divergence is capacity: ``ceil(cf·T_loc/E)`` PER SOURCE
+SHARD rather than one global quota. The total per-expert budget
+(n·C_loc ≥ C_global) is weakly larger, but the quota is enforced per
+shard, so a shard whose routing is skewed toward one expert can drop
+tokens the global quota would have kept — the drop PATTERN differs
+in both directions. With a capacity factor high enough that no shard
+overflows, the a2a path matches the single-chip run exactly
+(asserted in tests/test_moe.py).
+"""
+
+import functools
+
+import numpy
+
+from veles.znicz_tpu.parallel.ring import _shard_map
+
+
+def _specs(unit):
+    """(mesh, axis, batch_axis, PartitionSpec factory) for a unit the
+    setup routed through the explicit path."""
+    from jax.sharding import PartitionSpec as P
+    return unit.ep_mesh, unit.ep_axis, unit.ep_batch_axis, P
+
+
+def _token_axes(unit):
+    """The mesh axes the batch dim is sharded over inside the
+    exchange: (batch_axis, expert_axis) combined — see the module
+    docstring's token-layout note."""
+    _, axis, batch_axis, _ = _specs(unit)
+    return (batch_axis, axis) if batch_axis else (axis,)
+
+
+def _local_tokens(unit, x_shape):
+    """Static per-device token count and capacity."""
+    mesh, axis, batch_axis, _ = _specs(unit)
+    shards = int(numpy.prod([mesh.shape[a] for a in _token_axes(unit)]))
+    b, s = x_shape[0], x_shape[1]
+    if b % shards:
+        raise ValueError(
+            "batch %d not divisible by the %d-way token sharding "
+            "(data x expert axes)" % (b, shards))
+    t_loc = (b // shards) * s
+    return t_loc, unit.capacity(t_loc)
+
+
+def _a2a(x, axis, split, concat):
+    from jax import lax
+    return lax.all_to_all(x, axis, split_axis=split,
+                          concat_axis=concat, tiled=True)
+
+
+def _fwd_local(x, router, w1, b1, w2, b2, *, axis, experts, cap,
+               activation, es):
+    """Per-device forward body (under shard_map). x: (B_loc, S, D);
+    w1/b1/w2/b2: the device's expert block (E/n, ...). The exchanged
+    xe/h/ye buffers come back with a leading length-1 data dim so
+    their GLOBAL cache shapes honestly carry the per-data-shard
+    content (they are NOT replicated along the data axis at DP>1)."""
+    import jax.numpy as jnp
+    from veles.znicz_tpu.ops import moe
+
+    b, s, d = x.shape
+    xt = x.reshape(-1, d)
+    probs, onehot_e, gate, dispatch = moe.route_tokens(
+        jnp, xt, router, experts, cap)
+    xe_send = es("tec,td->ecd", dispatch, xt)          # (E, C, D)
+    xe_recv = _a2a(xe_send, axis, 0, 1)                # (E/n, nC, D)
+    h, ye_recv = moe.experts_fwd(jnp, xe_recv, w1, b1, w2, b2,
+                                 activation, es)
+    ye_local = _a2a(ye_recv, axis, 1, 0)               # (E, C, D)
+    combine = dispatch * gate[:, None, None]
+    yt = es("tec,ecd->td", combine, ye_local)
+    y = yt.reshape(b, s, d)
+    # cache ye in LOCAL-token coordinates (backward only needs it for
+    # dgate, saving the third all_to_all a re-exchange would cost);
+    # xe/h stay in exchanged coordinates, which is how the expert-FFN
+    # backward consumes them
+    return (y, probs.reshape(b, s, experts),
+            onehot_e.reshape(b, s, experts), gate.reshape(b, s),
+            dispatch.reshape(b, s, experts, cap),
+            xe_recv[None], h[None], ye_local[None])
+
+
+def moe_a2a_fwd(x, params, unit, es):
+    """All-to-all forward for a :class:`ops.moe.MoEFFN` whose
+    ``ep_mesh`` is set. Returns (y, cache) like ``MoEFFN._forward``;
+    the xe/h cache entries live in EXCHANGED coordinates — global
+    (dp, E, n·C, ·) arrays sharded over the expert axis — which is
+    how the expert-FFN backward consumes them, while ye is cached in
+    local-token coordinates (see ``_fwd_local``)."""
+    mesh, axis, batch_axis, P = _specs(unit)
+    _, cap = _local_tokens(unit, x.shape)
+    tok = _token_axes(unit)
+
+    xspec = P(tok, None, None)
+    espec = lambda nd: P(*((axis,) + (None,) * (nd - 1)))
+    # exchanged-coordinate caches (xe, h): leading data dim +
+    # expert-sharded expert dim -> global (dp, E, nC, ·). ye is cached
+    # in local-token coordinates: per-token-shard content behind a
+    # leading length-1 dim -> global (dp·n, E, C, D)
+    cspec = P(batch_axis, axis, None, None)
+    yspec = P(tok, None, None, None)
+    fn = _shard_map(
+        mesh=mesh,
+        in_specs=(xspec, P(), espec(3), espec(2), espec(3), espec(2)),
+        out_specs=(xspec, xspec, xspec, P(tok, None),
+                   P(tok, None, None, None),
+                   cspec, cspec, yspec))(
+        functools.partial(_fwd_local, axis=axis, experts=unit.experts,
+                          cap=cap, activation=unit.ACTIVATION, es=es))
+    y, probs, onehot_e, gate, dispatch, xe, h, ye = fn(
+        x, params["router"], params["weights"], params["bias"],
+        params["weights2"], params["bias2"])
+    if unit.residual:
+        y = y + x
+    cache = {"probs": probs, "onehot_e": onehot_e, "gate": gate,
+             "dispatch": dispatch, "xe": xe, "h": h, "ye": ye}
+    return y, cache
+
+
+def _bwd_local(x, err, router, w1, b1, w2, b2, probs, onehot_e, gate,
+               dispatch, xe_recv, h, ye_local, aux_weight, *, axis,
+               batch_axis, tok_axes, n_shards, experts, cap,
+               activation, residual, es):
+    """Per-device backward body: mirror of ``GDMoEFFN._backward`` with
+    the two einsum contractions that crossed the expert dim replaced
+    by reverse all_to_all exchanges."""
+    import jax.numpy as jnp
+    from jax import lax
+    from veles.znicz_tpu.ops import activations as A
+
+    b, s, d = x.shape
+    xt = x.reshape(-1, d)
+    dyt = err.reshape(-1, d)
+    probs = probs.reshape(-1, experts)
+    onehot_e = onehot_e.reshape(-1, experts)
+    gate = gate.reshape(-1)
+    dispatch = dispatch.reshape(-1, experts, cap)
+    xe_recv, h, ye_local = xe_recv[0], h[0], ye_local[0]
+    combine = dispatch * gate[:, None, None]
+    # combine path: send each token's output-grad to its expert owner
+    dye_send = es("tec,td->ecd", combine, dyt)         # (E, C, D)
+    dye_recv = _a2a(dye_send, axis, 0, 1)              # (E/n, nC, D)
+    ysel = es("tec,ecd->td", dispatch, ye_local)
+    dgate = (ysel * dyt).sum(axis=-1)                  # (T,)
+    # expert FFN backward on the local expert block
+    dh = es("ecd,ehd->ech", dye_recv, w2)
+    dh = dh * A.ACTIVATIONS[activation][1](jnp, h)
+    gw2 = es("ech,ecd->ehd", h, dye_recv)
+    gb2 = dye_recv.sum(axis=1)
+    gw1 = es("ecd,ech->edh", xe_recv, dh)
+    gb1 = dh.sum(axis=1)
+    dxe_recv = es("ech,edh->ecd", dh, w1)
+    # input grads travel back to the tokens' home shards
+    dxe_local = _a2a(dxe_recv, axis, 1, 0)             # (E, C, D)
+    dxt = es("tec,ecd->td", dispatch, dxe_local)
+    # router backward — straight-through assignment, shared formula
+    # with the gather path; the aux term uses the GLOBAL routing
+    # frequency and token count (pmean over the token axes) so it is
+    # exactly the single-chip gradient, not a per-shard variant
+    dprobs = onehot_e * dgate[:, None]
+    n_tokens_g = onehot_e.shape[0] * n_shards
+    freq = lax.pmean(onehot_e.mean(axis=0), tok_axes)
+    dprobs = dprobs + (aux_weight * experts / n_tokens_g) \
+        * freq[None, :]
+    dlogits = probs * (dprobs
+                       - (dprobs * probs).sum(-1, keepdims=True))
+    grouter = xt.T @ dlogits
+    dxt = dxt + dlogits @ router.T
+    dx = dxt.reshape(b, s, d)
+    if residual:
+        dx = dx + err
+    # expert grads: each data shard holds partial sums for ALL its
+    # experts' tokens from that shard -> sum over the data axis (GSPMD
+    # inserts this all-reduce automatically in gather mode). Router
+    # grads are partial over EVERY token shard -> psum over all token
+    # axes.
+    if batch_axis is not None:
+        gw1, gb1, gw2, gb2 = (lax.psum(g, batch_axis)
+                              for g in (gw1, gb1, gw2, gb2))
+    grouter = lax.psum(grouter, tok_axes)
+    return dx, gw1, gb1, gw2, gb2, grouter
+
+
+def moe_a2a_bwd(x, err, params, cache, aux_weight, unit, es):
+    """All-to-all backward for :class:`ops.moe.GDMoEFFN`: returns
+    (dx, grads) with expert-dim grads sharded over the expert axis
+    (matching the parameter shardings) and router/dx replicated across
+    it."""
+    import jax.numpy as jnp
+    mesh, axis, batch_axis, P = _specs(unit)
+    _, cap = _local_tokens(unit, x.shape)
+    tok = _token_axes(unit)
+    n_shards = int(numpy.prod([mesh.shape[a] for a in tok]))
+
+    xspec = P(tok, None, None)
+    espec = lambda nd: P(*((axis,) + (None,) * (nd - 1)))
+    cspec = P(batch_axis, axis, None, None)
+    yspec = P(tok, None, None, None)
+    fn = _shard_map(
+        mesh=mesh,
+        in_specs=(xspec, xspec, P(), espec(3), espec(2), espec(3),
+                  espec(2), xspec, xspec, P(tok, None),
+                  P(tok, None, None, None), cspec, cspec,
+                  yspec, P()),
+        out_specs=(xspec, espec(3), espec(2), espec(3), espec(2),
+                   P()))(
+        functools.partial(_bwd_local, axis=axis, batch_axis=batch_axis,
+                          tok_axes=tok, n_shards=n_shards,
+                          experts=unit.experts, cap=cap,
+                          activation=unit.ACTIVATION,
+                          residual=unit.residual, es=es))
+    dx, gw1, gb1, gw2, gb2, grouter = fn(
+        x, err, params["router"], params["weights"], params["bias"],
+        params["weights2"], params["bias2"], cache["probs"],
+        cache["onehot_e"], cache["gate"], cache["dispatch"],
+        cache["xe"], cache["h"], cache["ye"],
+        jnp.asarray(aux_weight, jnp.float32))
+    return dx, {"weights": gw1, "bias": gb1, "weights2": gw2,
+                "bias2": gb2, "router": grouter}
