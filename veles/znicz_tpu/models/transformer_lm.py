@@ -53,8 +53,12 @@ root.lm.update({
     # token bandwidth, fine on small meshes) or "alltoall" (explicit
     # shard_map lax.all_to_all exchange, O(tokens) — the at-scale EP;
     # parallel/expert.py)
+    # schedule: pipeline schedule with pipe > 1 — "gpipe" (stash all
+    # microbatches) or "1f1b" (PipeDream-flush, min(M, P-s) stash +
+    # forward recompute; parallel/pipeline.py)
     "parallel": {"seq": 1, "model": 1, "data": 1, "expert": 1,
-                 "pipe": 1, "microbatches": 4, "ep_routing": "gather"},
+                 "pipe": 1, "microbatches": 4, "ep_routing": "gather",
+                 "schedule": "gpipe"},
 })
 
 
@@ -298,7 +302,8 @@ class TransformerLMWorkflow(StandardWorkflow):
                 self, mesh,
                 microbatches=int(spec.get("microbatches", 4)),
                 batch_axis="data" if data > 1 else None,
-                refresh=False)
+                refresh=False,
+                schedule=str(spec.get("schedule", "gpipe")))
         self.xla_step.refresh_device()
 
 

@@ -53,11 +53,17 @@ class TransformerBlockStack(Forward):
         for name in self.PARAMS[2:]:
             setattr(self, name, Array())
         #: set by parallel.setup_pipeline_parallel: a Mesh with a
-        #: 'pipe' axis routes fwd/bwd through the GPipe schedule
+        #: 'pipe' axis routes fwd/bwd through the pipeline schedule
+        #: named by pipe_schedule — "gpipe" (forward stashes all M
+        #: microbatch caches, backward replays them) or "1f1b"
+        #: (forward skips the stash; the GD unit reruns the fused
+        #: PipeDream-flush schedule, rematerializing forwards, peak
+        #: stash min(M, P-s) per stage)
         self.pipe_mesh = None
         self.pipe_axis = "pipe"
         self.pipe_batch_axis = None
         self.pipe_microbatches = 4
+        self.pipe_schedule = "gpipe"
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -124,7 +130,17 @@ class TransformerBlockStack(Forward):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
         p = ctx.unit_params(self)
-        if self.pipe_mesh is not None:
+        if self.pipe_mesh is not None and self.pipe_schedule == "1f1b":
+            # no stash: the GD unit reruns the fused 1F1B schedule
+            # and rematerializes its forwards there
+            y = PL.pipeline_fwd(
+                p, x, self.pipe_mesh, axis=self.pipe_axis,
+                batch_axis=self.pipe_batch_axis,
+                n_micro=self.pipe_microbatches, heads=self.heads,
+                causal=self.causal, eps=self.eps, dot=ctx.dot,
+                stash=False)
+            caches = ()
+        elif self.pipe_mesh is not None:
             y, caches = PL.pipeline_fwd(
                 p, x, self.pipe_mesh, axis=self.pipe_axis,
                 batch_axis=self.pipe_batch_axis,
@@ -175,7 +191,24 @@ class GDTransformerBlockStack(GradientDescentBase):
         err = ctx.get(self, "err_output").reshape(x.shape)
         p = ctx.unit_params(f)
         caches = ctx.get(f, "cache_stack")
-        if f.pipe_mesh is not None:
+        if f.pipe_mesh is not None and f.pipe_schedule == "1f1b":
+            # fused 1F1B (PipeDream-flush): rerun forwards interleaved
+            # with backwards per the static schedule. The loss gradient
+            # already exists (the evaluator computed it from the
+            # forward unit's output with full-batch normalization), so
+            # err_fn just hands each microbatch its slice — which is
+            # why no n_micro/dp rescale applies here, unlike the
+            # standalone pipeline_1f1b_step convention (its docstring).
+            def err_passthrough(y_mb, e_mb):
+                return e_mb.astype(jnp.float32), jnp.float32(0.0)
+
+            _y, dx, grads, _loss = PL.pipeline_1f1b_step(
+                p, x, err, err_passthrough, f.pipe_mesh,
+                axis=f.pipe_axis, batch_axis=f.pipe_batch_axis,
+                n_micro=f.pipe_microbatches, heads=f.heads,
+                causal=f.causal, eps=f.eps, dot=ctx.dot,
+                es=ctx.einsum)
+        elif f.pipe_mesh is not None:
             dx, grads = PL.pipeline_bwd(
                 p, caches, err, f.pipe_mesh, axis=f.pipe_axis,
                 batch_axis=f.pipe_batch_axis,

@@ -271,19 +271,32 @@ def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True,
 
 def setup_pipeline_parallel(workflow, mesh, axis="pipe",
                             microbatches=4, batch_axis=None,
-                            refresh=True):
+                            refresh=True, schedule="gpipe"):
     """Pipeline parallelism for :class:`TransformerBlockStack` units:
     the stacked layer dim of every parameter (and its momentum /
     accumulation state) is sharded over ``axis`` — each stage owns
     L/P consecutive blocks — and the unit's traced path switches to
-    the GPipe microbatch schedule (``parallel/pipeline.py``), where
+    the microbatch ``schedule`` (``parallel/pipeline.py``), where
     activations hop stages via ``ppermute`` and weights never move.
+
+    ``schedule``: ``"gpipe"`` (forward stashes all M microbatch
+    caches; backward replays them — peak stash M per stage) or
+    ``"1f1b"`` (PipeDream-flush: the forward unit skips the stash and
+    the GD unit reruns the fused interleaved schedule, rematerializing
+    forwards — peak stash min(M, P-s) caches at stage s, at the cost
+    of a second forward pass, the standard recompute trade). Both are
+    leaf-for-leaf parity-tested through the workflow
+    (tests/test_pipeline.py).
+
     ``batch_axis`` names the mesh axis the batch is sharded over when
     composing PP with DP on one mesh; ``microbatches`` must divide
     the (per-data-shard) minibatch size."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from veles.znicz_tpu.ops.transformer_stack import (
         TransformerBlockStack)
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError("schedule must be 'gpipe' or '1f1b', got %r"
+                         % (schedule,))
     step = workflow.xla_step
     if step is None:
         raise ValueError("workflow has no xla_step (numpy backend?)")
@@ -307,6 +320,7 @@ def setup_pipeline_parallel(workflow, mesh, axis="pipe",
         fwd.pipe_axis = axis
         fwd.pipe_batch_axis = batch_axis
         fwd.pipe_microbatches = int(microbatches)
+        fwd.pipe_schedule = schedule
         gd = workflow.gds[i] if i < len(workflow.gds) else None
         sh = NamedSharding(mesh, P(axis))
         for key in fwd.PARAMS:

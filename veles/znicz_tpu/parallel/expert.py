@@ -83,6 +83,33 @@ def _local_tokens(unit, x_shape):
     return t_loc, unit.capacity(t_loc)
 
 
+def _spec_set(unit):
+    """The ONE definition of every PartitionSpec both entry points
+    use (forward outputs must mirror backward inputs exactly — the
+    replication check is disabled, so a drifted copy would silently
+    mis-shard the cached activations):
+
+    * ``x``: token tensors (B, S, ·) — batch over the combined token
+      axes;
+    * ``e(nd)``: expert-sharded parameter leaves of rank nd;
+    * ``c``: exchanged-coordinate caches xe/h — leading data dim,
+      expert-sharded expert dim -> global (dp, E, nC, ·);
+    * ``y``: the ye cache in local-token coordinates — per-token-shard
+      content behind a leading length-1 dim -> global (dp·n, E, C, D).
+    """
+    _, axis, batch_axis, P = _specs(unit)
+    tok = _token_axes(unit)
+    return {
+        "x": P(tok, None, None),
+        "e": lambda nd: P(*((axis,) + (None,) * (nd - 1))),
+        "tok2": P(tok, None),
+        "tok4": P(tok, None, None, None),
+        "c": P(batch_axis, axis, None, None),
+        "y": P(tok, None, None, None),
+        "rep": P(),
+    }
+
+
 def _a2a(x, axis, split, concat):
     from jax import lax
     return lax.all_to_all(x, axis, split_axis=split,
@@ -130,22 +157,13 @@ def moe_a2a_fwd(x, params, unit, es):
     local-token coordinates (see ``_fwd_local``)."""
     mesh, axis, batch_axis, P = _specs(unit)
     _, cap = _local_tokens(unit, x.shape)
-    tok = _token_axes(unit)
-
-    xspec = P(tok, None, None)
-    espec = lambda nd: P(*((axis,) + (None,) * (nd - 1)))
-    # exchanged-coordinate caches (xe, h): leading data dim +
-    # expert-sharded expert dim -> global (dp, E, nC, ·). ye is cached
-    # in local-token coordinates: per-token-shard content behind a
-    # leading length-1 dim -> global (dp·n, E, C, D)
-    cspec = P(batch_axis, axis, None, None)
-    yspec = P(tok, None, None, None)
+    sp = _spec_set(unit)
     fn = _shard_map(
         mesh=mesh,
-        in_specs=(xspec, P(), espec(3), espec(2), espec(3), espec(2)),
-        out_specs=(xspec, xspec, xspec, P(tok, None),
-                   P(tok, None, None, None),
-                   cspec, cspec, yspec))(
+        in_specs=(sp["x"], sp["rep"], sp["e"](3), sp["e"](2),
+                  sp["e"](3), sp["e"](2)),
+        out_specs=(sp["x"], sp["x"], sp["x"], sp["tok2"], sp["tok4"],
+                   sp["c"], sp["c"], sp["y"]))(
         functools.partial(_fwd_local, axis=axis, experts=unit.experts,
                           cap=cap, activation=unit.ACTIVATION, es=es))
     y, probs, onehot_e, gate, dispatch, xe, h, ye = fn(
@@ -232,19 +250,15 @@ def moe_a2a_bwd(x, err, params, cache, aux_weight, unit, es):
     _, cap = _local_tokens(unit, x.shape)
     tok = _token_axes(unit)
     n_shards = int(numpy.prod([mesh.shape[a] for a in tok]))
-
-    xspec = P(tok, None, None)
-    espec = lambda nd: P(*((axis,) + (None,) * (nd - 1)))
-    cspec = P(batch_axis, axis, None, None)
-    yspec = P(tok, None, None, None)
+    sp = _spec_set(unit)
     fn = _shard_map(
         mesh=mesh,
-        in_specs=(xspec, xspec, P(), espec(3), espec(2), espec(3),
-                  espec(2), xspec, xspec, P(tok, None),
-                  P(tok, None, None, None), cspec, cspec,
-                  yspec, P()),
-        out_specs=(xspec, espec(3), espec(2), espec(3), espec(2),
-                   P()))(
+        in_specs=(sp["x"], sp["x"], sp["rep"], sp["e"](3), sp["e"](2),
+                  sp["e"](3), sp["e"](2), sp["x"], sp["x"],
+                  sp["tok2"], sp["tok4"], sp["c"], sp["c"],
+                  sp["y"], sp["rep"]),
+        out_specs=(sp["x"], sp["e"](3), sp["e"](2), sp["e"](3),
+                   sp["e"](2), sp["rep"]))(
         functools.partial(_bwd_local, axis=axis, batch_axis=batch_axis,
                           tok_axes=tok, n_shards=n_shards,
                           experts=unit.experts, cap=cap,

@@ -164,10 +164,13 @@ def _chunk_fwd(params, xin, heads, causal, eps, dot=None):
 
 
 def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
-                        heads, causal, eps, dot=None):
+                        heads, causal, eps, dot=None, stash=True):
     """Per-device GPipe forward. ``params`` leaves (L/P, ...), x_loc
     (b, S, D) with b the data-local batch. Returns (y_loc, caches)
-    with cache leaves (M, L/P, b/M, ...)."""
+    with cache leaves (M, L/P, b/M, ...); with ``stash=False`` the
+    activation stash is never allocated (1F1B mode rematerializes
+    forwards inside the fused backward schedule) and y_loc alone is
+    returned."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -183,7 +186,7 @@ def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
         run, jax.ShapeDtypeStruct((bm, s, d), jnp.float32))
     caches0 = jax.tree_util.tree_map(
         lambda sd: jnp.zeros((n_micro,) + sd.shape, sd.dtype),
-        cache_shape)
+        cache_shape) if stash else None
     perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
     def step(carry, t):
@@ -195,11 +198,13 @@ def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
         m = t - stage                     # this stage's microbatch
         valid = (m >= 0) & (m < n_micro)
         mc = jnp.clip(m, 0, n_micro - 1)
-        caches = jax.tree_util.tree_map(
-            lambda buf, c: jnp.where(
-                valid, lax.dynamic_update_index_in_dim(buf, c, mc, 0),
-                buf),
-            caches, cache)
+        if stash:
+            caches = jax.tree_util.tree_map(
+                lambda buf, c: jnp.where(
+                    valid,
+                    lax.dynamic_update_index_in_dim(buf, c, mc, 0),
+                    buf),
+                caches, cache)
         outs = jnp.where(
             valid & (stage == n_stage - 1),
             lax.dynamic_update_index_in_dim(outs, y, mc, 0), outs)
@@ -212,7 +217,8 @@ def _pipeline_fwd_local(params, x_loc, *, axis_name, n_stage, n_micro,
         step, carry0, jnp.arange(n_micro + n_stage - 1))
     out = lax.psum(jnp.where(stage == n_stage - 1, outs, 0.0),
                    axis_name)
-    return out.reshape(b, s, d), caches
+    out = out.reshape(b, s, d)
+    return (out, caches) if stash else out
 
 
 def _pipeline_bwd_local(params, caches, err_loc, *, axis_name,
@@ -284,9 +290,12 @@ def _cache_specs(caches, axis, batch_axis):
 
 def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
                  n_micro=4, heads=4, causal=True, eps=1e-5,
-                 dot=None):
+                 dot=None, stash=True):
     """GPipe forward over ``mesh[axis]``. ``params`` leaves (L, ...)
-    sharded on dim 0; x (B, S, D) global. Returns (y, caches)."""
+    sharded on dim 0; x (B, S, D) global. Returns (y, caches), or y
+    alone with ``stash=False`` (the 1F1B workflow mode — the fused
+    backward schedule rematerializes its own forwards, so stashing
+    here would defeat 1F1B's min(M, P-s) memory bound)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -299,7 +308,11 @@ def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
     fn = functools.partial(
         _pipeline_fwd_local, axis_name=axis, n_stage=n_stage,
         n_micro=n_micro, heads=heads, causal=causal, eps=eps,
-        dot=dot)
+        dot=dot, stash=stash)
+    sm = _shard_map()
+    if not stash:
+        return sm(fn, mesh=mesh, in_specs=(pspec, xspec),
+                  out_specs=xspec)(params, x)
     # shapes of the stash, for out_specs: one chunk's caches (the
     # chunk itself is axis-free, so eval_shape is safe) + the
     # microbatch dim in front
@@ -315,11 +328,9 @@ def pipeline_fwd(params, x, mesh, axis="pipe", batch_axis=None,
     cache_shape = jax.tree_util.tree_map(
         lambda sd: jax.ShapeDtypeStruct((n_micro,) + sd.shape,
                                         sd.dtype), chunk_cache)
-    sm = _shard_map()
-    out = sm(fn, mesh=mesh, in_specs=(pspec, xspec),
-             out_specs=(xspec, _cache_specs(cache_shape, axis,
-                                            batch_axis)))(params, x)
-    return out
+    return sm(fn, mesh=mesh, in_specs=(pspec, xspec),
+              out_specs=(xspec, _cache_specs(cache_shape, axis,
+                                             batch_axis)))(params, x)
 
 
 def pipeline_bwd(params, caches, err, mesh, axis="pipe",
