@@ -72,8 +72,16 @@ def dense_attention(q, k, v, causal):
     return p @ v
 
 
+#: inner-block kernels for the ring steps: None = fused dense block,
+#: "scan" = flash.py blocked formulation, "pallas" = the TPU kernels
+#: (interpret mode on the CPU mesh)
+RING_INNERS = [None, "scan", "pallas"]
+
+
+@pytest.mark.parametrize("inner", RING_INNERS,
+                         ids=["dense", "scan", "pallas"])
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_attention_matches_dense(causal):
+def test_ring_attention_matches_dense(causal, inner):
     import jax
     import jax.numpy as jnp
     from veles.znicz_tpu import parallel
@@ -85,15 +93,18 @@ def test_ring_attention_matches_dense(causal):
     q = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
     k = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
     v = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
-    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal)
+    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal,
+                                        inner=inner, block=2)
     ref = dense_attention(q, k, v, causal)
     assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
                           atol=2e-5), \
         numpy.abs(numpy.asarray(out) - numpy.asarray(ref)).max()
 
 
+@pytest.mark.parametrize("inner", RING_INNERS,
+                         ids=["dense", "scan", "pallas"])
 @pytest.mark.parametrize("causal", [True, False])
-def test_ring_attention_backward_matches_jax_grad(causal):
+def test_ring_attention_backward_matches_jax_grad(causal, inner):
     import jax
     import jax.numpy as jnp
     from veles.znicz_tpu import parallel
@@ -107,9 +118,11 @@ def test_ring_attention_backward_matches_jax_grad(causal):
     v = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
     dout = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
 
-    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal)
+    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal,
+                                        inner=inner, block=2)
     dq, dk, dv = ring.ring_self_attention_bwd(
-        q, k, v, out, lse, dout, mesh, causal=causal)
+        q, k, v, out, lse, dout, mesh, causal=causal, inner=inner,
+        block=2)
 
     def loss(q, k, v):
         return jnp.sum(jnp.asarray(dout)

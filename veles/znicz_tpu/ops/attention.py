@@ -430,7 +430,7 @@ class MultiHeadAttention(Forward):
         mode = self._traced_mode(ctx, x.shape[1])
         names = ("q", "k", "v", "out_heads", "lse", "merged")
         if mode == "ring":
-            y, cache = self._fwd_ring(jnp, x, p, ctx.dot)
+            y, cache = self._fwd_ring(jnp, x, p, ctx, ctx.dot)
         elif mode == "pallas":
             y, cache = self._fwd_pallas(
                 jnp, x, p, ctx.dot,
@@ -482,17 +482,20 @@ class MultiHeadAttention(Forward):
         y = self._finish(x, merged, p, dot)
         return y, (q, k, v, out_heads, lse, merged)
 
-    def _pallas_block(self):
-        """Kernel block size: attn_block_size, or the largest
-        power-of-two divisor of S up to 128 (so attn_impl='pallas'
-        works without attn_block_size for any even S)."""
-        s = self.input.shape[1]
+    def _pallas_block(self, s=None):
+        """Kernel block size for a sequence of length ``s`` (default:
+        the unit's full sequence; the ring path passes its per-shard
+        length): attn_block_size — which must divide, same loud error
+        in every mode — or the largest power-of-two divisor up to 128
+        (so the flash kernels work without attn_block_size for any
+        even S)."""
+        if s is None:
+            s = self.input.shape[1]
         if self.attn_block_size:
             if s % self.attn_block_size:
                 raise ValueError(
                     "%s: attn_block_size %d does not divide sequence "
-                    "length %d (attn_impl='pallas')"
-                    % (self.name, self.attn_block_size, s))
+                    "length %d" % (self.name, self.attn_block_size, s))
             return self.attn_block_size
         return max(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
                    if s % b == 0)
@@ -513,14 +516,46 @@ class MultiHeadAttention(Forward):
         y = self._finish(x, merged, p, dot)
         return y, (q, k, v, out_heads, lse, merged)
 
-    def _fwd_ring(self, xp, x, p, dot):
+    def _ring_inner(self, ctx):
+        """(inner, block) for the ring path — which kernel each ring
+        step's LOCAL block runs (round-4 composition of the measured
+        single-chip flash wins with cross-chip SP). Shared by forward
+        and backward (the cache layout is the same either way, but
+        the traced programs must agree). Policy mirrors
+        ``_traced_mode``: explicit ``attn_impl`` wins; auto takes the
+        Pallas kernels on a real TPU once the PER-SHARD sequence
+        reaches PALLAS_AUTO_MIN_S; a set ``attn_block_size`` routes
+        the local block through the scan flash; otherwise the fused
+        dense block (the short-shard default)."""
+        s_loc = self.input.shape[1] // self.seq_mesh.shape[self.seq_axis]
+        if self.attn_impl == "pallas":
+            inner = "pallas"
+        elif self.attn_impl == "scan":
+            inner = "scan"
+        elif self.attn_impl is None \
+                and s_loc >= self.PALLAS_AUTO_MIN_S \
+                and ctx._compiler.device.platform in ("tpu", "axon"):
+            inner = "pallas"
+        elif self.attn_block_size:
+            inner = "scan"
+        else:
+            return None, None
+        return inner, self._pallas_block(s_loc)
+
+    def _fwd_ring(self, xp, x, p, ctx, dot):
         """Sequence-parallel forward: qkv projection under
-        auto-sharding, attention proper via the ppermute ring."""
+        auto-sharding, attention proper via the ppermute ring (each
+        step's local block optionally through the flash kernels)."""
         from veles.znicz_tpu.parallel import ring
+        inner, block = self._ring_inner(ctx)
         q, k, v = self._project_qkv(x, p, dot)
+        if inner is not None:
+            cd = ctx._compiler.device.compute_dtype
+            q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
         out_heads, lse = ring.ring_self_attention(
             q, k, v, self.seq_mesh, axis=self.seq_axis,
-            causal=self.causal, batch_axis=self.seq_batch_axis)
+            causal=self.causal, batch_axis=self.seq_batch_axis,
+            inner=inner, block=block, dot=dot)
         merged = self._merge(out_heads)
         y = self._finish(x, merged, p, dot)
         return y, (q, k, v, out_heads, lse, merged)
@@ -598,14 +633,21 @@ class GDMultiHeadAttention(GradientDescentBase):
 
     def _bwd_ring(self, xp, x, p, ctx, err):
         """Sequence-parallel backward via the ring (dk/dv circulate a
-        full circle back to their home shards)."""
+        full circle back to their home shards); the inner-block kernel
+        resolves identically to the forward's."""
         from veles.znicz_tpu.parallel import ring
         f = self.forward
+        inner, block = f._ring_inner(ctx)
+        cd = ctx._compiler.device.compute_dtype
+        cast = (lambda t: t.astype(cd)) if inner is not None \
+            else (lambda t: t)
         return self._bwd_outer(
             xp, x, p, ctx, err,
             lambda q, k, v, o, lse, dctx: ring.ring_self_attention_bwd(
-                q, k, v, o, lse, dctx, f.seq_mesh, axis=f.seq_axis,
-                causal=f.causal, batch_axis=f.seq_batch_axis))
+                q, k, v, o, lse, cast(dctx), f.seq_mesh,
+                axis=f.seq_axis, causal=f.causal,
+                batch_axis=f.seq_batch_axis, inner=inner, block=block,
+                dot=ctx.dot))
 
     def _bwd_blocked(self, xp, x, p, ctx, err):
         """Single-chip flash-style backward (block recomputation)."""
