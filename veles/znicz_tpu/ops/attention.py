@@ -540,7 +540,16 @@ class MultiHeadAttention(Forward):
             inner = "scan"
         else:
             return None, None
-        return inner, self._pallas_block(s_loc)
+        # block size: attn_block_size when it divides the SHARD length,
+        # else the largest power-of-two divisor — NOT the single-chip
+        # loud error: attn_block_size is tuned against the global S,
+        # and the per-shard length is a deployment detail (the same
+        # config must run at seq=1 and seq=8), so a non-dividing value
+        # degrades to the nearest workable tile instead of crashing
+        if self.attn_block_size and s_loc % self.attn_block_size == 0:
+            return inner, self.attn_block_size
+        return inner, max(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
+                          if s_loc % b == 0)
 
     def _fwd_ring(self, xp, x, p, ctx, dot):
         """Sequence-parallel forward: qkv projection under

@@ -72,11 +72,13 @@ def blocked_attention_fwd(q, k, v, causal=True, block=128, dot=None):
 
 
 def blocked_attention_bwd(q, k, v, out, lse, dout, causal=True,
-                          block=128, dot=None):
+                          block=128, dot=None, delta=None):
     """Backward by block recomputation from ``lse``; -> (dq, dk, dv),
     all exact (same formulas as the dense adjoint). The ds / p tiles
     are cast to the compute dtype before their three matmuls (same
-    bandwidth argument as forward)."""
+    bandwidth argument as forward). ``delta``: optional precomputed
+    ``rowsum(dout*out)`` (B, H, S) f32 — the ring's per-step inner
+    backward hoists it across steps."""
     import jax.numpy as jnp
     from jax import lax
     dot = dot or jnp.matmul
@@ -88,8 +90,9 @@ def blocked_attention_bwd(q, k, v, out, lse, dout, causal=True,
     n = s // block
     scale = numpy.float32(1.0 / numpy.sqrt(dh))
     qpos = jnp.arange(s)
-    delta = (dout.astype(jnp.float32)
-             * out.astype(jnp.float32)).sum(axis=-1)      # (B,H,S)
+    if delta is None:
+        delta = (dout.astype(jnp.float32)
+                 * out.astype(jnp.float32)).sum(axis=-1)  # (B,H,S)
     kb = jnp.moveaxis(k.reshape(b, h, n, block, dh), 2, 0)
     vb = jnp.moveaxis(v.reshape(b, h, n, block, dh), 2, 0)
 

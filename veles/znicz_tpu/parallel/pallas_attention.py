@@ -241,8 +241,13 @@ def flash_attention_fwd(q, k, v, causal=True, block_q=128,
 
 
 def flash_attention_bwd(q, k, v, out, lse, dout, causal=True,
-                        block_q=128, block_k=128, interpret=None):
-    """Block-recomputation backward → (dq, dk, dv), exact."""
+                        block_q=128, block_k=128, interpret=None,
+                        delta=None):
+    """Block-recomputation backward → (dq, dk, dv), exact. ``delta``:
+    optional precomputed ``rowsum(dout*out)`` (B, H, S) f32 — callers
+    that invoke this kernel repeatedly on the same out/dout (the ring's
+    per-step inner backward) hoist it to avoid re-reading both tensors
+    from HBM every call."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -260,8 +265,12 @@ def flash_attention_bwd(q, k, v, out, lse, dout, causal=True,
     qf, kf, vf, dof = (t.reshape(flat) for t in (q, k, v, dout))
     lsef = lse.reshape(b * h, s, 1)
     lse_lanes = lse.reshape(b * h, 1, s)
-    delta_rows = (dout.astype(jnp.float32)
-                  * out.astype(jnp.float32)).sum(axis=-1)
+    if delta is None:
+        delta_rows = (dout.astype(jnp.float32)
+                      * out.astype(jnp.float32)).sum(axis=-1)
+    else:
+        delta_rows = delta
+    delta_rows = delta_rows.astype(jnp.float32)
     delta = delta_rows.reshape(b * h, s, 1)
     delta_lanes = delta_rows.reshape(b * h, 1, s)
     qblocked, qfull, qvec, qfull_vec = _specs(block_q, s, dh)

@@ -118,10 +118,11 @@ def _inner_kernels(inner, block, dot=None):
             return PA.flash_attention_fwd(q, k, v, causal=causal,
                                           block_q=block, block_k=block)
 
-        def bwd(q, k, v, out, lse, dout, causal):
+        def bwd(q, k, v, out, lse, dout, causal, delta=None):
             return PA.flash_attention_bwd(q, k, v, out, lse, dout,
                                           causal=causal,
-                                          block_q=block, block_k=block)
+                                          block_q=block, block_k=block,
+                                          delta=delta)
     elif inner == "scan":
         from veles.znicz_tpu.parallel import flash
 
@@ -129,10 +130,11 @@ def _inner_kernels(inner, block, dot=None):
             return flash.blocked_attention_fwd(q, k, v, causal=causal,
                                                block=block, dot=dot)
 
-        def bwd(q, k, v, out, lse, dout, causal):
+        def bwd(q, k, v, out, lse, dout, causal, delta=None):
             return flash.blocked_attention_bwd(q, k, v, out, lse, dout,
                                                causal=causal,
-                                               block=block, dot=dot)
+                                               block=block, dot=dot,
+                                               delta=delta)
     else:
         raise ValueError("inner must be 'pallas' or 'scan', got %r"
                          % (inner,))
@@ -211,6 +213,11 @@ def ring_attention_bwd_flash(q, k, v, out, lse, dout, axis_name,
     _, kern_bwd = _inner_kernels(inner, block, dot)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    # delta is a property of (out, dout) alone — hoist the
+    # rowsum(dout*out) out of the per-step kernel calls so the ring
+    # does not re-read both tensors from HBM n_dev times
+    delta = (dout.astype(jnp.float32)
+             * out.astype(jnp.float32)).sum(axis=-1)
 
     def body(step, carry):
         k_cur, v_cur, dk_cur, dv_cur, dq = carry
@@ -219,8 +226,10 @@ def ring_attention_bwd_flash(q, k, v, out, lse, dout, axis_name,
                            jnp.zeros_like(v_cur))
         dq_b, dk_b, dv_b = _ring_branches(
             causal, src, my,
-            lambda _: kern_bwd(q, k_cur, v_cur, out, lse, dout, True),
-            lambda _: kern_bwd(q, k_cur, v_cur, out, lse, dout, False),
+            lambda _: kern_bwd(q, k_cur, v_cur, out, lse, dout, True,
+                               delta),
+            lambda _: kern_bwd(q, k_cur, v_cur, out, lse, dout, False,
+                               delta),
             zeros)
         dq = dq + dq_b.astype(jnp.float32)
         dk_cur = dk_cur + dk_b.astype(jnp.float32)
