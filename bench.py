@@ -224,6 +224,31 @@ def lm_scale_tokens_per_sec(measure_chunks=3):
         "BenchLMScale", 4, measure_chunks)
 
 
+def lm_base_tokens_per_sec(measure_chunks=3):
+    """Transformer-BASE LM throughput (canonical 12-layer config:
+    dim 768, 12 heads, ffn 3072, vocab 16384 -> ~110M params with the
+    embedding + output head; SURVEY §2.8 "Transformer-base LM" /
+    VERDICT r3 weak #5 — the 8-layer 57M flagship under-read it).
+    S=512, batch/attn_block from the round-4 v5e sweep."""
+    return _lm_throughput(
+        {"minibatch_size": 8, "n_train": 512, "n_valid": 32,
+         "seq_len": 512, "vocab": 16384, "max_period": 8},
+        {"dim": 768, "heads": 12, "layers": 12, "ffn_hidden": 3072,
+         "attn_block": 256},
+        "BenchLMBase", 4, measure_chunks)
+
+
+def lm_base_s8k_tokens_per_sec(measure_chunks=3):
+    """The 110M transformer-base at S=8192 (long-context row, auto
+    impl policy — Pallas flash takes over at this length)."""
+    return _lm_throughput(
+        {"minibatch_size": 2, "n_train": 16, "n_valid": 2,
+         "seq_len": 8192, "vocab": 16384, "max_period": 8},
+        {"dim": 768, "heads": 12, "layers": 12, "ffn_hidden": 3072,
+         "attn_block": 256},
+        "BenchLMBaseLong", 1, measure_chunks)
+
+
 def lm_longctx_tokens_per_sec(measure_chunks=3):
     """57.5M-param LM at S=8192 (long-context row): blocked attention
     with the AUTO impl policy — the Pallas flash kernels take over at
@@ -270,6 +295,9 @@ def main():
     _record(extra, "lm_57M_tokens_per_sec", lm_scale_tokens_per_sec)
     _record(extra, "lm_57M_s8k_tokens_per_sec",
             lm_longctx_tokens_per_sec)
+    _record(extra, "lm_110M_tokens_per_sec", lm_base_tokens_per_sec)
+    _record(extra, "lm_110M_s8k_tokens_per_sec",
+            lm_base_s8k_tokens_per_sec)
     # which data fed each number: real on-disk datasets or the
     # synthetic stand-ins (zero-egress environments have no choice,
     # but the record keeps every figure honest — VERDICT r2 item 4)

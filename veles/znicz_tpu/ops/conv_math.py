@@ -97,3 +97,72 @@ def sliding_channel_sum(xp, x, window, reverse=False):
     zero = xp.zeros_like(csum[..., :1])
     csum = xp.concatenate([zero, csum], axis=-1)
     return csum[..., window:window + n] - csum[..., :n]
+
+
+# -- space-to-depth packing for low-channel strided convs --------------
+#
+# A strided conv over very few input channels (AlexNet conv1: 11x11/s4
+# over RGB) starves the MXU: each (ky,kx) tap contracts only C of the
+# 128 lanes. With equal strides s, packing s x s spatial blocks into
+# the channel dim turns it into a stride-1 conv over s*s*C channels
+# with ceil(k/s) taps. Exact: the repacked weights carry zero taps
+# where the padded kernel exceeds the original extent, and block-coord
+# extras are sliced off. Measured on a v5e (B=128 AlexNet conv1): the
+# transform wins for the WEIGHT-GRAD conv (18 -> 12.4 ms including the
+# input repack) but LOSES for the forward (10.2 -> 20.9 ms: the
+# repack relayout costs more than the MXU efficiency returns there),
+# so only gd_conv.py uses it.
+
+
+def s2d_block(ky, kx, sliding, c):
+    """The space-to-depth block size (== stride) when the transform is
+    profitable, else 0: equal strides > 1, packed channels still
+    within one 128-lane tile, kernel wider than the stride."""
+    sy, sx = sliding
+    if sy != sx or sy <= 1 or c * sy * sy > 128:
+        return 0
+    if ky <= sy and kx <= sy:
+        return 0
+    return sy
+
+
+def s2d_pack_input(xp, x, s, padding):
+    """Explicitly apply ``padding`` (+ round H/W up to multiples of s
+    with zeros) and pack s x s blocks: (B,H,W,C) -> (B,H',W',s*s*C)
+    with channel order (block_row, block_col, C)."""
+    top, bottom, left, right = padding
+    b, h, w, c = x.shape
+    pb = (-(h + top + bottom)) % s
+    pr = (-(w + left + right)) % s
+    x = xp.pad(x, ((0, 0), (top, bottom + pb),
+                   (left, right + pr), (0, 0)))
+    hp, wp = x.shape[1] // s, x.shape[2] // s
+    return (x.reshape(b, hp, s, wp, s, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(b, hp, wp, s * s * c))
+
+
+def s2d_pack_weights(xp, w, n_kernels, ky, kx, c, s):
+    """Flat (K, ky*kx*C) weights -> block-coord HWIO
+    (ceil(ky/s), ceil(kx/s), s*s*C, K) with zero-padded taps; channel
+    order matches :func:`s2d_pack_input`."""
+    w4 = w.reshape(n_kernels, ky, kx, c)
+    w4 = xp.pad(w4, ((0, 0), (0, (-ky) % s), (0, (-kx) % s), (0, 0)))
+    kyb, kxb = w4.shape[1] // s, w4.shape[2] // s
+    w6 = w4.reshape(n_kernels, kyb, s, kxb, s, c)
+    return (w6.transpose(1, 3, 2, 4, 5, 0)
+            .reshape(kyb, kxb, s * s * c, n_kernels)), kyb, kxb
+
+
+def s2d_unpack_wgrad(xp, gw, n_kernels, ky, kx, c, s):
+    """Inverse of :func:`s2d_pack_weights` for a weight-grad conv
+    result (s*s*C, KYB', KXB', K): slice the block-coord extras, undo
+    the packing, slice the zero taps -> flat (K, ky*kx*C)."""
+    kyb = (ky + (-ky) % s) // s
+    kxb = (kx + (-kx) % s) // s
+    gw = gw[:, :kyb, :kxb, :]
+    gw = (gw.transpose(3, 1, 2, 0)
+          .reshape(n_kernels, kyb, kxb, s, s, c)
+          .transpose(0, 1, 3, 2, 4, 5)
+          .reshape(n_kernels, kyb * s, kxb * s, c))
+    return gw[:, :ky, :kx, :].reshape(n_kernels, ky * kx * c)

@@ -92,20 +92,41 @@ class GDConvBase(GradientDescentBase):
         # materialization (the round-2 "im2col fast path" special case
         # was an artifact of async-dispatch timing — block_until_ready
         # does not block through the dev tunnel).
-        gw = jax.lax.conv_general_dilated(
-            x.transpose(3, 1, 2, 0).astype(cd),   # C,H,W,B "NHWC"
-            dz.transpose(1, 2, 0, 3).astype(cd),  # oy,ox,B,K "HWIO"
-            window_strides=(1, 1),
-            padding=((top, bottom - ry), (left, right - rx)),
-            rhs_dilation=(sy, sx),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)   # -> (C,ky,kx,K)
-        grad_w = gw.transpose(3, 1, 2, 0) \
-            .reshape(f.n_kernels, f.ky * f.kx * c)
-        # bias grad as an MXU matvec (ones @ dz2) with f32 accumulate:
-        # measured 1.6x over a plain .sum on v5e — the (B,oy,ox)
-        # reduction maps badly onto the VPU lanes, the MXU reduction
-        # doesn't — and the result is bitwise identical
+        s2d = CM.s2d_block(f.ky, f.kx, f.sliding, c)
+        if s2d:
+            # space-to-depth transform (conv_math.py): the weight-grad
+            # conv contracts over batch+space with the packed s*s*C
+            # channels feeding the MXU lanes (18 -> 12.4 ms for
+            # AlexNet conv1 on a v5e; the forward measured SLOWER
+            # under the same transform and keeps the plain conv)
+            xs = CM.s2d_pack_input(jnp, x, s2d, self.padding_)
+            gw = jax.lax.conv_general_dilated(
+                xs.transpose(3, 1, 2, 0).astype(cd),  # C',H',W',B
+                dz.transpose(1, 2, 0, 3).astype(cd),  # oy,ox,B,K
+                window_strides=(1, 1), padding=((0, 0), (0, 0)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32)  # (C',kyb',kxb',K)
+            grad_w = CM.s2d_unpack_wgrad(
+                jnp, gw, f.n_kernels, f.ky, f.kx, c, s2d)
+        else:
+            gw = jax.lax.conv_general_dilated(
+                x.transpose(3, 1, 2, 0).astype(cd),   # C,H,W,B "NHWC"
+                dz.transpose(1, 2, 0, 3).astype(cd),  # oy,ox,B,K "HWIO"
+                window_strides=(1, 1),
+                padding=((top, bottom - ry), (left, right - rx)),
+                rhs_dilation=(sy, sx),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32)   # -> (C,ky,kx,K)
+            grad_w = gw.transpose(3, 1, 2, 0) \
+                .reshape(f.n_kernels, f.ky * f.kx * c)
+        # bias grad as an MXU matvec (ones @ dz2) with f32 accumulate.
+        # Round-4 trace: its fusion with the activation-derivative
+        # mask runs at ~11 GB/s effective — pathological — but every
+        # measured alternative was WORSE end-to-end on the v5e:
+        # optimization_barrier on dz 8877, barrier on the 2D reshape
+        # 7950, bias grad as a ones-input-channel inside the wgrad
+        # conv 8926 (the concat copies the input per conv), vs 9060
+        # img/s for this form. The reduction is XLA's to win.
         if self.include_bias:
             dz2 = dz.reshape(-1, f.n_kernels)
             ones = jnp.ones((1, dz2.shape[0]), dz2.dtype)

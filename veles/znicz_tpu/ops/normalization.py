@@ -43,10 +43,20 @@ class LRNormalizerForward(Forward):
             self.output.reset(
                 numpy.zeros(self.input.shape, numpy.float32))
 
+    def _dpow(self, xp, d):
+        """``d ** (-beta)`` — with the AlexNet default beta=0.75
+        rewritten as ``1/sqrt(d*sqrt(d))``: two sqrts and a multiply
+        on the VPU instead of a transcendental pow (exp+log) chain
+        over the largest activations in the net. Same value up to
+        rounding; shared by both backends so the oracle tracks."""
+        if self.beta == 0.75:
+            return 1.0 / xp.sqrt(d * xp.sqrt(d))
+        return d ** (-self.beta)
+
     def _forward(self, xp, x):
         d = self.k + self.alpha * CM.sliding_channel_sum(
             xp, x * x, self.n)
-        return x * d ** (-self.beta), d
+        return x * self._dpow(xp, d), d
 
     def numpy_run(self):
         x = self.input.map_read().mem.astype(numpy.float32)
@@ -73,7 +83,7 @@ class LRNormalizerBackward(GradientDescentBase):
     def _backward(self, xp, x, err):
         f = self.forward
         d = f.k + f.alpha * CM.sliding_channel_sum(xp, x * x, f.n)
-        dpow = d ** (-f.beta)
+        dpow = f._dpow(xp, d)
         inner = err * x * dpow / d
         spread = CM.sliding_channel_sum(xp, inner, f.n, reverse=True)
         return err * dpow - 2.0 * f.alpha * f.beta * x * spread
