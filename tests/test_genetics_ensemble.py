@@ -256,3 +256,137 @@ def test_cli_optimize_parallel_smoke(tmp_path):
     assert numpy.isfinite(doc["best_fitness"])
     assert doc["evaluations"] >= 4
     assert doc["workers"] == 2
+
+
+# -- GA over slaves (SURVEY §2.7 "runs distributed over slaves") ------
+
+def _quad_fitness(values):
+    """Picklable deterministic fitness for the slave-dispatch tests."""
+    return (values["a/lr"] - 0.37) ** 2
+
+
+def test_ga_over_slaves_matches_sequential():
+    """One GA search dispatched over TWO in-process slaves through the
+    HMAC-framed task server equals the sequential run bit-for-bit
+    (every individual carries its own deterministic evaluation), and
+    the topology records both slaves serving."""
+    import threading
+    from veles.config import Tune
+    from veles.genetics import (
+        GATaskServer, GeneticOptimizer, ga_slave_loop)
+
+    tun = {"a/lr": Tune(0.1, 0.01, 1.0)}
+    seq = GeneticOptimizer(_quad_fitness, dict(tun), generations=3,
+                           population_size=6, seed=11)
+    seq.run()
+
+    with GATaskServer("127.0.0.1:0") as server:
+        addr = "127.0.0.1:%d" % server.bound_address[1]
+        threads = [threading.Thread(
+            target=ga_slave_loop, args=(addr,),
+            kwargs={"name": "slave%d" % i}, daemon=True)
+            for i in range(2)]
+        for t in threads:
+            t.start()
+        par = GeneticOptimizer(_quad_fitness, dict(tun), generations=3,
+                               population_size=6, seed=11,
+                               map_fn=server)
+        par.run()
+        status = server.status()
+    for t in threads:
+        t.join(timeout=5)
+    assert par.best_fitness == seq.best_fitness
+    assert par.best_values == seq.best_values
+    assert [f for f, _ in par.history] == [f for f, _ in seq.history]
+    assert status["n_slaves"] >= 1
+
+
+def test_ga_requeue_protocol_level():
+    """The drop->requeue contract, exercised DIRECTLY: a slave takes a
+    task and dies before reporting — drop_slave must put exactly that
+    task back at the head of the pending pool, and a completed task
+    must NOT requeue on a later drop of the same slave."""
+    import threading
+    from veles.genetics import GATaskServer, _SafeEval
+
+    with GATaskServer("127.0.0.1:0") as server:
+        # two registered slaves, three tasks
+        sid_a = server._handle(("hello", "a"))[1]
+        sid_b = server._handle(("hello", "b"))[1]
+        fn = _SafeEval(_quad_fitness)
+        done = {}
+        t = threading.Thread(
+            target=lambda: done.update(
+                out=server.map(fn, [{"a/lr": v}
+                                    for v in (0.1, 0.2, 0.3)])),
+            daemon=True)
+        t.start()
+        import time
+        for _ in range(100):
+            if server.queue or server.tasks:
+                break
+            time.sleep(0.01)
+        kind, idx_a, fn_a, vals_a = server._handle(("task", sid_a))
+        assert kind == "task"
+        # slave A dies holding idx_a: it must return to the pool head
+        server.drop_slave(sid_a)
+        assert server.queue[0] == idx_a
+        assert sid_a not in server.inflight
+        # slave B drains everything (including the requeued task)
+        while len(server.results) < 3:
+            resp = server._handle(("task", sid_b))
+            if resp[0] != "task":
+                time.sleep(0.01)
+                continue
+            _, idx, fn_b, vals = resp
+            server._handle(("result", sid_b, idx, fn_b(vals)))
+        # completed tasks must not resurrect when B later drops
+        server.drop_slave(sid_b)
+        assert not server.queue or all(
+            i not in server.results for i in server.queue)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert [r[0] for r in done["out"]] == [
+            pytest.approx((v - 0.37) ** 2) for v in (0.1, 0.2, 0.3)]
+
+
+def test_ga_slave_churn_late_join_elasticity():
+    """Slave churn over the real sockets: a short-lived slave serves
+    one task and leaves cleanly; a slave joining MID-GENERATION picks
+    up the rest and the search completes. (The die-while-HOLDING-a-
+    task requeue path is covered at protocol level by
+    test_ga_requeue_protocol_level — a clean exit after the result
+    ack leaves nothing in flight to requeue.)"""
+    import threading
+    import time
+    from veles.config import Tune
+    from veles.genetics import (
+        GATaskServer, GeneticOptimizer, ga_slave_loop)
+
+    tun = {"a/lr": Tune(0.1, 0.01, 1.0)}
+    with GATaskServer("127.0.0.1:0") as server:
+        addr = "127.0.0.1:%d" % server.bound_address[1]
+        # slave A serves exactly one task, then disconnects
+        a = threading.Thread(target=ga_slave_loop, args=(addr,),
+                             kwargs={"name": "mortal", "max_tasks": 1},
+                             daemon=True)
+        a.start()
+        opt = GeneticOptimizer(_quad_fitness, dict(tun), generations=1,
+                               population_size=5, seed=7,
+                               map_fn=server)
+        done = {}
+
+        def search():
+            done["opt"] = opt.run()
+
+        t = threading.Thread(target=search, daemon=True)
+        t.start()
+        time.sleep(0.3)   # let the mortal slave take+finish one task
+        b = threading.Thread(target=ga_slave_loop, args=(addr,),
+                             kwargs={"name": "survivor"}, daemon=True)
+        b.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "generation never completed"
+    assert numpy.isfinite(opt.best_fitness)
+    # initial pop (5) + one child generation minus the 2 elites (3)
+    assert opt.evaluations == 8

@@ -236,17 +236,39 @@ class Main:
     def optimize(self, module):
         """``--optimize``: GA over every Tune leaf in root;
         GENSxPOPxWORKERS distributes each generation's individuals
-        over spawned worker processes (the reference farmed them to
-        slaves; SURVEY.md §2.7)."""
+        over spawned worker processes, and --listen-address /
+        --master-address farm them over REGISTERED SLAVES instead —
+        the reference's distributed genetics (SURVEY.md §2.7):
+
+            master:  velescli wf.py cfg.py --optimize 6x12 \\
+                         --listen-address 0.0.0.0:8888
+            slaves:  velescli wf.py cfg.py --optimize slave \\
+                         --master-address master:8888
+        """
         from veles.genetics import optimize_config
+        seed = self.args.seed if self.args.seed is not None else 1
+        if self.args.master_address:
+            # GA slave: evaluate callables ship inside the task frames,
+            # so the loop needs no local trainer construction
+            from veles.genetics import ga_slave_loop
+            served = ga_slave_loop(self.args.master_address,
+                                   name="ga-%s" % os.getpid())
+            print(json.dumps({"ga_slave_tasks": served}))
+            return None
+        if self.args.optimize == "slave":
+            raise SystemExit(
+                "--optimize slave requires --master-address "
+                "HOST:PORT (the GA master to join)")
         parts = self.args.optimize.split("x")
         gens = parts[0]
         pop = parts[1] if len(parts) > 1 and parts[1] else 12
         workers = int(parts[2]) if len(parts) > 2 else 1
-        seed = self.args.seed if self.args.seed is not None else 1
+        if self.args.listen_address:
+            return self._optimize_distributed(
+                int(gens), int(pop), seed, slaves=True)
         if workers > 1:
-            return self._optimize_parallel(int(gens), int(pop),
-                                           workers, seed)
+            return self._optimize_distributed(
+                int(gens), int(pop), seed, workers=workers)
 
         def run_one():
             prng.seed_all(seed)   # identical universe per individual
@@ -262,27 +284,41 @@ class Main:
         }))
         return opt
 
-    def _optimize_parallel(self, gens, pop, workers, seed):
+    def _optimize_distributed(self, gens, pop, seed, workers=None,
+                              slaves=False):
+        """Shared GA driver for both distributed maps: registered
+        SLAVES over the HMAC-framed task protocol (--listen-address;
+        drop/requeue keeps a generation alive through slave churn) or
+        local spawned WORKER processes (GENSxPOPxWORKERS)."""
         from veles.genetics import (
-            GeneticOptimizer, ProcessPoolMap, SubprocessTrainer,
-            apply_values, find_tunables)
+            GATaskServer, GeneticOptimizer, ProcessPoolMap,
+            SubprocessTrainer, apply_values, find_tunables)
         evaluate = SubprocessTrainer(
             self.args.workflow, self.args.config,
             overrides=self.args.overrides, seed=seed,
             device=self.args.device or "numpy")
-        with ProcessPoolMap(workers) as pmap:
+        if slaves:
+            map_cm = GATaskServer(self.args.listen_address)
+            print(json.dumps({"ga_master_listen":
+                              "%s:%d" % map_cm.bound_address}),
+                  flush=True)
+        else:
+            map_cm = ProcessPoolMap(workers)
+        with map_cm:
             opt = GeneticOptimizer(
                 evaluate, find_tunables(root), generations=gens,
-                population_size=pop, seed=seed, map_fn=pmap)
+                population_size=pop, seed=seed, map_fn=map_cm)
             best_values, _ = opt.run()
         if best_values is not None:
             apply_values(root, best_values)
-        print(json.dumps({
+        report = {
             "best_fitness": opt.best_fitness,
             "best_values": opt.best_values,
             "evaluations": opt.evaluations,
-            "workers": workers,
-        }))
+        }
+        if workers:
+            report["workers"] = workers
+        print(json.dumps(report))
         return opt
 
     def ensemble(self, module):

@@ -3,11 +3,14 @@
 Re-design of ``veles/genetics/`` [U] (SURVEY.md §2.7 "Genetics", L9):
 config values wrapped in ``Tune(default, min, max)`` define the search
 space; each individual is one full (short) training run; fitness is
-the run's validation metric (lower is better). The reference
-distributed individuals over slaves; the rebuild evaluates them
-sequentially or via any caller-supplied parallel ``map_fn`` (the TPU
-analogue would be one individual per device/slice — plumbing a
-``map_fn`` keeps that open without hardcoding a topology).
+the run's validation metric (lower is better). Like the reference,
+individuals distribute over SLAVES (``GATaskServer`` +
+``ga_slave_loop`` over the HMAC-framed TCP protocol, with the same
+drop->requeue elasticity as the training master; CLI:
+``--optimize ... --listen-address`` / ``--optimize slave
+--master-address``); ``ProcessPoolMap`` is the local spawned-worker
+fallback, and any caller-supplied ``map_fn`` plugs in (the TPU
+analogue: one individual per device/slice).
 
 The optimizer is deliberately classic (tournament selection, blend
 crossover, gaussian mutation, elitism) and fully seeded: same seed ⇒
@@ -320,3 +323,171 @@ def optimize_config(config_root, run_one, **kwargs):
     if best_values is not None:
         apply_values(config_root, best_values)
     return opt
+
+
+# -- distributed evaluation over slaves --------------------------------
+#
+# The reference's genetics "runs distributed over slaves" (SURVEY.md
+# §2.7): each individual is a short training run farmed out to the
+# cluster. The rebuild ships GA tasks over the SAME HMAC-framed TCP
+# protocol the training master uses (veles/server.py frames), with
+# the same elastic contract: a slave joining mid-generation starts
+# pulling tasks, a slave dying mid-task gets its task requeued.
+
+
+class GATaskServer(Logger):
+    """Master side: a per-generation queue of (idx, fn, values) tasks
+    served to registered slaves; results collected by index. ``fn``
+    rides inside the (HMAC-authenticated) frame, so slaves are
+    generic — they need no pre-shared evaluate callable."""
+
+    def __init__(self, address="127.0.0.1:0", slave_timeout=3600.0):
+        import threading
+        from veles.server import framed_server, require_secret_for
+        self.name = "GATaskServer"
+        host, _, port = str(address).rpartition(":")
+        self.address = (host or "127.0.0.1", int(port))
+        require_secret_for(self.address[0], "GA master listen")
+        self.lock = threading.RLock()
+        self.done_event = threading.Event()
+        self.results_ready = threading.Condition(self.lock)
+        self.slaves = {}
+        self._next_slave = 1
+        self.queue = []              # pending task pool (idx order)
+        self.tasks = {}              # idx -> (fn, values)
+        self.inflight = {}           # slave_id -> idx
+        self.results = {}            # idx -> result
+        # slave_timeout bounds a SILENT death (host power loss — no
+        # FIN ever arrives): past it the handler drops the slave and
+        # its task requeues. It must exceed the longest single
+        # evaluation — a slave is legitimately mute while training.
+        self._server = framed_server(
+            self.address, self._handle, self.done_event,
+            self.drop_slave, timeout=float(slave_timeout))
+        self.bound_address = self._server.server_address
+        threading.Thread(target=self._server.serve_forever,
+                         args=(0.05,), daemon=True).start()
+
+    def _handle(self, request):
+        kind = request[0]
+        with self.lock:
+            if kind == "hello":
+                slave_id = self._next_slave
+                self._next_slave += 1
+                self.slaves[slave_id] = {"name": request[1],
+                                         "tasks": 0}
+                self.info("GA slave %d (%s) joined", slave_id,
+                          request[1])
+                return ("welcome", slave_id)
+            if kind == "task":
+                if self.done_event.is_set():
+                    return ("bye",)
+                if not self.queue:
+                    return ("wait",)
+                idx = self.queue.pop(0)
+                self.inflight[request[1]] = idx
+                fn, values = self.tasks[idx]
+                return ("task", idx, fn, values)
+            if kind == "result":
+                _, slave_id, idx, result = request
+                if self.inflight.get(slave_id) == idx:
+                    del self.inflight[slave_id]
+                self.results[idx] = result
+                self.slaves[slave_id]["tasks"] += 1
+                self.results_ready.notify_all()
+                return ("ok",)
+        return ("error", "unknown request %r" % (kind,))
+
+    def drop_slave(self, slave_id):
+        """Death mid-task -> the task goes back to the pending pool
+        (same requeue contract as the training master)."""
+        with self.lock:
+            idx = self.inflight.pop(slave_id, None)
+            if idx is not None and idx not in self.results:
+                self.warning("GA slave %s died; requeueing task %d",
+                             slave_id, idx)
+                self.queue.insert(0, idx)
+            self.slaves.pop(slave_id, None)
+
+    def map(self, fn, values_list):
+        """Distribute one generation; blocks until every result is in
+        (tasks of dropped slaves are requeued for the survivors).
+        Results come back in population order."""
+        with self.lock:
+            self.tasks = {i: (fn, v) for i, v in enumerate(values_list)}
+            self.results = {}
+            self.queue = list(range(len(values_list)))
+        with self.results_ready:
+            while len(self.results) < len(self.tasks):
+                self.results_ready.wait(timeout=0.5)
+        return [self.results[i] for i in range(len(self.tasks))]
+
+    # GeneticOptimizer map_fn surface
+    def __call__(self, fn, xs):
+        xs = list(xs)
+        return self.map(fn, xs) if xs else []
+
+    def status(self):
+        with self.lock:
+            return {"mode": "ga-master",
+                    "n_slaves": len(self.slaves),
+                    "pending": len(self.queue),
+                    "inflight": dict(self.inflight)}
+
+    def close(self):
+        self.done_event.set()
+        self._server.shutdown()
+        self._server.server_close()   # release the listening socket
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def ga_slave_loop(address, name="ga-slave", max_tasks=None,
+                  poll=0.02, eval_lock=None):
+    """Slave side: join the GA master at ``address``, pull tasks,
+    evaluate, report — until the master says bye (or ``max_tasks``
+    served, for tests). ``eval_lock`` serializes evaluation when
+    several in-process slaves share mutable globals (root config)."""
+    import contextlib
+    import socket
+    import time as _time
+    from veles.server import (
+        require_secret_for, send_frame, recv_frame)
+    host, _, port = str(address).rpartition(":")
+    addr = (host or "127.0.0.1", int(port))
+    require_secret_for(addr[0], "GA slave master")
+    sock = socket.create_connection(addr, timeout=30)
+    send_frame(sock, ("hello", name))
+    welcome = recv_frame(sock)
+    if welcome is None or welcome[0] != "welcome":
+        sock.close()
+        raise RuntimeError(
+            "GA master at %s:%d closed the connection during the "
+            "handshake (search already finished?)" % addr)
+    slave_id = welcome[1]
+    served = 0
+    try:
+        while max_tasks is None or served < max_tasks:
+            send_frame(sock, ("task", slave_id))
+            resp = recv_frame(sock)
+            if resp is None or resp[0] == "bye":
+                break
+            if resp[0] == "wait":
+                _time.sleep(poll)
+                continue
+            _, idx, fn, values = resp
+            with (eval_lock or contextlib.nullcontext()):
+                result = fn(values)
+            send_frame(sock, ("result", slave_id, idx, result))
+            if recv_frame(sock) is None:
+                break
+            served += 1
+    except (ConnectionError, OSError):
+        pass            # master finished and closed: a clean exit
+    finally:
+        sock.close()
+    return served

@@ -110,6 +110,48 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
+def framed_server(address, handle_request, done_event, on_drop,
+                  timeout=None):
+    """The framed request loop shared by the training master and the
+    GA task master (``veles/genetics.py``): a ``ThreadingTCPServer``
+    whose per-connection handler pumps HMAC frames through
+    ``handle_request`` until ``done_event``, captures the slave id
+    from the hello exchange, and calls ``on_drop(slave_id)`` when the
+    connection dies — the drop->requeue elasticity hook. ``timeout``
+    (seconds) bounds a silent peer: a slave whose host vanishes
+    without FIN/RST would otherwise block its handler thread forever
+    and strand its in-flight work. The caller owns shutdown +
+    server_close (use ``with``)."""
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            if timeout:
+                self.request.settimeout(timeout)
+            slave_id = None
+            try:
+                while not done_event.is_set():
+                    req = recv_frame(self.request)
+                    if req is None:
+                        break
+                    resp = handle_request(req)
+                    if req[0] == "hello":
+                        slave_id = resp[1]
+                    send_frame(self.request, resp)
+                    if resp[0] == "bye":
+                        break
+            except (ConnectionError, OSError):
+                pass               # socket.timeout is an OSError too
+            finally:
+                if slave_id is not None:
+                    on_drop(slave_id)
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    return Server(address, Handler)
+
+
 class MasterServer(Logger):
     """Owns canonical weights + the job queue; never computes."""
 
@@ -207,35 +249,8 @@ class MasterServer(Logger):
     # -- socket plumbing ----------------------------------------------
 
     def serve_forever(self, poll=0.05):
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                slave_id = None
-                try:
-                    while not outer.done.is_set():
-                        req = recv_frame(self.request)
-                        if req is None:
-                            break
-                        if req[0] == "hello":
-                            resp = outer.handle(req)
-                            slave_id = resp[1]
-                        else:
-                            resp = outer.handle(req)
-                        send_frame(self.request, resp)
-                        if resp[0] == "bye":
-                            break
-                except (ConnectionError, OSError):
-                    pass
-                finally:
-                    if slave_id is not None:
-                        outer.drop_slave(slave_id)
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        with Server(self.address, Handler) as server:
+        with framed_server(self.address, self.handle, self.done,
+                           self.drop_slave) as server:
             self._server = server
             self.bound_address = server.server_address
             threading.Thread(target=server.serve_forever,

@@ -142,22 +142,13 @@ def s2d_pack_input(xp, x, s, padding):
             .reshape(b, hp, wp, s * s * c))
 
 
-def s2d_pack_weights(xp, w, n_kernels, ky, kx, c, s):
-    """Flat (K, ky*kx*C) weights -> block-coord HWIO
-    (ceil(ky/s), ceil(kx/s), s*s*C, K) with zero-padded taps; channel
-    order matches :func:`s2d_pack_input`."""
-    w4 = w.reshape(n_kernels, ky, kx, c)
-    w4 = xp.pad(w4, ((0, 0), (0, (-ky) % s), (0, (-kx) % s), (0, 0)))
-    kyb, kxb = w4.shape[1] // s, w4.shape[2] // s
-    w6 = w4.reshape(n_kernels, kyb, s, kxb, s, c)
-    return (w6.transpose(1, 3, 2, 4, 5, 0)
-            .reshape(kyb, kxb, s * s * c, n_kernels)), kyb, kxb
-
-
 def s2d_unpack_wgrad(xp, gw, n_kernels, ky, kx, c, s):
-    """Inverse of :func:`s2d_pack_weights` for a weight-grad conv
-    result (s*s*C, KYB', KXB', K): slice the block-coord extras, undo
-    the packing, slice the zero taps -> flat (K, ky*kx*C)."""
+    """Weight-grad conv result over packed inputs (s*s*C, KYB', KXB',
+    K) -> flat (K, ky*kx*C) original-coordinate weights: slice the
+    block-coord extras, unpack the (block_row, block_col, C) channel
+    order of :func:`s2d_pack_input` back into spatial taps, slice the
+    positions beyond the original kernel extent (they correspond to
+    the zero-padded rows the packed input carries)."""
     kyb = (ky + (-ky) % s) // s
     kxb = (kx + (-kx) % s) // s
     gw = gw[:, :kyb, :kxb, :]
