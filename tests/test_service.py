@@ -73,6 +73,97 @@ def test_snapshot_resume_xla(tmp_path):
     assert err <= wf.decision.history[-1]["validation"]["metric"] + 0.05
 
 
+class _BlobHandler:
+    """Minimal in-process object server (PUT/GET/DELETE /name,
+    GET / -> JSON list) for the HTTPSnapshotStore round-trip."""
+
+    @staticmethod
+    def serve():
+        import http.server
+        import json as _json
+        import threading
+        blobs = {}
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _name(self):
+                return self.path.lstrip("/")
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                blobs[self._name()] = self.rfile.read(n)
+                self.send_response(201)
+                self.end_headers()
+
+            def do_GET(self):
+                name = self._name()
+                if not name:
+                    body = _json.dumps(sorted(blobs)).encode()
+                elif name in blobs:
+                    body = blobs[name]
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):
+                existed = blobs.pop(self._name(), None) is not None
+                self.send_response(204 if existed else 404)
+                self.end_headers()
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        return server, blobs
+
+
+def test_snapshot_http_store_roundtrip():
+    """Snapshot + resume through the REMOTE store (SURVEY §2.7
+    alternate-backend row): snapshots land on an HTTP object server,
+    retention DELETEs stale names there, and --snapshot-style resume
+    loads straight from the http:// URI."""
+    from veles.snapshotter import load_snapshot
+    server, blobs = _BlobHandler.serve()
+    try:
+        url = "http://127.0.0.1:%d/ckpts" % server.server_address[1]
+        prng.seed_all(555)
+        from veles.znicz_tpu.models import mnist
+        root.mnist.loader.minibatch_size = 50
+        root.mnist.loader.n_train = 500
+        root.mnist.loader.n_valid = 100
+        root.mnist.decision.max_epochs = 2
+        from veles.znicz_tpu.standard_workflow import StandardWorkflow
+        wf = StandardWorkflow(
+            None, name="SnapHTTP", layers=root.mnist.layers,
+            loader_factory=lambda w: mnist.MnistLoader(
+                w, name="loader", minibatch_size=50),
+            decision_config=root.mnist.decision.to_dict(),
+            snapshotter_config={"store": url})
+        wf.initialize(device="numpy")
+        wf.run()
+        dest = wf.snapshotter.destination
+        assert dest.startswith(url), dest
+        # blobs really live on the server, within retention
+        assert blobs and len(
+            [n for n in blobs if n.startswith("ckpts/")]) \
+            <= wf.snapshotter.keep
+        state = load_snapshot(dest)
+        wf2 = make_wf("SnapHTTP2", max_epochs=3)
+        wf2.restore_state(state)
+        assert wf2.decision.epoch_number == wf.decision.best_epoch
+        wf2.run()
+        assert wf2.decision.epoch_number == 3
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_cli_end_to_end(tmp_path):
     """Drive the real CLI: sample module + overrides + result file."""
     result = tmp_path / "result.json"

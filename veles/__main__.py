@@ -247,7 +247,11 @@ class Main:
         """
         from veles.genetics import optimize_config
         seed = self.args.seed if self.args.seed is not None else 1
-        if self.args.master_address:
+        if self.args.optimize == "slave":
+            if not self.args.master_address:
+                raise SystemExit(
+                    "--optimize slave requires --master-address "
+                    "HOST:PORT (the GA master to join)")
             # GA slave: evaluate callables ship inside the task frames,
             # so the loop needs no local trainer construction
             from veles.genetics import ga_slave_loop
@@ -255,10 +259,12 @@ class Main:
                                    name="ga-%s" % os.getpid())
             print(json.dumps({"ga_slave_tasks": served}))
             return None
-        if self.args.optimize == "slave":
+        if self.args.master_address:
+            # refuse rather than silently discard the GENSxPOP search
             raise SystemExit(
-                "--optimize slave requires --master-address "
-                "HOST:PORT (the GA master to join)")
+                "--optimize %r conflicts with --master-address: a GA "
+                "master uses --listen-address; to JOIN a master, use "
+                "--optimize slave" % self.args.optimize)
         parts = self.args.optimize.split("x")
         gens = parts[0]
         pop = parts[1] if len(parts) > 1 and parts[1] else 12

@@ -13,6 +13,14 @@ path — while keeping the reference's UX:
 * optional gzip/bz2/lzma compression;
 * ``--snapshot file`` resume: load states into a freshly built
   workflow and continue.
+
+Storage is PLUGGABLE (the reference's snapshotter had ODBC/S3-style
+alternate backends, SURVEY.md §2.7): :class:`SnapshotStore` is a tiny
+put/get/list/delete byte-blob contract, with
+:class:`FileSnapshotStore` (default; local directory) and
+:class:`HTTPSnapshotStore` (REST-style PUT/GET/DELETE against any
+object endpoint — the S3-shaped deployment). ``--snapshot http://...``
+resumes straight from the remote store.
 """
 
 import bz2
@@ -31,12 +39,174 @@ from veles.units import Unit
 _OPENERS = {"": open, "gz": gzip.open, "bz2": bz2.open, "xz": lzma.open}
 
 
+class _BufferedStream:
+    """Default ``SnapshotStore.stream``: buffer, then one ``put`` on
+    clean exit (remote stores need whole blobs); ``.uri`` afterwards."""
+
+    def __init__(self, store, name):
+        self.store = store
+        self.name = name
+        self.uri = None
+
+    def __enter__(self):
+        self.buf = io.BytesIO()
+        return self.buf
+
+    def __exit__(self, et, ev, tb):
+        if et is None:
+            self.uri = self.store.put(self.name, self.buf.getvalue())
+        return False
+
+
+class _FileStream:
+    """File-backed ``stream``: write THROUGH to disk (no second
+    in-memory copy of the blob) with the write-then-rename commit."""
+
+    def __init__(self, store, name):
+        self.path = os.path.join(store.directory, name)
+        self.uri = None
+
+    def __enter__(self):
+        self._f = open(self.path + ".tmp", "wb")
+        return self._f
+
+    def __exit__(self, et, ev, tb):
+        self._f.close()
+        if et is None:
+            os.replace(self.path + ".tmp", self.path)
+            self.uri = self.path
+        else:
+            try:
+                os.remove(self.path + ".tmp")
+            except OSError:
+                pass
+        return False
+
+
+class SnapshotStore:
+    """Byte-blob store contract: names are flat (the snapshotter's
+    stamped filenames), payloads are opaque compressed npz bytes."""
+
+    def put(self, name, data):
+        """Store ``data`` under ``name``; -> a resolvable URI/path."""
+        raise NotImplementedError
+
+    def stream(self, name):
+        """A context manager yielding a writable binary file whose
+        contents commit to ``name`` on clean exit (``.uri`` holds the
+        result). Default buffers and ``put``s; file-backed stores
+        stream straight to disk."""
+        return _BufferedStream(self, name)
+
+    def get(self, name):
+        """-> the bytes stored under ``name`` (KeyError if absent)."""
+        raise NotImplementedError
+
+    def list(self):
+        """-> sorted snapshot names currently stored."""
+        raise NotImplementedError
+
+    def delete(self, name):
+        """Remove ``name``; missing names are ignored (retention may
+        race a manual cleanup)."""
+        raise NotImplementedError
+
+
+class FileSnapshotStore(SnapshotStore):
+    """The default local-directory backend."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def put(self, name, data):
+        with self.stream(name) as f:
+            f.write(data)
+        return os.path.join(self.directory, name)
+
+    def stream(self, name):
+        # write-then-rename: a kill mid-write must not leave a
+        # truncated checkpoint a resume would trust
+        return _FileStream(self, name)
+
+    def get(self, name):
+        path = os.path.join(self.directory, name)
+        if not os.path.exists(path):
+            raise KeyError(name)
+        with open(path, "rb") as f:
+            return f.read()
+
+    def list(self):
+        return sorted(n for n in os.listdir(self.directory)
+                      if ".ckpt." in n)
+
+    def delete(self, name):
+        try:
+            os.remove(os.path.join(self.directory, name))
+        except OSError:
+            pass
+
+
+class HTTPSnapshotStore(SnapshotStore):
+    """REST-style remote backend: ``PUT/GET/DELETE <base>/<name>``,
+    ``GET <base>/`` -> JSON name list. Matches any object-store-shaped
+    endpoint (an S3 bucket behind a signer, the forge host, a plain
+    nginx WebDAV location); the transport is stdlib urllib, so
+    zero-dependency like the rest of the service layer."""
+
+    def __init__(self, base_url, timeout=60):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = float(timeout)
+
+    def _request(self, method, name="", data=None):
+        import urllib.request
+        url = self.base_url + "/" + name
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/octet-stream")
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def put(self, name, data):
+        self._request("PUT", name, data).read()
+        return self.base_url + "/" + name
+
+    def get(self, name):
+        import urllib.error
+        try:
+            return self._request("GET", name).read()
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                raise KeyError(name) from None
+            raise
+
+    def list(self):
+        return sorted(json.loads(self._request("GET").read().decode()))
+
+    def delete(self, name):
+        import urllib.error
+        try:
+            self._request("DELETE", name).read()
+        except urllib.error.HTTPError as exc:
+            if exc.code != 404:
+                raise
+
+
+def store_for(target):
+    """A store + name resolver for a snapshot TARGET: an http(s) URI
+    maps to (HTTPSnapshotStore(base), name); anything else is a local
+    path handled by the file machinery."""
+    if target.startswith(("http://", "https://")):
+        base, _, name = target.rpartition("/")
+        return HTTPSnapshotStore(base), name
+    return None, target
+
+
 class SnapshotterBase(Unit):
     """Gated checkpoint writer."""
 
     def __init__(self, workflow, prefix="wf", compression="gz",
                  directory=None, keep=2, export_inference=None,
-                 **kwargs):
+                 store=None, **kwargs):
         super().__init__(workflow, **kwargs)
         if compression not in _OPENERS:
             raise ValueError("compression must be one of %s"
@@ -44,9 +214,17 @@ class SnapshotterBase(Unit):
         self.prefix = prefix
         self.compression = compression
         self.directory = directory or root.common.dirs.snapshots
+        #: the storage backend; default = local FileSnapshotStore over
+        #: ``directory``. Any SnapshotStore plugs in (config can name
+        #: an HTTP endpoint: ``store="http://host/bucket"``).
+        if isinstance(store, str):
+            store = HTTPSnapshotStore(store) \
+                if store.startswith(("http://", "https://")) \
+                else FileSnapshotStore(store)
+        self._store = store
         self.keep = keep
         self.decision = None
-        self.destination = None      # last written path
+        self.destination = None      # last written path/URI
         self._written = []
         #: directory to (re)write the C++ inference archive into on
         #: every improved snapshot — the deployable artifact always
@@ -54,9 +232,15 @@ class SnapshotterBase(Unit):
         #: flow, SURVEY.md §3.5)
         self.export_inference_dir = export_inference
 
+    @property
+    def store(self):
+        if self._store is None:
+            self._store = FileSnapshotStore(self.directory)
+        return self._store
+
     def initialize(self, **kwargs):
         super().initialize(**kwargs)
-        os.makedirs(self.directory, exist_ok=True)
+        self.store   # materialize (creates the directory for files)
 
     def suffix(self):
         metric = getattr(self.decision, "best_metric", None)
@@ -68,29 +252,44 @@ class SnapshotterBase(Unit):
         self.export_snapshot()
 
     def export_snapshot(self):
-        path = os.path.join(
-            self.directory, "%s_%s.ckpt.npz%s" % (
-                self.prefix, self.suffix(),
-                "." + self.compression if self.compression else ""))
+        name = "%s_%s.ckpt.npz%s" % (
+            self.prefix, self.suffix(),
+            "." + self.compression if self.compression else "")
         payload = self.workflow.checkpoint_state()
         blob = io.BytesIO()
         numpy.savez(blob, **_flatten_tree(payload))
-        opener = _OPENERS[self.compression]
-        with opener(path, "wb") as f:
-            f.write(blob.getvalue())
+        # compress THROUGH the store's stream: file stores get the
+        # old direct-to-disk write (no second in-memory copy of the
+        # blob); buffering stores (HTTP) collect and put once
+        sp = self.store.stream(name)
+        try:
+            with sp as sink:
+                if self.compression:
+                    with _OPENERS[self.compression](sink, "wb") as f:
+                        f.write(blob.getvalue())
+                else:
+                    sink.write(blob.getvalue())
+        except Exception as exc:
+            # a checkpoint is auxiliary: a transient store failure
+            # (remote 503, full disk) must not kill hours of training
+            self.warning("snapshot %s NOT written (%s: %s) — training "
+                         "continues", name, type(exc).__name__, exc)
+            return None
+        path = sp.uri
         self.destination = path
         # same-suffix rewrites refresh their retention slot
-        if path in self._written:
-            self._written.remove(path)
-        self._written.append(path)
+        if name in self._written:
+            self._written.remove(name)
+        self._written.append(name)
         # retention: keep the last `keep` snapshots (newest == best so
         # far, since the gate only opens on improvement)
         while len(self._written) > self.keep:
             stale = self._written.pop(0)
             try:
-                os.remove(stale)
-            except OSError:
-                pass
+                self.store.delete(stale)
+            except Exception as exc:
+                self.warning("retention delete of %s failed: %s",
+                             stale, exc)
         if self.export_inference_dir:
             from veles.export_inference import export_inference
             # checkpoint_state() above already synced the at_valid view
@@ -107,14 +306,22 @@ class Snapshotter(SnapshotterBase):
 
 
 def load_snapshot(path):
-    """Read a checkpoint written by Snapshotter back into a state tree."""
-    base = os.path.basename(path)
+    """Read a checkpoint written by Snapshotter back into a state
+    tree. ``path``: a local file, or an ``http(s)://`` URI resolved
+    through :class:`HTTPSnapshotStore` (remote resume)."""
+    store, name = store_for(path)
+    base = os.path.basename(name)
     comp = ""
     for suffix, opener in _OPENERS.items():
         if suffix and base.endswith("." + suffix):
             comp = suffix
-    with _OPENERS[comp](path, "rb") as f:
-        data = f.read()
+    if store is not None:
+        raw = store.get(name)
+    else:
+        with open(path, "rb") as f:
+            raw = f.read()
+    data = raw if not comp else \
+        _OPENERS[comp](io.BytesIO(raw), "rb").read()
     npz = numpy.load(io.BytesIO(data), allow_pickle=False)
     return _unflatten_tree(dict(npz))
 
