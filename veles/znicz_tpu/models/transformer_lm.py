@@ -295,8 +295,7 @@ class TransformerLMWorkflow(StandardWorkflow):
         if expert > 1:
             parallel.setup_expert_parallel(
                 self, mesh, refresh=False,
-                routing=str(spec.get("ep_routing", "gather")),
-                batch_axis="data" if data > 1 else None)
+                routing=str(spec.get("ep_routing", "gather")))
         if pipe > 1:
             parallel.setup_pipeline_parallel(
                 self, mesh,

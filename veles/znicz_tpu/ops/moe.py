@@ -111,10 +111,11 @@ class MoEFFN(Forward):
         self.bias2 = Array()
         # explicit all-to-all EP (parallel/expert.py); set by
         # setup_expert_parallel(routing="alltoall"), None = GSPMD
-        # gather lowering
+        # gather lowering. ep_batch_axes: every non-expert mesh axis,
+        # over which tokens additionally shard inside the exchange
         self.ep_mesh = None
         self.ep_axis = None
-        self.ep_batch_axis = None
+        self.ep_batch_axes = ()
 
     def output_shape_for(self, ishape):
         return tuple(ishape)

@@ -180,7 +180,7 @@ def setup_sequence_parallel(workflow, mesh, axis="seq",
 
 
 def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True,
-                          routing="gather", batch_axis=None):
+                          routing="gather"):
     """Expert parallelism for MoE units: the leading (expert) dim of
     every stacked expert parameter — and its momentum state — is
     sharded over ``axis``, so each device holds E/n experts. The
@@ -197,10 +197,12 @@ def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True,
       choice.
     * ``"alltoall"``: the canonical GShard exchange, explicit
       ``shard_map`` + ``lax.all_to_all`` (``parallel/expert.py``) —
-      O(tokens) bandwidth, the at-scale choice. Pass ``batch_axis``
-      when composing with DP on the same mesh so the token specs
-      match the batch sharding. Capacity/aux become per-data-shard at
-      DP>1 (see ``parallel/expert.py`` docstring)."""
+      O(tokens) bandwidth, the at-scale choice. Tokens shard over
+      EVERY mesh axis inside the exchange (the non-expert axes are
+      derived from the mesh — nothing to pass when composing with
+      DP/TP/SP on one mesh); the batch must divide the total device
+      count. Capacity/aux become per-token-shard at >1 shards (see
+      ``parallel/expert.py`` docstring)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from veles.znicz_tpu.ops.moe import MoEFFN
     if routing not in ("gather", "alltoall"):
@@ -220,22 +222,16 @@ def setup_expert_parallel(workflow, mesh, axis="expert", refresh=True,
                 "%s: %s axis size %d does not divide expert count %d"
                 % (fwd.name, axis, n, fwd.experts))
         if routing == "alltoall":
-            extra = [a for a in mesh.axis_names
-                     if a not in (axis, batch_axis)]
-            if extra:
-                # loud error, not a silent fallback: the exchange
-                # shards tokens over (batch_axis, expert) only, so any
-                # further mesh axis would replicate the whole token
-                # exchange across its ranks — the O(replication)
-                # traffic alltoall mode exists to eliminate
-                raise ValueError(
-                    "alltoall EP composes with a data axis only; mesh "
-                    "axes %r would silently replicate the token "
-                    "exchange — use routing='gather' with them or "
-                    "drop them" % (extra,))
             fwd.ep_mesh = mesh
             fwd.ep_axis = axis
-            fwd.ep_batch_axis = batch_axis
+            # tokens shard over EVERY non-expert mesh axis inside the
+            # exchange (merely replicating them along any axis would
+            # duplicate the token exchange across its ranks — the
+            # O(replication) traffic alltoall mode exists to
+            # eliminate); expert/router grads psum back over these
+            # axes in the backward (parallel/expert.py)
+            fwd.ep_batch_axes = tuple(
+                a for a in mesh.axis_names if a != axis)
         gd = workflow.gds[i] if i < len(workflow.gds) else None
         for key in ("weights", "bias", "weights2", "bias2"):
             sh = NamedSharding(

@@ -9,16 +9,17 @@ block onto every expert shard — O(E) bandwidth — while this path ships
 each token once to the device owning its expert — O(tokens).
 
 Token layout — the crucial choice: inside the exchange the batch is
-sharded over the COMBINED (data, expert) axes, GShard-style, so every
-device owns a distinct token shard. (Merely replicating tokens along
-the expert axis — the outer program's layout — would make each of the
-n expert-axis peers ship the SAME tokens, handing every expert n
-duplicate copies and scaling its weight gradients by n; the shard_map
-in/out specs therefore split the batch dim over ``(batch_axis, axis)``
-and GSPMD inserts the cheap reshard at the boundary.)
+sharded over EVERY mesh axis (non-expert axes + the expert axis),
+GShard-style, so every device owns a distinct token shard. (Merely
+replicating tokens along any axis — the outer program's layout along
+the expert/model/seq axes — would make peers on that axis ship the
+SAME tokens, handing every expert duplicate copies and scaling its
+weight gradients by the replication factor; the shard_map in/out
+specs therefore split the batch dim over all axes and GSPMD inserts
+the cheap reshard at the boundary.)
 
-Dataflow per device (local tokens T_loc = B·S/(dp·n), global experts
-E, local experts E/n, per-(expert, source-shard) capacity C):
+Dataflow per device (local tokens T_loc = B·S/n_devices, global
+experts E, local experts E/n, per-(expert, source-shard) capacity C):
 
 1. route local tokens with the SHARED formula (``moe.route_tokens``)
    → dispatch one-hots (T_loc, E, C);
@@ -32,8 +33,9 @@ E, local experts E/n, per-(expert, source-shard) capacity C):
 
 The backward unit mirrors the exchange (the transpose of an
 all-to-all is the reverse all-to-all); expert-weight gradients psum
-over the data axis (each expert's tokens from other data shards live
-there), router gradients psum over every token-sharding axis.
+over every NON-expert axis (each expert's tokens from other token
+shards live there — the a2a only crosses the expert axis), router
+gradients psum over every token-sharding axis.
 
 Parity semantics vs the single-chip / gather formulation: the
 load-balancing auxiliary gradient uses the GLOBAL routing frequency
@@ -56,29 +58,31 @@ from veles.znicz_tpu.parallel.ring import _shard_map
 
 
 def _specs(unit):
-    """(mesh, axis, batch_axis, PartitionSpec factory) for a unit the
-    setup routed through the explicit path."""
+    """(mesh, axis, batch_axes, PartitionSpec factory) for a unit the
+    setup routed through the explicit path. ``batch_axes``: every
+    non-expert mesh axis (data/model/seq/pipe) — tokens shard over
+    all of them inside the exchange."""
     from jax.sharding import PartitionSpec as P
-    return unit.ep_mesh, unit.ep_axis, unit.ep_batch_axis, P
+    return (unit.ep_mesh, unit.ep_axis, tuple(unit.ep_batch_axes), P)
 
 
 def _token_axes(unit):
     """The mesh axes the batch dim is sharded over inside the
-    exchange: (batch_axis, expert_axis) combined — see the module
-    docstring's token-layout note."""
-    _, axis, batch_axis, _ = _specs(unit)
-    return (batch_axis, axis) if batch_axis else (axis,)
+    exchange: every non-expert axis plus the expert axis — see the
+    module docstring's token-layout note."""
+    _, axis, batch_axes, _ = _specs(unit)
+    return batch_axes + (axis,)
 
 
 def _local_tokens(unit, x_shape):
     """Static per-device token count and capacity."""
-    mesh, axis, batch_axis, _ = _specs(unit)
+    mesh, axis, batch_axes, _ = _specs(unit)
     shards = int(numpy.prod([mesh.shape[a] for a in _token_axes(unit)]))
     b, s = x_shape[0], x_shape[1]
     if b % shards:
         raise ValueError(
             "batch %d not divisible by the %d-way token sharding "
-            "(data x expert axes)" % (b, shards))
+            "(every mesh axis)" % (b, shards))
     t_loc = (b // shards) * s
     return t_loc, unit.capacity(t_loc)
 
@@ -92,19 +96,19 @@ def _spec_set(unit):
     * ``x``: token tensors (B, S, ·) — batch over the combined token
       axes;
     * ``e(nd)``: expert-sharded parameter leaves of rank nd;
-    * ``c``: exchanged-coordinate caches xe/h — leading data dim,
-      expert-sharded expert dim -> global (dp, E, nC, ·);
+    * ``c``: exchanged-coordinate caches xe/h — leading non-expert
+      dim, expert-sharded expert dim -> global (prod(batch), E, nC, ·);
     * ``y``: the ye cache in local-token coordinates — per-token-shard
-      content behind a leading length-1 dim -> global (dp·n, E, C, D).
+      content behind a leading length-1 dim -> global (shards, E, C, D).
     """
-    _, axis, batch_axis, P = _specs(unit)
+    _, axis, batch_axes, P = _specs(unit)
     tok = _token_axes(unit)
     return {
         "x": P(tok, None, None),
         "e": lambda nd: P(*((axis,) + (None,) * (nd - 1))),
         "tok2": P(tok, None),
         "tok4": P(tok, None, None, None),
-        "c": P(batch_axis, axis, None, None),
+        "c": P(batch_axes or None, axis, None, None),
         "y": P(tok, None, None, None),
         "rep": P(),
     }
@@ -152,10 +156,10 @@ def moe_a2a_fwd(x, params, unit, es):
     """All-to-all forward for a :class:`ops.moe.MoEFFN` whose
     ``ep_mesh`` is set. Returns (y, cache) like ``MoEFFN._forward``;
     the xe/h cache entries live in EXCHANGED coordinates — global
-    (dp, E, n·C, ·) arrays sharded over the expert axis — which is
-    how the expert-FFN backward consumes them, while ye is cached in
-    local-token coordinates (see ``_fwd_local``)."""
-    mesh, axis, batch_axis, P = _specs(unit)
+    (prod(non-expert axes), E, n·C, ·) arrays sharded over the expert
+    axis — which is how the expert-FFN backward consumes them, while
+    ye is cached in local-token coordinates (see ``_fwd_local``)."""
+    mesh, axis, _batch_axes, P = _specs(unit)
     _, cap = _local_tokens(unit, x.shape)
     sp = _spec_set(unit)
     fn = _shard_map(
@@ -178,7 +182,7 @@ def moe_a2a_fwd(x, params, unit, es):
 
 def _bwd_local(x, err, router, w1, b1, w2, b2, probs, onehot_e, gate,
                dispatch, xe_recv, h, ye_local, aux_weight, *, axis,
-               batch_axis, tok_axes, n_shards, experts, cap,
+               batch_axes, tok_axes, n_shards, experts, cap,
                activation, residual, es):
     """Per-device backward body: mirror of ``GDMoEFFN._backward`` with
     the two einsum contractions that crossed the expert dim replaced
@@ -228,13 +232,14 @@ def _bwd_local(x, err, router, w1, b1, w2, b2, probs, onehot_e, gate,
     dx = dxt.reshape(b, s, d)
     if residual:
         dx = dx + err
-    # expert grads: each data shard holds partial sums for ALL its
-    # experts' tokens from that shard -> sum over the data axis (GSPMD
+    # expert grads: each non-expert-axis shard holds partial sums for
+    # its experts' tokens from ITS token subset (the a2a only crosses
+    # the expert axis) -> sum over every non-expert token axis (GSPMD
     # inserts this all-reduce automatically in gather mode). Router
     # grads are partial over EVERY token shard -> psum over all token
     # axes.
-    if batch_axis is not None:
-        gw1, gb1, gw2, gb2 = (lax.psum(g, batch_axis)
+    if batch_axes:
+        gw1, gb1, gw2, gb2 = (lax.psum(g, batch_axes)
                               for g in (gw1, gb1, gw2, gb2))
     grouter = lax.psum(grouter, tok_axes)
     return dx, gw1, gb1, gw2, gb2, grouter
@@ -246,7 +251,7 @@ def moe_a2a_bwd(x, err, params, cache, aux_weight, unit, es):
     (matching the parameter shardings) and router/dx replicated across
     it."""
     import jax.numpy as jnp
-    mesh, axis, batch_axis, P = _specs(unit)
+    mesh, axis, batch_axes, P = _specs(unit)
     _, cap = _local_tokens(unit, x.shape)
     tok = _token_axes(unit)
     n_shards = int(numpy.prod([mesh.shape[a] for a in tok]))
@@ -259,7 +264,7 @@ def moe_a2a_bwd(x, err, params, cache, aux_weight, unit, es):
                   sp["y"], sp["rep"]),
         out_specs=(sp["x"], sp["e"](3), sp["e"](2), sp["e"](3),
                    sp["e"](2), sp["rep"]))(
-        functools.partial(_bwd_local, axis=axis, batch_axis=batch_axis,
+        functools.partial(_bwd_local, axis=axis, batch_axes=batch_axes,
                           tok_axes=tok, n_shards=n_shards,
                           experts=unit.experts, cap=cap,
                           activation=unit.ACTIVATION,
