@@ -193,7 +193,7 @@ def test_extra_param_accumulation_and_bias_hypers(kind, wlike, blike):
 
 
 def _run_moe_lm(backend, parallel_spec=None, seed=515,
-                capacity_factor=2.0):
+                capacity_factor=2.0, max_epochs=6):
     prng.seed_all(seed)
     from veles.znicz_tpu.models import transformer_lm
     root.lm.loader.update({"minibatch_size": 32, "n_train": 512,
@@ -203,7 +203,7 @@ def _run_moe_lm(backend, parallel_spec=None, seed=515,
                           "ffn_hidden": 64, "moe_experts": 4,
                           "moe_capacity_factor": capacity_factor,
                           "moe_aux_weight": 0.01, "attn_block": None})
-    root.lm.decision.max_epochs = 6
+    root.lm.decision.max_epochs = max_epochs
     root.lm.parallel.update({"seq": 1, "model": 1, "data": 1,
                              "expert": 1, "ep_routing": "gather"})
     if parallel_spec:
@@ -317,6 +317,32 @@ def test_moe_lm_ep_alltoall_trains_with_drops():
     h = [e["validation"]["metric"] for e in wf.decision.history]
     assert h[-1] < h[0], h
     parallel.assert_collectives(wf.xla_step, ["all-to-all"])
+
+
+def test_moe_ep_alltoall_snapshot_restores_single_device(tmp_path):
+    """Checkpoints are LAYOUT-independent: a snapshot written while
+    the experts were sharded over the mesh (alltoall routing) restores
+    bit-for-bit onto a plain single-device workflow — the distributed
+    run leaves nothing layout-specific in the checkpoint."""
+    from veles.snapshotter import Snapshotter, load_snapshot
+
+    wf = _run_moe_lm("xla", {"expert": 4, "data": 2,
+                             "ep_routing": "alltoall"},
+                     capacity_factor=8.0)
+    snap = Snapshotter(wf, name="snap", directory=str(tmp_path))
+    snap.decision = wf.decision
+    state = load_snapshot(snap.export_snapshot())
+    wf1 = _run_moe_lm("xla", capacity_factor=8.0, seed=516,
+                      max_epochs=1)
+    wf1.restore_state(state)
+    moe = next(f for f in wf1.forwards if isinstance(f, MoEFFN))
+    for key in MoEFFN.PARAMS:
+        restored = wf1.xla_step.params[moe.name][key]
+        # values from the sharded checkpoint, placement single-device
+        assert numpy.array_equal(
+            numpy.asarray(restored),
+            numpy.asarray(state["params"][moe.name][key])), key
+        assert len(restored.sharding.device_set) == 1
 
 
 def test_moe_lm_single_slave_matches_standalone():

@@ -223,6 +223,29 @@ def test_stacked_lm_1f1b_schedule_trains_like_gpipe():
         wf8.xla_step, ["collective-permute", "all-reduce"])
 
 
+def test_pp_1f1b_snapshot_restores_single_device(tmp_path):
+    """A checkpoint written while the stacked layers were
+    pipe-sharded (1F1B schedule) restores onto a single-device
+    workflow — layout independence for PP too."""
+    from veles.snapshotter import Snapshotter, load_snapshot
+
+    wf = _run_stacked_lm("xla", {"pipe": 4, "microbatches": 4,
+                                 "schedule": "1f1b"}, epochs=2)
+    snap = Snapshotter(wf, name="snap", directory=str(tmp_path))
+    snap.decision = wf.decision
+    state = load_snapshot(snap.export_snapshot())
+    wf1 = _run_stacked_lm("xla", seed=607, epochs=1)
+    wf1.restore_state(state)
+    stack = next(f for f in wf1.forwards
+                 if isinstance(f, TransformerBlockStack))
+    for key in stack.PARAMS:
+        restored = wf1.xla_step.params[stack.name][key]
+        assert numpy.array_equal(
+            numpy.asarray(restored),
+            numpy.asarray(state["params"][stack.name][key])), key
+        assert len(restored.sharding.device_set) == 1
+
+
 def test_1f1b_schedule_properties():
     """Static-schedule invariants: every stage finishes M forwards and
     M backwards; causality holds (consume strictly after neighbour
