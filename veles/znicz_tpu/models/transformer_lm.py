@@ -36,9 +36,12 @@ root.lm.update({
     # parallelism via root.lm.parallel.pipe).
     # attn_impl: None/"scan" = lax.scan flash formulation when
     # attn_block is set; "pallas" = the hand-written Pallas TPU
-    # kernels (parallel/pallas_attention.py)
+    # kernels (parallel/pallas_attention.py). pallas_tile: explicit
+    # kernel tile override (None = measured auto, up to 512 — the
+    # VMEM escape hatch for large head dims)
     "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128,
-              "attn_block": None, "attn_impl": None, "moe_experts": 0,
+              "attn_block": None, "attn_impl": None,
+              "pallas_tile": None, "moe_experts": 0,
               "moe_capacity_factor": 2.0, "moe_aux_weight": 0.01,
               "stacked": False},
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
@@ -225,7 +228,8 @@ def build_layers():
              "->": {"heads": m.heads, "causal": True,
                     "residual": True,
                     "attn_block_size": m.get("attn_block"),
-                    "attn_impl": m.get("attn_impl")},
+                    "attn_impl": m.get("attn_impl"),
+                    "pallas_tile": m.get("pallas_tile")},
              "<-": dict(t)},
             {"type": "layernorm", "<-": dict(t)},
             dict(ffn_layer),

@@ -29,6 +29,15 @@ from veles.znicz_tpu.nn_units import (
 from veles.znicz_tpu.ops import activations as A
 
 
+def _pow2_divisor(s, cap):
+    """Largest power-of-two divisor of ``s``, at most ``cap`` — the
+    shared tile-size fallback for the flash/Pallas paths."""
+    b = 1
+    while b * 2 <= cap and s % (b * 2) == 0:
+        b *= 2
+    return b
+
+
 # ---------------------------------------------------------------------------
 # per-token dense (operates on the trailing dim of (B, S, D))
 
@@ -330,6 +339,11 @@ class MultiHeadAttention(Forward):
             raise ValueError(
                 "attn_impl must be None, 'scan' or 'pallas', got %r"
                 % (self.attn_impl,))
+        #: explicit Pallas kernel tile (None = the measured auto
+        #: choice, _pallas_block): the VMEM escape hatch for head
+        #: dims where the auto tile's scoped-VMEM footprint is too
+        #: large. Must divide the (per-shard) sequence length.
+        self.pallas_tile = kwargs.get("pallas_tile")
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -483,22 +497,25 @@ class MultiHeadAttention(Forward):
         return y, (q, k, v, out_heads, lse, merged)
 
     def _pallas_block(self, s=None):
-        """Kernel block size for a sequence of length ``s`` (default:
+        """Pallas kernel tile for a sequence of length ``s`` (default:
         the unit's full sequence; the ring path passes its per-shard
-        length): attn_block_size — which must divide, same loud error
-        in every mode — or the largest power-of-two divisor up to 128
-        (so the flash kernels work without attn_block_size for any
-        even S)."""
+        length): ``pallas_tile`` when set (the explicit VMEM escape
+        hatch — must divide), else the largest power-of-two divisor
+        of ``s`` up to 512 — the measured v5e optimum in the
+        auto-select regime (57M LM, tile 512 vs the old
+        attn_block=256: 111k vs 82k tok/s at S=4096, 80k vs 53k at
+        S=8192; tile 1024 blows scoped VMEM). ``attn_block_size``
+        tunes the SCAN formulation and no longer constrains the
+        kernel tile (honoring it cost 36-50% at long S, round 4)."""
         if s is None:
             s = self.input.shape[1]
-        if self.attn_block_size:
-            if s % self.attn_block_size:
+        if self.pallas_tile:
+            if s % self.pallas_tile:
                 raise ValueError(
-                    "%s: attn_block_size %d does not divide sequence "
-                    "length %d" % (self.name, self.attn_block_size, s))
-            return self.attn_block_size
-        return max(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
-                   if s % b == 0)
+                    "%s: pallas_tile %d does not divide sequence "
+                    "length %d" % (self.name, self.pallas_tile, s))
+            return self.pallas_tile
+        return _pow2_divisor(s, 512)
 
     def _fwd_pallas(self, xp, x, p, dot, cd=None):
         """Flash forward on the hand-written Pallas TPU kernel.
@@ -540,16 +557,18 @@ class MultiHeadAttention(Forward):
             inner = "scan"
         else:
             return None, None
-        # block size: attn_block_size when it divides the SHARD length,
-        # else the largest power-of-two divisor — NOT the single-chip
-        # loud error: attn_block_size is tuned against the global S,
-        # and the per-shard length is a deployment detail (the same
-        # config must run at seq=1 and seq=8), so a non-dividing value
-        # degrades to the nearest workable tile instead of crashing
+        if inner == "pallas":
+            # the kernel picks its own measured-optimum tile
+            return inner, self._pallas_block(s_loc)
+        # scan inner: attn_block_size when it divides the SHARD
+        # length, else the largest power-of-two divisor — NOT a loud
+        # error: attn_block_size is tuned against the global S, and
+        # the per-shard length is a deployment detail (the same
+        # config must run at seq=1 and seq=8), so a non-dividing
+        # value degrades to the nearest workable tile
         if self.attn_block_size and s_loc % self.attn_block_size == 0:
             return inner, self.attn_block_size
-        return inner, max(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
-                          if s_loc % b == 0)
+        return inner, _pow2_divisor(s_loc, 128)
 
     def _fwd_ring(self, xp, x, p, ctx, dot):
         """Sequence-parallel forward: qkv projection under
