@@ -414,13 +414,17 @@ class MultiHeadAttention(Forward):
 
     #: blocked-attention auto policy: with ``attn_impl=None`` the
     #: Pallas kernels take over on a real TPU once S reaches this
-    #: bound — measured end-to-end on a v5e 57M LM (2026-07-30):
-    #: scan wins at S=2048 (127k vs 111k tok/s, pallas_call's fusion
-    #: boundary dominates), pallas wins 1.9x at S=4096 (91k vs 49k)
-    #: and 2.6x at S=8192 (57k vs 22k — its causal loop bound SKIPS
-    #: fully-masked K blocks, which the scan schedule cannot).
-    #: ``attn_impl="scan"`` forces the scan at any S.
-    PALLAS_AUTO_MIN_S = 4096
+    #: bound. Measured end-to-end on a v5e 57M LM with the round-4
+    #: auto tile (2026-07-31, pallas vs scan tok/s): S=512 150k vs
+    #: 164k (scan wins — pallas_call's fusion boundary dominates),
+    #: S=1024 174k vs 161k, S=2048 156k vs 119k, S=4096 111k vs 82k,
+    #: S=8192 85k vs 53k (the causal loop bound SKIPS fully-masked K
+    #: blocks, which the scan schedule cannot). The round-3 threshold
+    #: of 4096 was an artifact of the kernel inheriting attn_block=256
+    #: as its tile; with the tile freed (``_pallas_block``) the
+    #: crossover sits between 512 and 1024. ``attn_impl="scan"``
+    #: forces the scan at any S.
+    PALLAS_AUTO_MIN_S = 1024
 
     def _traced_mode(self, ctx, s):
         """ONE dispatch resolver for the traced forward AND backward

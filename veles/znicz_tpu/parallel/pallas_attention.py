@@ -3,24 +3,26 @@ fast path.
 
 Real TPU kernels keeping the (m, l, acc) online-softmax state in VMEM
 across K/V blocks (SURVEY.md §2.5, §7 stage 6). Where they win, and
-why (measured on a v5e, 2026-07-30, bf16 inputs, 57.5M LM training
-step, readback timing):
+why (measured on a v5e, round-4 auto tile 2026-07-31, bf16 inputs,
+57.5M LM training step, readback timing; pallas vs scan tok/s):
 
-* SHORT sequences (S<=2048): the XLA scan (``parallel/flash.py``)
-  wins end-to-end (127k vs 111k tok/s at S=2048) — ``pallas_call`` is
-  a fusion boundary, so the qkv projection and surrounding elementwise
-  work can no longer fuse into the attention loop, and at short S
-  that overhead dominates.
-* LONG sequences: these kernels win END-TO-END — 1.9x at S=4096 (91k
-  vs 49k tok/s) and 2.6x at S=8192 (57k vs 22k) — because the causal
-  ``fori_loop`` bound SKIPS fully-masked K blocks entirely, halving
-  the quadratic work, which the scan schedule cannot do (a lax.cond
-  block-skip was measured SLOWER: TPU conditionals break scan
-  pipelining; inside a Pallas kernel the loop bound is a plain scalar
-  and costs nothing).
+* S=512: the XLA scan (``parallel/flash.py``) wins end-to-end (164k
+  vs 150k) — ``pallas_call`` is a fusion boundary, so the qkv
+  projection and surrounding elementwise work can no longer fuse into
+  the attention loop, and at short S that overhead dominates.
+* S>=1024: these kernels win END-TO-END — 174k vs 161k at S=1024,
+  156k vs 119k at S=2048, 111k vs 82k at S=4096, 85k vs 53k at
+  S=8192 — because the causal ``fori_loop`` bound SKIPS fully-masked
+  K blocks entirely, halving the quadratic work, which the scan
+  schedule cannot do (a lax.cond block-skip was measured SLOWER: TPU
+  conditionals break scan pipelining; inside a Pallas kernel the loop
+  bound is a plain scalar and costs nothing). Round 3 put the
+  crossover at 4096 — an artifact of the kernel inheriting
+  attn_block=256 as its tile; the freed tile
+  (``MultiHeadAttention._pallas_block``, up to 512) moved it.
 
 ``MultiHeadAttention`` therefore auto-selects: ``attn_impl=None``
-uses the scan below ``PALLAS_AUTO_MIN_S`` (4096) and these kernels at
+uses the scan below ``PALLAS_AUTO_MIN_S`` (1024) and these kernels at
 or above it on a real TPU; ``attn_impl="scan"|"pallas"`` forces
 either. Inputs ride in the compute dtype (bf16 on TPU): half the
 VMEM — at S=8192 the difference between fitting and a scoped-vmem
