@@ -144,6 +144,11 @@ def _xla_throughput(create_workflow, cfg, count, epochs_per_dispatch,
     import veles.prng as prng
     prng.seed_all(99)
     cfg.decision.max_epochs = 1024
+    # patience must exceed the chunk size: XLAStep clamps even forced
+    # dispatch chunks to fail_iterations - epochs_since_best, so the
+    # sample default of 50 silently clips 64-epoch chunks (and shrinks
+    # them further as patience drains — ADVICE-grade variance)
+    cfg.decision.fail_iterations = 100000
     wf = create_workflow(name=name)
     wf.initialize(device="xla")
     loader, step = wf.loader, wf.xla_step
@@ -160,11 +165,14 @@ def xla_cifar_images_per_sec(measure_chunks=3):
     from veles.znicz_tpu.models import cifar10
     root.cifar.loader.update({"minibatch_size": 100, "n_train": 2000,
                               "n_valid": 400})
+    # 64 epochs per dispatch: the r3 pin of 16 under-amortized the
+    # per-chunk metric fetch on this small model (r4 sweep: 167k at
+    # 16, 256k at 64, flat at 128+)
     return _xla_throughput(
         cifar10.create_workflow, root.cifar,
         lambda ld: int(ld.minibatch_size)
         if ld.minibatch_class == CLASS_TRAIN else 0,
-        epochs_per_dispatch=16, name="BenchCifar",
+        epochs_per_dispatch=64, name="BenchCifar",
         measure_chunks=measure_chunks)
 
 
@@ -203,10 +211,12 @@ def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
 
 def lm_tokens_per_sec(measure_chunks=3):
     """Transformer-LM training throughput (tokens/sec) on the XLA
-    device — the north star's NEW config (BASELINE config #5)."""
+    device — the north star's NEW config (BASELINE config #5).
+    64 epochs per dispatch (r4 sweep: 13.9M tok/s at the old 8,
+    21.8M at 64 — the toy model is fetch-amortization-bound)."""
     return _lm_throughput(
         {"minibatch_size": 64, "n_train": 2048, "n_valid": 256,
-         "seq_len": 128}, {}, "BenchLM", 8, measure_chunks)
+         "seq_len": 128}, {}, "BenchLM", 64, measure_chunks)
 
 
 def lm_scale_tokens_per_sec(measure_chunks=3):
