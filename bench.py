@@ -46,6 +46,8 @@ def _build_mnist(backend, name, mb=100, n_train=6000, n_valid=1000,
     root.mnist.loader.n_valid = n_valid
     if max_epochs is not None:
         root.mnist.decision.max_epochs = max_epochs
+        # patience must exceed the dispatch chunk (see _xla_throughput)
+        root.mnist.decision.fail_iterations = 100000
     wf = mnist.create_workflow(name=name)
     wf.initialize(device=backend)
     return wf
