@@ -32,6 +32,10 @@ def alexnet_images_per_sec(n_samples=3):
         "minibatch_size": 128, "n_train": 1536, "n_valid": 256,
         "n_classes": 16})
     root.imagenet.decision.max_epochs = 1024
+    # patience must exceed warmup+measured epochs: XLAStep clamps
+    # chunks to the remaining fail_iterations (see bench.py), and the
+    # default 50 < the 56 epochs this bench dispatches
+    root.imagenet.decision.fail_iterations = 100000
     wf = imagenet.create_workflow(name="BenchAlexNet")
     wf.initialize(device="xla")
     loader, step = wf.loader, wf.xla_step
