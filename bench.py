@@ -34,6 +34,129 @@ import json
 import sys
 import time
 
+#: v5e bf16 peak (dense MXU) used for every MFU figure
+PEAK_BF16_FLOPS = 197e12
+
+
+def device_matmul_tflops(n=8192, reps_lo=16, reps_hi=80):
+    """Calibration row (VERDICT r4 #5): a fixed DEVICE-ONLY bf16
+    matmul rate, so cross-round bench tables can flag tunnel slow
+    phases (the same build measured MNIST 17.5k and 9.0k steps/s
+    hours apart — BASELINE.md round 4).
+
+    Method: chained n³ matmuls under one ``lax.scan`` dispatch — each
+    result feeds the next (independent identical dispatches get CSE'd
+    into one execution; BASELINE.md round-4 microbench pitfall) — with
+    a scalar readback as the sync point (``block_until_ready`` does
+    not block through the tunnel). The rate comes from the DIFFERENCE
+    between a ``reps_hi`` and a ``reps_lo`` run, which cancels the
+    ~100ms tunnel round-trip and any constant dispatch overhead."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    import numpy
+
+    gen = numpy.random.Generator(numpy.random.PCG64(7))
+    a = jnp.asarray(gen.standard_normal((n, n), numpy.float32),
+                    jnp.bfloat16)
+    b = jnp.asarray(gen.standard_normal((n, n), numpy.float32)
+                    / numpy.sqrt(n), jnp.bfloat16)
+
+    def chain(reps, samples=3):
+        @jax.jit
+        def run(a, b):
+            def step(c, _):
+                return jnp.matmul(
+                    c, b, preferred_element_type=jnp.bfloat16), ()
+            c, _ = lax.scan(step, a, None, length=reps)
+            return c.astype(jnp.float32).sum()
+        float(run(a, b))                   # compile + warm
+        best = float("inf")
+        for _ in range(samples):           # min-of-N: the tunnel adds
+            t0 = time.perf_counter()       # multi-second jitter spikes
+            float(run(a, b))               # scalar readback = sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    dt = chain(reps_hi) - chain(reps_lo)
+    if dt <= 0:
+        raise RuntimeError(
+            "calibration difference non-positive (%.3fs) — tunnel "
+            "jitter swamped the measurement" % dt)
+    flops = 2.0 * n ** 3 * (reps_hi - reps_lo)
+    return flops / dt / 1e12
+
+
+def lm_train_flops_per_token(dim, layers, ffn_hidden, vocab, seq):
+    """Attention-AWARE train FLOPs per token (VERDICT r4 #2 — the
+    6·N-only form under-counts long-context rows where attention
+    FLOPs rival the matmul params'):
+
+    * matmul parameters: 6 FLOPs each (2 fwd + 4 bwd) over qkv/out/
+      ffn/vocab-head weights. The EMBEDDING table is excluded — the
+      lookup is a gather, not a matmul (this makes the figures here
+      slightly stricter than round 4's 6·N_total arithmetic, which
+      credited the 12.6M-param embedding as compute);
+    * attention score/context matmuls, CAUSAL coverage: per layer per
+      sequence 6·S(S+1)·dim FLOPs (2 fwd + 4 bwd matmuls over the
+      S(S+1)/2 causal pairs) -> 6·(S+1)·dim per token per layer.
+      Causal, not the 12·L·S·d full-square form: MFU counts the
+      FLOPs a perfect implementation NEEDS. The Pallas kernels (auto
+      at S>=1024) really do skip the masked half via their fori_loop
+      bounds; the scan-flash path at shorter S computes the full
+      square and masks (a cond skip measured slower there —
+      parallel/flash.py), which simply reads as lower MFU here."""
+    n_mm = layers * (4 * dim * dim + 2 * dim * ffn_hidden) \
+        + dim * vocab
+    return 6.0 * n_mm + 6.0 * layers * (seq + 1) * dim
+
+
+#: the at-scale LM rows: ONE place for each row's loader/model config
+#: — the throughput function AND its MFU accounting both read these,
+#: so a retune cannot desynchronize the two
+LM_ROWS = {
+    "57M": (
+        {"minibatch_size": 8, "n_train": 512, "n_valid": 32,
+         "seq_len": 512, "vocab": 32, "max_period": 8},
+        {"dim": 768, "heads": 12, "layers": 8, "ffn_hidden": 3072,
+         "attn_block": 256}),
+    "57M_s8k": (
+        {"minibatch_size": 2, "n_train": 16, "n_valid": 2,
+         "seq_len": 8192, "vocab": 32, "max_period": 8},
+        {"dim": 768, "heads": 12, "layers": 8, "ffn_hidden": 3072,
+         "attn_block": 256}),
+    "110M": (
+        {"minibatch_size": 8, "n_train": 512, "n_valid": 32,
+         "seq_len": 512, "vocab": 16384, "max_period": 8},
+        {"dim": 768, "heads": 12, "layers": 12, "ffn_hidden": 3072,
+         "attn_block": 256}),
+    "110M_s8k": (
+        {"minibatch_size": 2, "n_train": 16, "n_valid": 2,
+         "seq_len": 8192, "vocab": 16384, "max_period": 8},
+        {"dim": 768, "heads": 12, "layers": 12, "ffn_hidden": 3072,
+         "attn_block": 256}),
+    "345M": (
+        {"minibatch_size": 8, "n_train": 256, "n_valid": 16,
+         "seq_len": 512, "vocab": 16384, "max_period": 8},
+        {"dim": 1024, "heads": 16, "layers": 24, "ffn_hidden": 4096,
+         "attn_block": 256}),
+}
+
+
+def _row_flops_per_token(row):
+    ld, md = LM_ROWS[row]
+    return lm_train_flops_per_token(
+        md["dim"], md["layers"], md["ffn_hidden"], ld["vocab"],
+        ld["seq_len"])
+
+
+def _mfu(extra, key, mfu_key, row):
+    """Derive an MFU figure from a recorded median tokens/sec row."""
+    if key in extra:
+        extra[mfu_key] = round(
+            extra[key] * _row_flops_per_token(row)
+            / PEAK_BF16_FLOPS, 4)
+
 
 def _build_mnist(backend, name, mb=100, n_train=6000, n_valid=1000,
                  max_epochs=None):
@@ -228,12 +351,8 @@ def lm_scale_tokens_per_sec(measure_chunks=3):
     Config is the measured round-3 optimum from the v5e sweep:
     batch 8 / attn_block 256 (248k median tok/s vs 220k at the old
     batch 16 / block 128)."""
-    return _lm_throughput(
-        {"minibatch_size": 8, "n_train": 512, "n_valid": 32,
-         "seq_len": 512, "vocab": 32, "max_period": 8},
-        {"dim": 768, "heads": 12, "layers": 8, "ffn_hidden": 3072,
-         "attn_block": 256},
-        "BenchLMScale", 4, measure_chunks)
+    return _lm_throughput(*LM_ROWS["57M"], "BenchLMScale", 4,
+                          measure_chunks)
 
 
 def lm_base_tokens_per_sec(measure_chunks=3):
@@ -242,23 +361,15 @@ def lm_base_tokens_per_sec(measure_chunks=3):
     embedding + output head; SURVEY §2.8 "Transformer-base LM" /
     VERDICT r3 weak #5 — the 8-layer 57M flagship under-read it).
     S=512, batch/attn_block from the round-4 v5e sweep."""
-    return _lm_throughput(
-        {"minibatch_size": 8, "n_train": 512, "n_valid": 32,
-         "seq_len": 512, "vocab": 16384, "max_period": 8},
-        {"dim": 768, "heads": 12, "layers": 12, "ffn_hidden": 3072,
-         "attn_block": 256},
-        "BenchLMBase", 4, measure_chunks)
+    return _lm_throughput(*LM_ROWS["110M"], "BenchLMBase", 4,
+                          measure_chunks)
 
 
 def lm_base_s8k_tokens_per_sec(measure_chunks=3):
     """The 110M transformer-base at S=8192 (long-context row, auto
     impl policy — Pallas flash takes over at this length)."""
-    return _lm_throughput(
-        {"minibatch_size": 2, "n_train": 16, "n_valid": 2,
-         "seq_len": 8192, "vocab": 16384, "max_period": 8},
-        {"dim": 768, "heads": 12, "layers": 12, "ffn_hidden": 3072,
-         "attn_block": 256},
-        "BenchLMBaseLong", 1, measure_chunks)
+    return _lm_throughput(*LM_ROWS["110M_s8k"], "BenchLMBaseLong", 1,
+                          measure_chunks)
 
 
 def lm_longctx_tokens_per_sec(measure_chunks=3):
@@ -266,12 +377,17 @@ def lm_longctx_tokens_per_sec(measure_chunks=3):
     with the AUTO impl policy — the Pallas flash kernels take over at
     this length (measured 2.6x over the XLA scan end-to-end on a v5e;
     ops/attention.py PALLAS_AUTO_MIN_S)."""
-    return _lm_throughput(
-        {"minibatch_size": 2, "n_train": 16, "n_valid": 2,
-         "seq_len": 8192, "vocab": 32, "max_period": 8},
-        {"dim": 768, "heads": 12, "layers": 8, "ffn_hidden": 3072,
-         "attn_block": 256},
-        "BenchLMLongCtx", 1, measure_chunks)
+    return _lm_throughput(*LM_ROWS["57M_s8k"], "BenchLMLongCtx", 1,
+                          measure_chunks)
+
+
+def lm_345m_tokens_per_sec(measure_chunks=3):
+    """~345M-param LM (24 layers, dim 1024, 16 heads, ffn 4096,
+    vocab 16384 — GPT-2-medium shape) at S=512: the scale-past-110M
+    row VERDICT r4 #4 asked for, batch from the round-5 v5e sweep
+    (BASELINE.md)."""
+    return _lm_throughput(*LM_ROWS["345M"], "BenchLM345M", 2,
+                          measure_chunks)
 
 
 def _record(extra, key, fn):
@@ -286,13 +402,21 @@ def _record(extra, key, fn):
 
 
 def main():
+    extra = {}
+    try:
+        # calibration FIRST: a fixed device-only matmul rate stamps
+        # which tunnel phase this whole run measured in
+        extra["calibration_matmul8k_bf16_tflops"] = round(
+            device_matmul_tflops(), 1)
+    except Exception as exc:
+        extra["calibration_error"] = str(exc)[:200]
     base = numpy_steps_per_sec()
     fast, fast_median, grad_bytes = xla_mnist_bench(measure_chunks=3)
-    extra = {
+    extra.update({
         "mnist_numpy_steps_per_sec": round(base, 2),
         "mnist_train_steps_per_sec_best": round(fast, 2),
         "grad_sync_bytes_per_step": int(grad_bytes),
-    }
+    })
     _record(extra, "cifar_conv_images_per_sec", xla_cifar_images_per_sec)
 
     def alexnet_row():
@@ -310,6 +434,20 @@ def main():
     _record(extra, "lm_110M_tokens_per_sec", lm_base_tokens_per_sec)
     _record(extra, "lm_110M_s8k_tokens_per_sec",
             lm_base_s8k_tokens_per_sec)
+    _record(extra, "lm_345M_tokens_per_sec", lm_345m_tokens_per_sec)
+    # attention-aware MFU for every at-scale LM row (VERDICT r4 #2):
+    # median tok/s x train-FLOPs/token over the v5e bf16 peak, shapes
+    # read from the SAME LM_ROWS entry the throughput row used
+    for row in LM_ROWS:
+        _mfu(extra, "lm_%s_tokens_per_sec" % row, "lm_%s_mfu" % row,
+             row)
+    try:
+        # calibration AGAIN at the end: a large start/end gap flags a
+        # tunnel phase change mid-run (BASELINE.md r4 variance note)
+        extra["calibration_matmul8k_bf16_tflops_end"] = round(
+            device_matmul_tflops(), 1)
+    except Exception as exc:
+        extra["calibration_end_error"] = str(exc)[:200]
     # which data fed each number: real on-disk datasets or the
     # synthetic stand-ins (zero-egress environments have no choice,
     # but the record keeps every figure honest — VERDICT r2 item 4)

@@ -265,6 +265,52 @@ def _quad_fitness(values):
     return (values["a/lr"] - 0.37) ** 2
 
 
+def _slow_quad_fitness(values):
+    """Same, but slower than the timeout-drop test's slave_timeout."""
+    import time
+    time.sleep(0.6)
+    return _quad_fitness(values)
+
+
+def test_ga_slave_survives_timeout_drop():
+    """A healthy slave whose evaluation outlives the master's
+    slave_timeout gets dropped (its task requeues) — it must
+    RECONNECT, re-register under a fresh id, re-report the finished
+    result, and keep serving, instead of mistaking the closed socket
+    for a finished search and exiting (ADVICE r4 medium: with every
+    evaluation longer than the timeout, a non-reconnecting pool
+    drains one task per slave into a silent livelock). One slave,
+    slave_timeout far below the evaluation time: the search can only
+    complete through the reconnect path."""
+    import threading
+    import time
+    from veles.genetics import GATaskServer, _SafeEval, ga_slave_loop
+
+    with GATaskServer("127.0.0.1:0", slave_timeout=0.25) as server:
+        addr = "127.0.0.1:%d" % server.bound_address[1]
+        t_slave = threading.Thread(
+            target=ga_slave_loop, args=(addr,),
+            kwargs={"name": "slow", "reconnect_delay": 0.05},
+            daemon=True)
+        t_slave.start()
+        done = {}
+        t_map = threading.Thread(
+            target=lambda: done.update(out=server.map(
+                _SafeEval(_slow_quad_fitness),
+                [{"a/lr": v} for v in (0.1, 0.3)])),
+            daemon=True)
+        t_map.start()
+        t_map.join(timeout=30)
+        assert not t_map.is_alive(), \
+            "map() livelocked: dropped slave never came back"
+        assert [r[0] for r in done["out"]] == [
+            pytest.approx((v - 0.37) ** 2) for v in (0.1, 0.3)]
+        # the slave really was dropped and re-registered at least once
+        assert server._next_slave > 2
+    t_slave.join(timeout=10)
+    assert not t_slave.is_alive()
+
+
 def test_ga_over_slaves_matches_sequential():
     """One GA search dispatched over TWO in-process slaves through the
     HMAC-framed task server equals the sequential run bit-for-bit
@@ -326,7 +372,8 @@ def test_ga_requeue_protocol_level():
             if server.queue or server.tasks:
                 break
             time.sleep(0.01)
-        kind, idx_a, fn_a, vals_a = server._handle(("task", sid_a))
+        kind, idx_a, fn_a, vals_a, epoch = server._handle(
+            ("task", sid_a))
         assert kind == "task"
         # slave A dies holding idx_a: it must return to the pool head
         server.drop_slave(sid_a)
@@ -338,8 +385,8 @@ def test_ga_requeue_protocol_level():
             if resp[0] != "task":
                 time.sleep(0.01)
                 continue
-            _, idx, fn_b, vals = resp
-            server._handle(("result", sid_b, idx, fn_b(vals)))
+            _, idx, fn_b, vals, ep = resp
+            server._handle(("result", sid_b, idx, fn_b(vals), ep))
         # completed tasks must not resurrect when B later drops
         server.drop_slave(sid_b)
         assert not server.queue or all(
@@ -348,6 +395,13 @@ def test_ga_requeue_protocol_level():
         assert not t.is_alive()
         assert [r[0] for r in done["out"]] == [
             pytest.approx((v - 0.37) ** 2) for v in (0.1, 0.2, 0.3)]
+        # a STALE-generation re-report (a dropped slave finishing
+        # after its generation completed) is acknowledged but
+        # discarded — it must not poison a later map()'s results
+        before = dict(server.results)
+        assert server._handle(
+            ("result", sid_b, 0, -1.0, epoch - 1)) == ("ok",)
+        assert server.results == before
 
 
 def test_ga_slave_churn_late_join_elasticity():

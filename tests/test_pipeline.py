@@ -199,6 +199,44 @@ def test_stacked_lm_1f1b_leaf_for_leaf_vs_gpipe():
                 (key, numpy.abs(a - b).max())
 
 
+def _permute_count(wf):
+    import re
+    hlo = wf.xla_step.lowered_epoch_hlo(optimized=True)
+    return len(re.findall(r"collective-permute(?:-start)?\(", hlo))
+
+
+def test_stacked_lm_1f1b_single_forward(monkeypatch):
+    """The 1F1B fold (VERDICT r4 #1) runs ONE pipelined forward per
+    train step: the loss tail folds into the fused schedule, so the
+    epoch program carries exactly as many collective-permutes as
+    GPipe — fused train schedule (permF+permB = 2) + eval forward (1)
+    = 3. The legacy double-forward fallback (unfoldable tail) pays a
+    4th: the un-stashed train forward's own permute chain."""
+    tiny = {"n_train": 32, "n_valid": 32}
+    spec = {"pipe": 4, "microbatches": 4, "schedule": "1f1b"}
+    wf = _run_stacked_lm("xla", spec, epochs=1, loader_overrides=tiny)
+    stack = next(f for f in wf.forwards
+                 if isinstance(f, TransformerBlockStack))
+    assert stack.pipe_tail is not None, \
+        "token_dense -> EvaluatorLM tail must fold"
+    assert [type(u).__name__ for u in stack.pipe_tail["units"]] == \
+        ["TokenDense"]
+    n_fold = _permute_count(wf)
+    wf_g = _run_stacked_lm("xla", {"pipe": 4, "microbatches": 4},
+                           epochs=1, loader_overrides=tiny)
+    assert n_fold == _permute_count(wf_g) == 3
+    # break the protocol -> the fold must disengage and the fallback
+    # must pay the extra forward pass (one more permute chain)
+    from veles.znicz_tpu.ops.attention import TokenDenseBase
+    monkeypatch.setattr(TokenDenseBase, "tail_fwd", None)
+    wf_fb = _run_stacked_lm("xla", spec, epochs=1,
+                            loader_overrides=tiny)
+    stack_fb = next(f for f in wf_fb.forwards
+                    if isinstance(f, TransformerBlockStack))
+    assert stack_fb.pipe_tail is None
+    assert _permute_count(wf_fb) == 4
+
+
 def test_stacked_lm_1f1b_schedule_trains_like_gpipe():
     """1F1B workflow histories track the single-device run. Gradient
     accumulation ORDER differs from GPipe (interleaved vs replay), so

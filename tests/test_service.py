@@ -99,8 +99,12 @@ class _BlobHandler:
 
             def do_GET(self):
                 name = self._name()
-                if not name:
-                    body = _json.dumps(sorted(blobs)).encode()
+                if not name or name.endswith("/"):
+                    # prefix list (S3-style): GET <prefix>/ returns
+                    # the full object paths under it
+                    body = _json.dumps(
+                        sorted(n for n in blobs
+                               if n.startswith(name))).encode()
                 elif name in blobs:
                     body = blobs[name]
                 else:
@@ -153,6 +157,14 @@ def test_snapshot_http_store_roundtrip():
         assert blobs and len(
             [n for n in blobs if n.startswith("ckpts/")]) \
             <= wf.snapshotter.keep
+        # list() works against this very endpoint shape and filters/
+        # normalizes like the file store (ADVICE r4): base-relative
+        # .ckpt. names only
+        listed = wf.snapshotter.store.list()
+        assert listed == sorted(
+            n[len("ckpts/"):] for n in blobs
+            if n.startswith("ckpts/") and ".ckpt." in n)
+        assert all("/" not in n and ".ckpt." in n for n in listed)
         state = load_snapshot(dest)
         wf2 = make_wf("SnapHTTP2", max_epochs=3)
         wf2.restore_state(state)
@@ -162,6 +174,40 @@ def test_snapshot_http_store_roundtrip():
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_snapshot_store_failure_escalates(tmp_path):
+    """Transient store failures warn and continue; a store that fails
+    ``max_store_failures`` times IN A ROW raises — a permanently dead
+    backend must not silently disable checkpointing for a whole run
+    (ADVICE r4). A success in between resets the counter."""
+    from veles.snapshotter import FileSnapshotStore
+
+    wf = make_wf("SnapFail", max_epochs=1,
+                 snapdir=str(tmp_path / "snaps"))
+    wf.run()
+    snap = wf.snapshotter
+
+    class FlakyStore(FileSnapshotStore):
+        broken = True
+
+        def stream(self, name):
+            if self.broken:
+                raise OSError("store down")
+            return super().stream(name)
+
+    snap._store = FlakyStore(str(tmp_path / "flaky"))
+    assert snap.max_store_failures == 3
+    assert snap.export_snapshot() is None
+    assert snap.export_snapshot() is None
+    snap._store.broken = False           # success resets the counter
+    assert snap.export_snapshot() is not None
+    assert snap._store_failures == 0
+    snap._store.broken = True
+    assert snap.export_snapshot() is None
+    assert snap.export_snapshot() is None
+    with pytest.raises(OSError):
+        snap.export_snapshot()
 
 
 def test_cli_end_to_end(tmp_path):

@@ -103,6 +103,13 @@ def build_argparser():
                         "6x12x4 evaluates 4 individuals concurrently "
                         "in spawned worker processes); fitness = best "
                         "validation metric")
+    p.add_argument("--slave-timeout", type=float, default=3600.0,
+                   metavar="SECONDS",
+                   help="GA master (--optimize + --listen-address): "
+                        "drop a silent slave and requeue its task "
+                        "after this long; must exceed the longest "
+                        "single evaluation (a slave is legitimately "
+                        "mute while training an individual)")
     p.add_argument("--ensemble", type=int, default=None, metavar="N",
                    help="train N differently-seeded instances and "
                         "report ensemble vs member validation error")
@@ -270,6 +277,15 @@ class Main:
         pop = parts[1] if len(parts) > 1 and parts[1] else 12
         workers = int(parts[2]) if len(parts) > 2 else 1
         if self.args.listen_address:
+            if workers > 1:
+                # refuse rather than silently discard the WORKERS
+                # component (mirrors the --master-address conflict)
+                raise SystemExit(
+                    "--optimize %r combines a workers count with "
+                    "--listen-address: registered slaves evaluate "
+                    "the individuals, so local workers would be "
+                    "ignored — drop the x%d or the --listen-address"
+                    % (self.args.optimize, workers))
             return self._optimize_distributed(
                 int(gens), int(pop), seed, slaves=True)
         if workers > 1:
@@ -304,7 +320,9 @@ class Main:
             overrides=self.args.overrides, seed=seed,
             device=self.args.device or "numpy")
         if slaves:
-            map_cm = GATaskServer(self.args.listen_address)
+            map_cm = GATaskServer(
+                self.args.listen_address,
+                slave_timeout=self.args.slave_timeout)
             print(json.dumps({"ga_master_listen":
                               "%s:%d" % map_cm.bound_address}),
                   flush=True)

@@ -357,6 +357,12 @@ class GATaskServer(Logger):
         self.tasks = {}              # idx -> (fn, values)
         self.inflight = {}           # slave_id -> idx
         self.results = {}            # idx -> result
+        #: generation guard: task frames carry the epoch of the map()
+        #: call that queued them and result frames echo it, so a
+        #: timeout-dropped slave re-reporting AFTER the generation
+        #: completed (the reconnect path) cannot poison a later
+        #: generation's fitness under the same index
+        self.map_epoch = 0
         # slave_timeout bounds a SILENT death (host power loss — no
         # FIN ever arrives): past it the handler drops the slave and
         # its task requeues. It must exceed the longest single
@@ -387,13 +393,30 @@ class GATaskServer(Logger):
                 idx = self.queue.pop(0)
                 self.inflight[request[1]] = idx
                 fn, values = self.tasks[idx]
-                return ("task", idx, fn, values)
+                return ("task", idx, fn, values, self.map_epoch)
             if kind == "result":
-                _, slave_id, idx, result = request
+                try:
+                    _, slave_id, idx, result, epoch = request
+                except ValueError:
+                    # arity skew (a slave from another build): refuse
+                    # the frame cleanly instead of killing the handler
+                    return ("error",
+                            "malformed result frame (want 5 fields, "
+                            "got %d) — mixed master/slave versions?"
+                            % len(request))
+                if epoch != self.map_epoch:
+                    # stale re-report from a generation that already
+                    # completed while the slave was dropped: discard
+                    self.warning(
+                        "discarding result for task %d from map "
+                        "epoch %d (current %d)", idx, epoch,
+                        self.map_epoch)
+                    return ("ok",)
                 if self.inflight.get(slave_id) == idx:
                     del self.inflight[slave_id]
                 self.results[idx] = result
-                self.slaves[slave_id]["tasks"] += 1
+                if slave_id in self.slaves:
+                    self.slaves[slave_id]["tasks"] += 1
                 self.results_ready.notify_all()
                 return ("ok",)
         return ("error", "unknown request %r" % (kind,))
@@ -414,6 +437,7 @@ class GATaskServer(Logger):
         (tasks of dropped slaves are requeued for the survivors).
         Results come back in population order."""
         with self.lock:
+            self.map_epoch += 1
             self.tasks = {i: (fn, v) for i, v in enumerate(values_list)}
             self.results = {}
             self.queue = list(range(len(values_list)))
@@ -447,11 +471,25 @@ class GATaskServer(Logger):
 
 
 def ga_slave_loop(address, name="ga-slave", max_tasks=None,
-                  poll=0.02, eval_lock=None):
+                  poll=0.02, eval_lock=None, reconnect_attempts=3,
+                  reconnect_delay=1.0):
     """Slave side: join the GA master at ``address``, pull tasks,
     evaluate, report — until the master says bye (or ``max_tasks``
     served, for tests). ``eval_lock`` serializes evaluation when
-    several in-process slaves share mutable globals (root config)."""
+    several in-process slaves share mutable globals (root config).
+
+    A MID-RUN connection loss is not treated as "master finished":
+    the master drops (and requeues the task of) any slave whose
+    evaluation outlives its ``slave_timeout``, and before round 5 the
+    dropped-but-healthy slave would mistake the closed socket for a
+    clean shutdown and exit permanently — with every evaluation
+    longer than the timeout, the whole pool would drain one task at a
+    time into a silent livelock (ADVICE r4). Now the slave re-dials
+    and re-registers (fresh slave id) up to ``reconnect_attempts``
+    times; only when the master no longer answers does it exit. A
+    finished evaluation is re-reported over the new connection, so
+    the work survives the drop even when the master already requeued
+    it (the result handler accepts results for any known index)."""
     import contextlib
     import socket
     import time as _time
@@ -460,34 +498,76 @@ def ga_slave_loop(address, name="ga-slave", max_tasks=None,
     host, _, port = str(address).rpartition(":")
     addr = (host or "127.0.0.1", int(port))
     require_secret_for(addr[0], "GA slave master")
-    sock = socket.create_connection(addr, timeout=30)
-    send_frame(sock, ("hello", name))
-    welcome = recv_frame(sock)
-    if welcome is None or welcome[0] != "welcome":
-        sock.close()
-        raise RuntimeError(
-            "GA master at %s:%d closed the connection during the "
-            "handshake (search already finished?)" % addr)
-    slave_id = welcome[1]
+    state = {"sock": None, "slave_id": None}
+
+    def connect(first=False):
+        sock = socket.create_connection(addr, timeout=30)
+        send_frame(sock, ("hello", name))
+        welcome = recv_frame(sock)
+        if welcome is None or welcome[0] != "welcome":
+            sock.close()
+            if first:
+                raise RuntimeError(
+                    "GA master at %s:%d closed the connection during "
+                    "the handshake (search already finished?)" % addr)
+            return False
+        state["sock"], state["slave_id"] = sock, welcome[1]
+        return True
+
+    def drop_sock():
+        if state["sock"] is not None:
+            state["sock"].close()
+            state["sock"] = None
+
+    def rpc(build_msg):
+        """send+recv with one reconnect round: ``build_msg(slave_id)``
+        so a re-registered identity is used on the retry. None =>
+        the master is genuinely gone."""
+        for _attempt in range(2):
+            if state["sock"] is None:
+                ok = False
+                for _ in range(max(1, int(reconnect_attempts))):
+                    try:
+                        ok = connect()
+                    except (ConnectionError, OSError):
+                        ok = False
+                    if ok:
+                        break
+                    _time.sleep(reconnect_delay)
+                if not ok:
+                    return None
+            try:
+                send_frame(state["sock"], build_msg(state["slave_id"]))
+                resp = recv_frame(state["sock"])
+            except (ConnectionError, OSError):
+                resp = None
+            if resp is not None:
+                return resp
+            drop_sock()
+        return None
+
+    connect(first=True)
     served = 0
     try:
         while max_tasks is None or served < max_tasks:
-            send_frame(sock, ("task", slave_id))
-            resp = recv_frame(sock)
+            resp = rpc(lambda sid: ("task", sid))
             if resp is None or resp[0] == "bye":
                 break
             if resp[0] == "wait":
                 _time.sleep(poll)
                 continue
-            _, idx, fn, values = resp
+            if resp[0] != "task" or len(resp) != 5:
+                # unknown frame (the server's ('error', msg) reply) or
+                # arity skew (a master from another build): exit
+                # cleanly instead of dying on unpack
+                break
+            _, idx, fn, values, epoch = resp
             with (eval_lock or contextlib.nullcontext()):
                 result = fn(values)
-            send_frame(sock, ("result", slave_id, idx, result))
-            if recv_frame(sock) is None:
+            if rpc(lambda sid: ("result", sid, idx, result,
+                                epoch)) is None:
                 break
             served += 1
-    except (ConnectionError, OSError):
-        pass            # master finished and closed: a clean exit
     finally:
-        sock.close()
+        drop_sock()
     return served

@@ -180,7 +180,22 @@ class HTTPSnapshotStore(SnapshotStore):
             raise
 
     def list(self):
-        return sorted(json.loads(self._request("GET").read().decode()))
+        """``GET <base>/`` -> JSON array. Servers may return names
+        relative to the base or full object paths (an S3-style lister
+        returns key prefixes) — both are accepted, normalized to
+        base-relative names and filtered to ``.ckpt.`` blobs exactly
+        like :meth:`FileSnapshotStore.list` (tests/test_service.py
+        covers the round-trip against the reference blob server)."""
+        from urllib.parse import urlsplit
+        names = json.loads(self._request("GET").read().decode())
+        prefix = urlsplit(self.base_url).path.lstrip("/")
+        out = []
+        for n in names:
+            if prefix and n.startswith(prefix + "/"):
+                n = n[len(prefix) + 1:]
+            if ".ckpt." in n:
+                out.append(n)
+        return sorted(out)
 
     def delete(self, name):
         import urllib.error
@@ -226,6 +241,13 @@ class SnapshotterBase(Unit):
         self.decision = None
         self.destination = None      # last written path/URI
         self._written = []
+        #: consecutive store-write failures; at ``max_store_failures``
+        #: the next failure RAISES instead of warning — a permanently
+        #: broken backend (dead endpoint, full disk) must not let a
+        #: long run finish with stale or no checkpoints and nothing
+        #: but warnings in the log (ADVICE r4)
+        self._store_failures = 0
+        self.max_store_failures = 3
         #: directory to (re)write the C++ inference archive into on
         #: every improved snapshot — the deployable artifact always
         #: tracks the best checkpoint (reference export-on-snapshot
@@ -261,8 +283,8 @@ class SnapshotterBase(Unit):
         # compress THROUGH the store's stream: file stores get the
         # old direct-to-disk write (no second in-memory copy of the
         # blob); buffering stores (HTTP) collect and put once
-        sp = self.store.stream(name)
         try:
+            sp = self.store.stream(name)
             with sp as sink:
                 if self.compression:
                     with _OPENERS[self.compression](sink, "wb") as f:
@@ -270,11 +292,24 @@ class SnapshotterBase(Unit):
                 else:
                     sink.write(blob.getvalue())
         except Exception as exc:
-            # a checkpoint is auxiliary: a transient store failure
+            # a checkpoint is auxiliary: a TRANSIENT store failure
             # (remote 503, full disk) must not kill hours of training
-            self.warning("snapshot %s NOT written (%s: %s) — training "
-                         "continues", name, type(exc).__name__, exc)
+            # — but a store that fails every time has silently
+            # disabled checkpointing, which a run owner must hear
+            # about louder than log warnings
+            self._store_failures += 1
+            if self._store_failures >= self.max_store_failures:
+                self.error(
+                    "snapshot store failed %d times in a row — "
+                    "checkpointing is effectively disabled",
+                    self._store_failures)
+                raise
+            self.warning("snapshot %s NOT written (%s: %s; failure "
+                         "%d/%d) — training continues", name,
+                         type(exc).__name__, exc, self._store_failures,
+                         self.max_store_failures)
             return None
+        self._store_failures = 0
         path = sp.uri
         self.destination = path
         # same-suffix rewrites refresh their retention slot

@@ -87,6 +87,26 @@ class TokenDenseBase(Forward):
                               ctx.dot)
                 .astype(ctx.act_dtype))
 
+    # -- loss-tail protocol (the 1F1B fold) ---------------------------
+    # ops/transformer_stack.py replays the units BETWEEN the block
+    # stack and the evaluator per microbatch inside the fused 1F1B
+    # schedule (as the last-stage err_fn), so the schedule needs this
+    # unit's forward and input-gradient as pure functions. Weight
+    # gradients are NOT computed here — the unit's own GD does that
+    # once, full-batch, outside the schedule.
+
+    def tail_fwd(self, xp, x, p, dot):
+        """Pure forward over explicit params (same math as xla_run)."""
+        return self._forward(xp, x, p["weights"], p.get("bias"), dot)
+
+    def tail_bwd(self, xp, y, p, err, dot):
+        """Input gradient given this unit's OUTPUT ``y`` (the
+        activation derivative is output-expressed, znicz style — see
+        GDTokenDenseBase._backward, whose dx arm this mirrors)."""
+        d = A.ACTIVATIONS[self.ACTIVATION][1](xp, y)
+        dz = err if isinstance(d, float) else err * d
+        return dot(dz, p["weights"].T)
+
 
 @forward_unit("token_dense")
 class TokenDense(TokenDenseBase):

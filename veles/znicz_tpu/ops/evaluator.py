@@ -220,6 +220,27 @@ class EvaluatorLM(EvaluatorBase):
         wrong = ((pred != labels) & (rowmask[:, None] > 0)).sum()
         return err, loss, wrong
 
+    @staticmethod
+    def mb_loss_grad(xp, logits, labels, inv_denom):
+        """Per-MICROBATCH fused softmax-CE gradient with the full-batch
+        normalization baked in (``inv_denom`` = 1/(valid·S) of the
+        whole minibatch): summing the returned (err, loss) over all
+        microbatches reproduces :meth:`_compute` exactly. Rows whose
+        labels carry the ``-1`` pad sentinel contribute nothing — the
+        1F1B fold (ops/transformer_stack.py) marks invalid rows that
+        way because the row/valid comparison needs global row indices
+        a microbatch slice no longer has."""
+        vocab = logits.shape[-1]
+        z = logits - logits.max(axis=-1, keepdims=True)
+        logp = z - xp.log(xp.exp(z).sum(axis=-1, keepdims=True))
+        probs = xp.exp(logp)
+        onehot = (labels[..., None] ==
+                  xp.arange(vocab)[None, None, :]).astype(logits.dtype)
+        mask = (labels >= 0).astype(logits.dtype)
+        err = (probs - onehot) * mask[..., None] * inv_denom
+        loss = -((logp * onehot).sum(axis=-1) * mask).sum() * inv_denom
+        return err, loss
+
     def numpy_run(self):
         logits = self.input.map_read().mem.astype(numpy.float32)
         labels = numpy.asarray(self.labels.map_read().mem,

@@ -56,14 +56,23 @@ class TransformerBlockStack(Forward):
         #: 'pipe' axis routes fwd/bwd through the pipeline schedule
         #: named by pipe_schedule — "gpipe" (forward stashes all M
         #: microbatch caches, backward replays them) or "1f1b"
-        #: (forward skips the stash; the GD unit reruns the fused
-        #: PipeDream-flush schedule, rematerializing forwards, peak
-        #: stash min(M, P-s) per stage)
+        #: (PipeDream-flush: with a foldable loss tail — see
+        #: ``pipe_tail`` — the TRAIN forward runs the whole fused
+        #: interleaved schedule, ONE forward per microbatch, peak
+        #: stash min(M, P-s) per stage; without one, the forward runs
+        #: un-stashed and the GD unit reruns the schedule — the legacy
+        #: double-forward fallback)
         self.pipe_mesh = None
         self.pipe_axis = "pipe"
         self.pipe_batch_axis = None
         self.pipe_microbatches = 4
         self.pipe_schedule = "gpipe"
+        #: {"units": [...], "evaluator": ev} — the forwards BETWEEN
+        #: this stack and the evaluator, when every one implements the
+        #: tail_fwd/tail_bwd protocol and the evaluator provides
+        #: mb_loss_grad (set by setup_pipeline_parallel for 1F1B; the
+        #: VERDICT r4 #1 single-forward fold)
+        self.pipe_tail = None
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -126,19 +135,76 @@ class TransformerBlockStack(Forward):
         self.output.mem[...] = x
         self._cache = caches
 
+    def _fused_1f1b(self, ctx, p, x):
+        """TRAIN-time 1F1B with the loss folded in: run the fused
+        interleaved schedule with the downstream loss tail (vocab
+        projection → softmax-CE gradient) as the last-stage err_fn —
+        ONE pipelined forward per train step (VERDICT r4 #1). The
+        gradient math mirrors the unfused chain cast-for-cast
+        (act_dtype between units, f32 loss/LN math), so GPipe
+        leaf-for-leaf parity holds to float tolerance. Returns y;
+        stashes (dx, grads) in the trace context for the GD unit."""
+        import jax.numpy as jnp
+        ev = self.pipe_tail["evaluator"]
+        tails = self.pipe_tail["units"]
+        labels = ctx.get(ev, "labels").astype(jnp.int32)
+        valid = ctx.get(ev, "batch_size")
+        # global row validity must ride the labels into the schedule:
+        # a microbatch slice no longer knows its global row offset, so
+        # invalid (pad) rows are marked with a -1 sentinel instead
+        rowmask = jnp.arange(labels.shape[0]) < valid
+        labels_m = jnp.where(rowmask[:, None], labels, -1)
+        inv_denom = 1.0 / (valid.astype(jnp.float32)
+                           * numpy.float32(labels.shape[1]))
+        aux = {"tail": [ctx.unit_params(u) for u in tails],
+               "inv_denom": inv_denom}
+        act_dtype = ctx.act_dtype
+        dot = ctx.dot
+
+        def err_fn(y_mb, lbl_mb, a):
+            h = y_mb.astype(act_dtype)
+            ys = []
+            for u, tp in zip(tails, a["tail"]):
+                h = u.tail_fwd(jnp, h, tp, dot).astype(act_dtype)
+                ys.append(h)
+            derr, mb_loss = ev.mb_loss_grad(
+                jnp, h.astype(jnp.float32), lbl_mb, a["inv_denom"])
+            e = derr.astype(act_dtype)
+            for u, tp, yy in zip(reversed(tails),
+                                 reversed(a["tail"]), reversed(ys)):
+                e = u.tail_bwd(jnp, yy, tp, e, dot).astype(act_dtype)
+            return e.astype(jnp.float32), mb_loss
+
+        y, dx, grads, _loss = PL.pipeline_1f1b_step(
+            p, x, labels_m, err_fn, self.pipe_mesh,
+            axis=self.pipe_axis, batch_axis=self.pipe_batch_axis,
+            n_micro=self.pipe_microbatches, heads=self.heads,
+            causal=self.causal, eps=self.eps, dot=ctx.dot,
+            es=ctx.einsum, aux=aux)
+        # err_fn bakes the GLOBAL 1/(valid·S) denominator in, so the
+        # summed grads/dx already match the full-batch convention — no
+        # n_micro/dp rescale (pipeline_1f1b_step docstring)
+        ctx.set(self, "fused_1f1b", (dx, grads))
+        return y
+
     def xla_run(self, ctx):
         import jax.numpy as jnp
         x = ctx.get(self, "input")
         p = ctx.unit_params(self)
         if self.pipe_mesh is not None and self.pipe_schedule == "1f1b":
-            # no stash: the GD unit reruns the fused 1F1B schedule
-            # and rematerializes its forwards there
-            y = PL.pipeline_fwd(
-                p, x, self.pipe_mesh, axis=self.pipe_axis,
-                batch_axis=self.pipe_batch_axis,
-                n_micro=self.pipe_microbatches, heads=self.heads,
-                causal=self.causal, eps=self.eps, dot=ctx.dot,
-                stash=False)
+            if ctx.train and self.pipe_tail is not None:
+                y = self._fused_1f1b(ctx, p, x)
+            else:
+                # eval, or an unfoldable loss tail: un-stashed forward
+                # (the GD unit then reruns the fused schedule and
+                # rematerializes its forwards there — double-forward
+                # fallback)
+                y = PL.pipeline_fwd(
+                    p, x, self.pipe_mesh, axis=self.pipe_axis,
+                    batch_axis=self.pipe_batch_axis,
+                    n_micro=self.pipe_microbatches, heads=self.heads,
+                    causal=self.causal, eps=self.eps, dot=ctx.dot,
+                    stash=False)
             caches = ()
         elif self.pipe_mesh is not None:
             y, caches = PL.pipeline_fwd(
@@ -191,9 +257,15 @@ class GDTransformerBlockStack(GradientDescentBase):
         err = ctx.get(self, "err_output").reshape(x.shape)
         p = ctx.unit_params(f)
         caches = ctx.get(f, "cache_stack")
-        if f.pipe_mesh is not None and f.pipe_schedule == "1f1b":
-            # fused 1F1B (PipeDream-flush): rerun forwards interleaved
-            # with backwards per the static schedule. The loss gradient
+        if f.pipe_mesh is not None and f.pipe_schedule == "1f1b" \
+                and ctx.get(f, "fused_1f1b") is not None:
+            # the forward unit already ran the WHOLE fused schedule
+            # (loss folded in as the last-stage err_fn — one pipelined
+            # forward); just consume its dx/grads
+            dx, grads = ctx.get(f, "fused_1f1b")
+        elif f.pipe_mesh is not None and f.pipe_schedule == "1f1b":
+            # unfoldable loss tail: rerun forwards interleaved with
+            # backwards per the static schedule. The loss gradient
             # already exists (the evaluator computed it from the
             # forward unit's output with full-batch normalization), so
             # err_fn just hands each microbatch its slice — which is

@@ -277,11 +277,15 @@ def setup_pipeline_parallel(workflow, mesh, axis="pipe",
 
     ``schedule``: ``"gpipe"`` (forward stashes all M microbatch
     caches; backward replays them — peak stash M per stage) or
-    ``"1f1b"`` (PipeDream-flush: the forward unit skips the stash and
-    the GD unit reruns the fused interleaved schedule, rematerializing
-    forwards — peak stash min(M, P-s) caches at stage s, at the cost
-    of a second forward pass, the standard recompute trade). Both are
-    leaf-for-leaf parity-tested through the workflow
+    ``"1f1b"`` (PipeDream-flush, peak stash min(M, P-s) caches at
+    stage s). When every forward unit between the stack and the
+    evaluator implements the tail_fwd/tail_bwd protocol and the
+    evaluator provides ``mb_loss_grad`` (the stacked LM's token_dense
+    → EvaluatorLM tail does), 1F1B folds the loss into the fused
+    schedule as the last-stage err_fn and the train step runs ONE
+    pipelined forward; otherwise it falls back to an un-stashed
+    forward plus a rematerializing fused backward (two forwards).
+    Both are leaf-for-leaf parity-tested through the workflow
     (tests/test_pipeline.py).
 
     ``batch_axis`` names the mesh axis the batch is sharded over when
@@ -317,6 +321,28 @@ def setup_pipeline_parallel(workflow, mesh, axis="pipe",
         fwd.pipe_batch_axis = batch_axis
         fwd.pipe_microbatches = int(microbatches)
         fwd.pipe_schedule = schedule
+        fwd.pipe_tail = None
+        if schedule == "1f1b":
+            # single-forward fold: the units between the stack and the
+            # evaluator become the fused schedule's last-stage err_fn
+            # when they all speak the loss-tail protocol
+            tail = list(workflow.forwards[i + 1:])
+            ev = getattr(workflow, "evaluator", None)
+            foldable = (
+                ev is not None
+                and callable(getattr(ev, "mb_loss_grad", None))
+                and all(callable(getattr(u, "tail_fwd", None))
+                        and callable(getattr(u, "tail_bwd", None))
+                        for u in tail))
+            if foldable:
+                fwd.pipe_tail = {"units": tail, "evaluator": ev}
+            else:
+                fwd.warning(
+                    "1F1B loss tail %s -> %s is not foldable; the "
+                    "train step will pay a second (un-stashed) "
+                    "forward pass",
+                    [type(u).__name__ for u in tail],
+                    type(ev).__name__ if ev is not None else None)
         gd = workflow.gds[i] if i < len(workflow.gds) else None
         sh = NamedSharding(mesh, P(axis))
         for key in fwd.PARAMS:

@@ -423,16 +423,18 @@ def build_1f1b_schedule(n_stage, n_micro):
             numpy.asarray(bidx, numpy.int32))
 
 
-def _pipeline_1f1b_local(params, x_loc, tgt_loc, schedule, err_fn,
-                         *, axis_name, n_stage, n_micro, heads,
-                         causal, eps, batch_axis=None, dot=None,
-                         es=None):
+def _pipeline_1f1b_local(params, x_loc, tgt_loc, aux, schedule,
+                         err_fn, *, axis_name, n_stage, n_micro,
+                         heads, causal, eps, batch_axis=None,
+                         dot=None, es=None, has_aux=False):
     """Per-device 1F1B train-segment: forwards AND backwards interleave
     per the static schedule; the LAST stage turns each finished
-    forward into its loss gradient via ``err_fn(y_mb, tgt_mb)`` so a
-    microbatch's backward starts P-s ticks after its forward instead
-    of after the whole forward phase. Returns (y_loc, dx_loc, grads,
-    loss_sum)."""
+    forward into its loss gradient via ``err_fn(y_mb, tgt_mb[, aux])``
+    so a microbatch's backward starts P-s ticks after its forward
+    instead of after the whole forward phase. ``err_fn`` is evaluated
+    under a ``lax.cond`` on the last stage only — a loss head of real
+    size (e.g. a vocab projection) costs nothing on the other P-1
+    stages. Returns (y_loc, dx_loc, grads, loss_sum)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -477,13 +479,26 @@ def _pipeline_1f1b_local(params, x_loc, tgt_loc, schedule, err_fn,
                 lambda buf, c: lax.dynamic_update_index_in_dim(
                     buf, c, fm % depth, 0),
                 caches, cache)
-            # last stage: the microbatch's loss gradient, immediately
+            # last stage: the microbatch's loss gradient, immediately.
+            # cond, not where: only the last stage PAYS for the loss
+            # head (err_fn may contain a full vocab projection)
             tgt = lax.dynamic_index_in_dim(tgt_mb, fm, 0,
                                            keepdims=False)
-            derr, mb_loss = err_fn(y, tgt)
+            is_last = stage == n_stage - 1
+
+            def loss_grad(_):
+                de, lo = err_fn(y, tgt, aux) if has_aux \
+                    else err_fn(y, tgt)
+                return de.astype(jnp.float32), lo.astype(jnp.float32)
+
+            def no_loss(_):
+                return (jnp.zeros((bm, s, d), jnp.float32),
+                        jnp.float32(0.0))
+
+            derr, mb_loss = lax.cond(is_last, loss_grad, no_loss,
+                                     operand=None)
             derrs = lax.dynamic_update_index_in_dim(
                 derrs, derr, fm % depth, 0)
-            is_last = stage == n_stage - 1
             outs = jnp.where(
                 is_last,
                 lax.dynamic_update_index_in_dim(outs, y, fm, 0), outs)
@@ -569,12 +584,23 @@ def _pipeline_1f1b_local(params, x_loc, tgt_loc, schedule, err_fn,
 
 def pipeline_1f1b_step(params, x, targets, err_fn, mesh, axis="pipe",
                        batch_axis=None, n_micro=4, heads=4,
-                       causal=True, eps=1e-5, dot=None, es=None):
+                       causal=True, eps=1e-5, dot=None, es=None,
+                       aux=None):
     """One 1F1B training segment over ``mesh[axis]``: forward, per-
     microbatch loss gradient (``err_fn(y_mb, tgt_mb) -> (derr_mb,
-    loss_scalar)`` — traced on every stage, consumed on the last), and
-    interleaved backward in ONE schedule. Returns (y, dx, grads,
-    loss_sum); grads leaves (L, ...) stage-sharded like params.
+    loss_scalar)`` — evaluated on the last stage only, under a
+    ``lax.cond``), and interleaved backward in ONE schedule. Returns
+    (y, dx, grads, loss_sum); grads leaves (L, ...) stage-sharded like
+    params.
+
+    ``aux``: optional pytree of REPLICATED extras (loss-head weights,
+    a precomputed 1/denominator, ...) shipped into the shard_map and
+    handed to ``err_fn(y_mb, tgt_mb, aux)``. Tracer-safe — closures
+    over jit-level values inside ``err_fn`` are not (shard_map rejects
+    closed-over tracers); everything traced must ride ``aux`` or
+    ``targets``. The workflow's 1F1B fold (ops/transformer_stack.py)
+    uses this to run the vocab projection + softmax-CE gradient as the
+    last-stage err_fn — ONE pipelined forward per train step.
 
     SCALING CONVENTION — sums, never means: grads and loss are summed
     over the ``n_micro`` microbatches and (with ``batch_axis``) psum'd
@@ -586,9 +612,11 @@ def pipeline_1f1b_step(params, x, targets, err_fn, mesh, axis="pipe",
     dx through the microbatch-local mean denominator (1/bm vs 1/B).
     Divide by that factor (or fold ``1/(n_micro*dp)`` into ``err_fn``)
     before feeding an optimizer; tests/test_pipeline.py's 1F1B parity
-    check shows the exact rescale. Kept as a sum because the right
-    normalization lives with the loss definition, not the schedule —
-    same convention as ``pipeline_train_step`` (GPipe).
+    check shows the exact rescale. An ``err_fn`` that bakes the GLOBAL
+    denominator in (the workflow fold does) needs no rescale at all.
+    Kept as a sum because the right normalization lives with the loss
+    definition, not the schedule — same convention as
+    ``pipeline_train_step`` (GPipe).
 
     Peak stash: ``n_stage`` microbatch caches per stage vs GPipe's
     ``n_micro`` — the 1F1B memory bound (docs/PARALLELISM.md has the
@@ -603,11 +631,16 @@ def pipeline_1f1b_step(params, x, targets, err_fn, mesh, axis="pipe",
     pspec = jax.tree_util.tree_map(lambda _: P(axis), params)
     xspec = P(batch_axis, None, None)
     tspec = P(*([batch_axis] + [None] * (targets.ndim - 1)))
+    has_aux = aux is not None
+    aux_tree = aux if has_aux else {}
+    aspec = jax.tree_util.tree_map(lambda _: P(), aux_tree)
     fn = functools.partial(
         _pipeline_1f1b_local, schedule=schedule, err_fn=err_fn,
         axis_name=axis, n_stage=n_stage, n_micro=n_micro, heads=heads,
-        causal=causal, eps=eps, batch_axis=batch_axis, dot=dot, es=es)
+        causal=causal, eps=eps, batch_axis=batch_axis, dot=dot, es=es,
+        has_aux=has_aux)
     sm = _shard_map()
     return sm(
-        fn, mesh=mesh, in_specs=(pspec, xspec, tspec),
-        out_specs=(xspec, xspec, pspec, P()))(params, x, targets)
+        fn, mesh=mesh, in_specs=(pspec, xspec, tspec, aspec),
+        out_specs=(xspec, xspec, pspec, P()))(params, x, targets,
+                                              aux_tree)
