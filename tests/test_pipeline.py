@@ -57,6 +57,69 @@ def test_stack_backward_vs_jax_grad(cls, kwargs):
         assert numpy.allclose(w0 - w1_x, oracle, atol=5e-4), pname
 
 
+def test_stack_remat_matches_plain():
+    """The remat path (stash layer inputs, recompute caches in the
+    backward — VERDICT r4 #3) is numerically identical to the full-
+    stash scan: same y, dx, every grad leaf; and its stash really is
+    just the (L, B, S, D) inputs, not the O(L·B·H·S²) cache tree."""
+    import jax
+    import jax.numpy as jnp
+
+    prng.seed_all(91)
+    gen = prng.get("remat")
+    L, B, S, D, H, heads = 3, 2, 8, 16, 32, 4
+    x = gen.normal(0, 1.0, (B, S, D)).astype(numpy.float32)
+    err = gen.normal(0, 1.0, (B, S, D)).astype(numpy.float32)
+    params = {}
+    shapes = {"weights": (L, D, 3 * D), "bias": (L, 3 * D),
+              "weights_out": (L, D, D), "bias_out": (L, D),
+              "ln1_g": (L, D), "ln1_b": (L, D),
+              "ffn_w1": (L, D, H), "ffn_b1": (L, H),
+              "ffn_w2": (L, H, D), "ffn_b2": (L, D),
+              "ln2_g": (L, D), "ln2_b": (L, D)}
+    for k, shp in shapes.items():
+        if k.endswith("_g"):
+            params[k] = numpy.ones(shp, numpy.float32)
+        elif "bias" in k or k.endswith("_b"):
+            params[k] = numpy.zeros(shp, numpy.float32)
+        else:
+            params[k] = gen.normal(0, 0.3, shp).astype(numpy.float32)
+    y0, caches = jax.jit(lambda p, xx: PL.stack_fwd(
+        p, xx, heads, True, 1e-5))(params, x)
+    dx0, g0 = jax.jit(lambda p, c, e: PL.stack_bwd(
+        p, c, e, heads, 1e-5))(params, caches, err)
+    y1, xs = jax.jit(lambda p, xx: PL.stack_fwd_remat(
+        p, xx, heads, True, 1e-5))(params, x)
+    dx1, g1 = jax.jit(lambda p, c, e: PL.stack_bwd_remat(
+        p, c, e, heads, True, 1e-5))(params, xs, err)
+    assert xs.shape == (L, B, S, D)       # inputs only, no cache tree
+    assert numpy.allclose(numpy.asarray(y0), numpy.asarray(y1),
+                          atol=1e-6)
+    assert numpy.allclose(numpy.asarray(dx0), numpy.asarray(dx1),
+                          atol=1e-5)
+    for k in g0:
+        assert numpy.allclose(numpy.asarray(g0[k]),
+                              numpy.asarray(g1[k]), atol=1e-5), k
+
+
+def test_stacked_lm_remat_trains_identically():
+    """root.lm.model.remat through the workflow: identical training
+    history to the full-stash run (remat is a memory knob, not a math
+    change)."""
+    h0 = [e["validation"]["metric"] for e in
+          _run_stacked_lm("xla", epochs=3).decision.history]
+    root.lm.model.remat = True
+    try:
+        wf = _run_stacked_lm("xla", epochs=3)
+    finally:
+        root.lm.model.remat = False
+    stack = next(f for f in wf.forwards
+                 if isinstance(f, TransformerBlockStack))
+    assert stack.remat
+    h1 = [e["validation"]["metric"] for e in wf.decision.history]
+    assert numpy.allclose(h0, h1, atol=1e-4), (h0, h1)
+
+
 def _mesh(axes):
     import jax
     from veles.znicz_tpu import parallel

@@ -407,10 +407,14 @@ class GATaskServer(Logger):
                 if epoch != self.map_epoch:
                     # stale re-report from a generation that already
                     # completed while the slave was dropped: discard
+                    # (and release any stale in-flight claim so a
+                    # later drop cannot requeue an old index)
                     self.warning(
                         "discarding result for task %d from map "
                         "epoch %d (current %d)", idx, epoch,
                         self.map_epoch)
+                    if self.inflight.get(slave_id) == idx:
+                        del self.inflight[slave_id]
                     return ("ok",)
                 if self.inflight.get(slave_id) == idx:
                     del self.inflight[slave_id]
@@ -441,6 +445,9 @@ class GATaskServer(Logger):
             self.tasks = {i: (fn, v) for i, v in enumerate(values_list)}
             self.results = {}
             self.queue = list(range(len(values_list)))
+            # stale in-flight entries are PREVIOUS-generation indices;
+            # a later drop_slave must not requeue them into this one
+            self.inflight.clear()
         with self.results_ready:
             while len(self.results) < len(self.tasks):
                 self.results_ready.wait(timeout=0.5)

@@ -193,6 +193,11 @@ class HTTPSnapshotStore(SnapshotStore):
         for n in names:
             if prefix and n.startswith(prefix + "/"):
                 n = n[len(prefix) + 1:]
+            if "/" in n:
+                # a full-bucket lister may return keys OUTSIDE this
+                # base (another run's prefix): never surface foreign
+                # checkpoints as ours
+                continue
             if ".ckpt." in n:
                 out.append(n)
         return sorted(out)

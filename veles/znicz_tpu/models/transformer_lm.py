@@ -39,11 +39,15 @@ root.lm.update({
     # kernels (parallel/pallas_attention.py). pallas_tile: explicit
     # kernel tile override (None = measured auto, up to 512 — the
     # VMEM escape hatch for large head dims)
+    # remat (with stacked=True): activation-checkpoint the block scan
+    # — stash only layer inputs, recompute caches in the backward;
+    # ~+1/3 compute for an O(heads*seq/12) stash cut (the (B, S)
+    # envelope knob for the stacked path; docs/PARALLELISM.md)
     "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128,
               "attn_block": None, "attn_impl": None,
               "pallas_tile": None, "moe_experts": 0,
               "moe_capacity_factor": 2.0, "moe_aux_weight": 0.01,
-              "stacked": False},
+              "stacked": False, "remat": False},
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
               "weights_decay": 0.0},
     "decision": {"max_epochs": 8, "fail_iterations": 50},
@@ -204,7 +208,8 @@ def build_layers():
         layers += [
             {"type": "transformer_stack",
              "->": {"layers": m.layers, "heads": m.heads,
-                    "hidden": m.ffn_hidden, "causal": True},
+                    "hidden": m.ffn_hidden, "causal": True,
+                    "remat": bool(m.get("remat"))},
              "<-": dict(t)},
             {"type": "token_dense",
              "->": {"output_features": root.lm.loader.vocab},

@@ -204,13 +204,24 @@ class EvaluatorLM(EvaluatorBase):
         super().__init__(workflow, **kwargs)
         self.labels = None          # linked: loader.minibatch_labels
 
-    def _compute(self, xp, logits, labels, valid):
-        b, s, vocab = logits.shape
+    @staticmethod
+    def _softmax_ce_core(xp, logits, labels):
+        """The ONE stable softmax-CE kernel (max-shift, logp, probs,
+        onehot) shared by the full-batch ``_compute`` and the 1F1B
+        fold's per-microbatch ``mb_loss_grad`` — their parity contract
+        (summed microbatch grads == full-batch grads) rides on the
+        numerics living in exactly one place."""
+        vocab = logits.shape[-1]
         z = logits - logits.max(axis=-1, keepdims=True)
         logp = z - xp.log(xp.exp(z).sum(axis=-1, keepdims=True))
         probs = xp.exp(logp)
         onehot = (labels[..., None] ==
                   xp.arange(vocab)[None, None, :]).astype(logits.dtype)
+        return logp, probs, onehot
+
+    def _compute(self, xp, logits, labels, valid):
+        b, s, vocab = logits.shape
+        logp, probs, onehot = self._softmax_ce_core(xp, logits, labels)
         rowmask = (xp.arange(b) < valid).astype(logits.dtype)
         denom = valid.astype(logits.dtype) * float(s)
         err = (probs - onehot) * rowmask[:, None, None] / denom
@@ -230,12 +241,8 @@ class EvaluatorLM(EvaluatorBase):
         1F1B fold (ops/transformer_stack.py) marks invalid rows that
         way because the row/valid comparison needs global row indices
         a microbatch slice no longer has."""
-        vocab = logits.shape[-1]
-        z = logits - logits.max(axis=-1, keepdims=True)
-        logp = z - xp.log(xp.exp(z).sum(axis=-1, keepdims=True))
-        probs = xp.exp(logp)
-        onehot = (labels[..., None] ==
-                  xp.arange(vocab)[None, None, :]).astype(logits.dtype)
+        logp, probs, onehot = EvaluatorLM._softmax_ce_core(
+            xp, logits, labels)
         mask = (labels >= 0).astype(logits.dtype)
         err = (probs - onehot) * mask[..., None] * inv_denom
         loss = -((logp * onehot).sum(axis=-1) * mask).sum() * inv_denom

@@ -40,7 +40,7 @@ class TransformerBlockStack(Forward):
               "ffn_b2", "ln2_g", "ln2_b")
 
     def __init__(self, workflow, layers=None, heads=4, hidden=None,
-                 causal=True, eps=1e-5, **kwargs):
+                 causal=True, eps=1e-5, remat=False, **kwargs):
         super().__init__(workflow, **kwargs)
         if not layers:
             raise ValueError("transformer_stack needs layers >= 1")
@@ -49,6 +49,15 @@ class TransformerBlockStack(Forward):
         self.hidden = hidden
         self.causal = causal
         self.eps = float(eps)
+        #: activation checkpointing for the SINGLE-PROGRAM scan path:
+        #: stash only layer inputs (L,B,S,D) and recompute each
+        #: block's cache in the backward, instead of stashing the full
+        #: cache whose O(L·B·H·S²) probs leaf caps (B, S) — ~+⅓
+        #: compute for an O(H·S/12)-fold stash cut (measured envelope
+        #: in docs/PARALLELISM.md). Ignored under pipeline parallelism
+        #: (the schedules own their stash policy; 1F1B already bounds
+        #: it at min(M, P-s) microbatches)
+        self.remat = bool(remat)
         from veles.memory import Array
         for name in self.PARAMS[2:]:
             setattr(self, name, Array())
@@ -189,7 +198,12 @@ class TransformerBlockStack(Forward):
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
-        x = ctx.get(self, "input")
+        # f32 at the scan boundary: the carry must keep one dtype
+        # across layers (block_fwd emits f32), but under the bf16
+        # activation policy the incoming tensor is bf16 — without the
+        # cast the lax.scan carry type-mismatches on TPU (the f32 CPU
+        # suite can't see this)
+        x = ctx.get(self, "input").astype(jnp.float32)
         p = ctx.unit_params(self)
         if self.pipe_mesh is not None and self.pipe_schedule == "1f1b":
             if ctx.train and self.pipe_tail is not None:
@@ -212,6 +226,9 @@ class TransformerBlockStack(Forward):
                 batch_axis=self.pipe_batch_axis,
                 n_micro=self.pipe_microbatches, heads=self.heads,
                 causal=self.causal, eps=self.eps, dot=ctx.dot)
+        elif self.remat:
+            y, caches = PL.stack_fwd_remat(
+                p, x, self.heads, self.causal, self.eps, ctx.dot)
         else:
             y, caches = PL.stack_fwd(p, x, self.heads, self.causal,
                                      self.eps, ctx.dot)
@@ -253,8 +270,10 @@ class GDTransformerBlockStack(GradientDescentBase):
     def xla_run(self, ctx):
         import jax.numpy as jnp
         f = self.forward
-        x = ctx.get(f, "input")
-        err = ctx.get(self, "err_output").reshape(x.shape)
+        x = ctx.get(f, "input").astype(jnp.float32)
+        # f32 for the same scan-carry reason as the forward unit
+        err = ctx.get(self, "err_output").reshape(x.shape) \
+            .astype(jnp.float32)
         p = ctx.unit_params(f)
         caches = ctx.get(f, "cache_stack")
         if f.pipe_mesh is not None and f.pipe_schedule == "1f1b" \
@@ -286,6 +305,12 @@ class GDTransformerBlockStack(GradientDescentBase):
                 batch_axis=f.pipe_batch_axis,
                 n_micro=f.pipe_microbatches, heads=f.heads, eps=f.eps,
                 dot=ctx.dot, es=ctx.einsum)
+        elif f.remat:
+            # caches here are the stashed layer INPUTS; the reverse
+            # scan recomputes each block's cache before block_bwd
+            dx, grads = PL.stack_bwd_remat(
+                p, caches, err, f.heads, f.causal, f.eps, ctx.dot,
+                ctx.einsum)
         else:
             dx, grads = PL.stack_bwd(p, caches, err, f.heads, f.eps,
                                      ctx.dot, ctx.einsum)

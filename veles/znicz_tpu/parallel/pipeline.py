@@ -156,6 +156,52 @@ def stack_bwd(params, caches, err, heads, eps, dot=None, es=None):
 
 
 # ---------------------------------------------------------------------------
+# rematerializing stack (the remat knob — VERDICT r4 #3)
+
+
+def stack_fwd_remat(params, x, heads, causal, eps, dot=None):
+    """Like :func:`stack_fwd` but stashes ONLY each layer's INPUT
+    (L, B, S, D) instead of the full cache — the cache's dominant
+    leaf is the attention probs at O(L·B·H·S²), which is what caps
+    single-chip (B, S) for the stacked path. The backward recomputes
+    each block's cache from its stashed input (one extra block
+    forward per layer ≈ +⅓ compute — the classic activation-
+    checkpointing trade, done explicitly because the repo's backward
+    is hand-written rather than jax.grad-derived, so ``jax.checkpoint``
+    has nothing to rematerialize). Returns (y, xs)."""
+    from jax import lax
+
+    import jax.numpy as jnp
+
+    def step(carry, lp):
+        y, _cache = block_fwd(jnp, carry, lp, heads, causal, eps, dot)
+        return y, carry                    # stash the layer INPUT
+
+    return lax.scan(step, x, params)
+
+
+def stack_bwd_remat(params, xs, err, heads, causal, eps, dot=None,
+                    es=None):
+    """Backward of :func:`stack_fwd_remat`: the reverse scan first
+    re-runs the block forward on the stashed input to rebuild the
+    cache, then applies the shared :func:`block_bwd`. Numerically
+    identical to :func:`stack_bwd` — the recomputed cache is the same
+    values (deterministic block, no dropout inside)."""
+    from jax import lax
+
+    import jax.numpy as jnp
+
+    def step(dcarry, layer):
+        lp, x_l = layer
+        _y, cache = block_fwd(jnp, x_l, lp, heads, causal, eps, dot)
+        dx, grads = block_bwd(jnp, lp, cache, dcarry, heads, eps,
+                              dot, es)
+        return dx, grads
+
+    return lax.scan(step, err, (params, xs), reverse=True)
+
+
+# ---------------------------------------------------------------------------
 # the GPipe schedule
 
 
