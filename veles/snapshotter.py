@@ -191,6 +191,7 @@ class HTTPSnapshotStore(SnapshotStore):
         prefix = urlsplit(self.base_url).path.lstrip("/")
         out = []
         for n in names:
+            n = n.lstrip("/")   # WebDAV-style absolute hrefs
             if prefix and n.startswith(prefix + "/"):
                 n = n[len(prefix) + 1:]
             if "/" in n:
