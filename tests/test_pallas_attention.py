@@ -94,6 +94,42 @@ def test_pallas_bwd_fused_matches_two_kernel(case):
              numpy.abs(numpy.asarray(a) - numpy.asarray(b)).max())
 
 
+@pytest.mark.parametrize("bq,bk", [(32, 16), (16, 32)],
+                         ids=["bq>bk", "bq<bk"])
+@pytest.mark.parametrize("causal", [True, False],
+                         ids=["causal", "full"])
+def test_pallas_unequal_blocks(bq, bk, causal):
+    """UNEQUAL block_q/block_k exercise the hand-derived diagonal
+    split boundaries (round 5: the floor/ceil clear points differ
+    from the trivial qi/ki±1 values only here) — fwd vs the scan
+    flash, and BOTH backward forms vs the scan backward."""
+    s = 64
+    q, k, v = _qkv(s)
+    prng.seed_all(912)
+    dout = prng.get("pa4").normal(0, 1.0, q.shape).astype(
+        numpy.float32)
+    out_ref, lse_ref = flash.blocked_attention_fwd(
+        q, k, v, causal=causal, block=16)
+    out, lse = PA.flash_attention_fwd(
+        q, k, v, causal=causal, block_q=bq, block_k=bk,
+        interpret=True)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(out_ref),
+                          atol=2e-5)
+    assert numpy.allclose(numpy.asarray(lse), numpy.asarray(lse_ref),
+                          atol=2e-5)
+    refs = flash.blocked_attention_bwd(
+        q, k, v, out_ref, lse_ref, dout, causal=causal, block=16)
+    for fused in (False, True):
+        got = PA.flash_attention_bwd(
+            q, k, v, out, lse, dout, causal=causal, block_q=bq,
+            block_k=bk, interpret=True, fused=fused)
+        for name, r, g in zip(("dq", "dk", "dv"), refs, got):
+            assert numpy.allclose(numpy.asarray(g), numpy.asarray(r),
+                                  atol=2e-4), \
+                (fused, name,
+                 numpy.abs(numpy.asarray(g) - numpy.asarray(r)).max())
+
+
 def test_attention_unit_pallas_path():
     """The unit with attn_impl='pallas': traced forward and backward
     must match the dense numpy oracle (different formulation, same
