@@ -405,7 +405,45 @@ def _record(extra, key, fn):
         extra[key + "_error"] = str(exc)[:200]
 
 
+def _device_reachable(timeout_s=240):
+    """Probe device init in a daemon thread: a dead TPU tunnel makes
+    ``jax.devices()`` HANG (not raise) — observed in round 5 when the
+    dev tunnel wedged — and a bench that hangs forever tells the
+    driver nothing. Returns (ok, detail)."""
+    import threading
+    out = {}
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+            devs = jax.devices()
+            float(jnp.ones((2, 2)).sum())     # readback = real proof
+            out["devices"] = str(devs)
+        except Exception as exc:
+            out["error"] = "%s: %s" % (type(exc).__name__, exc)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return False, "device init did not answer in %ds" % timeout_s
+    if "error" in out:
+        return False, out["error"]
+    return True, out["devices"]
+
+
 def main():
+    ok, detail = _device_reachable()
+    if not ok:
+        print(json.dumps({
+            "metric": "mnist_train_steps_per_sec",
+            "value": 0.0,
+            "unit": "steps/s",
+            "vs_baseline": 0.0,
+            "extra": {"device_error": detail[:300]},
+        }))
+        return 1
     extra = {}
     try:
         # calibration FIRST: a fixed device-only matmul rate stamps
