@@ -14,6 +14,13 @@ Re-design of ``velescli.py`` = ``veles/__main__.py`` [U] (SURVEY.md
   ``--listen-address``/``--master-address`` select master/slave modes,
   ``--workflow-graph`` dumps graphviz, ``--result-file`` writes the
   run's metric history as JSON.
+
+One subcommand lives OUTSIDE the workflow shape:
+
+    python -m veles serve --model NAME=ARCHIVE_DIR [...]
+
+starts the batched online-inference frontend (``veles/serving/``) over
+``export_inference`` artifacts — see ``velescli.py serve --help``.
 """
 
 import argparse
@@ -131,7 +138,11 @@ class Main:
     """The reference's Main object: owns launcher + workflow."""
 
     def __init__(self, argv=None):
-        self.args = build_argparser().parse_args(argv)
+        # INTERMIXED parsing: the reference CLI shape puts dot-path
+        # overrides at the tail, but callers legitimately interleave
+        # (``--seed 99 root.a=1 --result-file r.json``); plain
+        # parse_args refuses trailing positionals after optionals
+        self.args = build_argparser().parse_intermixed_args(argv)
         self.workflow = None
         self.launcher = None
 
@@ -421,6 +432,13 @@ def daemonize(log_file=None):
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # the serving subcommand (veles/serving/): no workflow module,
+        # no launcher — a registry of exported models behind the
+        # batched HTTP frontend
+        from veles.serving.frontend import serve_main
+        return serve_main(argv[1:])
     m = Main(argv)
     if getattr(m.args, "background", False):
         if not daemonize(m.args.log_file):

@@ -264,7 +264,13 @@ class StepCompiler:
     def __init__(self, units, device: XLADevice, donate=True):
         self.units = list(units)
         self.device = device
-        self.donate = donate
+        # donation is the TPU HBM lever; on the CPU platform it buys
+        # nothing and jaxlib 0.4.37 was observed to flakily SEGFAULT
+        # converting/awaiting outputs of donated programs on the
+        # 8-virtual-device test mesh (use-after-free in the donated
+        # aliasing path) — so only donate on real accelerators
+        self.donate = bool(donate) and \
+            getattr(device, "platform", None) != "cpu"
         self._compiled = {}
 
     # pytree assembly ---------------------------------------------------

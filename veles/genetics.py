@@ -571,8 +571,19 @@ def ga_slave_loop(address, name="ga-slave", max_tasks=None,
             _, idx, fn, values, epoch = resp
             with (eval_lock or contextlib.nullcontext()):
                 result = fn(values)
-            if rpc(lambda sid: ("result", sid, idx, result,
-                                epoch)) is None:
+            ack = rpc(lambda sid: ("result", sid, idx, result,
+                                   epoch))
+            if ack is None:
+                break
+            if ack[0] != "ok":
+                # the server's ('error', msg) refusal (mixed
+                # master/slave builds): the result was NOT accepted —
+                # surface the server's message and stop instead of
+                # counting the task as served (ADVICE r5)
+                import logging
+                logging.getLogger(name).error(
+                    "GA master refused result for task %s: %s", idx,
+                    ack[1] if len(ack) > 1 else ack)
                 break
             served += 1
     finally:

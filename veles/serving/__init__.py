@@ -1,0 +1,25 @@
+"""veles.serving — batched online inference (SURVEY.md §2.6 gap).
+
+The training side ends at ``export_inference`` (archive on disk) and
+the snapshotter (checkpoints); the reference platform handed actual
+serving to the separate libVeles C++ engine. This package is the
+JAX-native serving half of the north star:
+
+* :mod:`veles.serving.model`    — archive loader + pure forward
+  interpreter over the SAME xp-generic math the training ops use;
+* :mod:`veles.serving.registry` — named model/version registry with
+  hot reload and checkpoint-refresh (local or HTTPSnapshotStore);
+* :mod:`veles.serving.engine`   — per-(model, bucket) compiled
+  forward cache (jax.jit, donated batch buffers, warmup);
+* :mod:`veles.serving.batcher`  — dynamic micro-batching with
+  power-of-two buckets, per-request deadlines, backpressure shedding;
+* :mod:`veles.serving.frontend` — threaded HTTP/JSON frontend
+  (``/v1/models``, ``/v1/predict``, ``/healthz``, ``/metrics``) and
+  the ``velescli.py serve`` entry point.
+"""
+
+from veles.serving.batcher import (             # noqa: F401
+    DeadlineExceeded, MicroBatcher, QueueFull)
+from veles.serving.engine import InferenceEngine  # noqa: F401
+from veles.serving.model import ArchiveModel      # noqa: F401
+from veles.serving.registry import ModelRegistry  # noqa: F401

@@ -1,0 +1,259 @@
+"""Dynamic micro-batching with deadlines and backpressure.
+
+Concurrent requests (one sample or a few rows each) coalesce into one
+forward per dispatch: the worker drains whatever is queued — up to
+``max_batch`` rows — waiting at most ``max_wait_ms`` from the moment
+the oldest request arrived, so a lone request still answers promptly
+while a burst fills the batch (classic dynamic batching; the engine
+pads the result up to its power-of-two bucket).
+
+Overload policy, in order:
+
+* **shedding** — :meth:`submit` raises :class:`QueueFull` once
+  ``max_queue`` rows are pending; the frontend maps it to HTTP 503.
+  Bounded queues instead of unbounded latency: under sustained
+  overload every queued request would miss its deadline anyway.
+* **deadlines** — each request carries an absolute deadline; requests
+  already expired at dequeue time get :class:`DeadlineExceeded`
+  (HTTP 504) WITHOUT wasting forward compute on them.
+
+Metrics (queue depth, batch fill, latency percentiles, rps) are
+collected here — the one place every request passes through.
+"""
+
+import collections
+import threading
+import time
+
+from veles.logger import Logger
+
+
+class QueueFull(Exception):
+    """Backpressure: the pending queue is at capacity — shed."""
+
+
+class DeadlineExceeded(Exception):
+    """The request expired before a batch slot reached it."""
+
+
+class _Request:
+    __slots__ = ("rows", "deadline", "t_enqueue", "event", "result",
+                 "error")
+
+    def __init__(self, rows, deadline):
+        self.rows = rows
+        self.deadline = deadline
+        self.t_enqueue = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class MicroBatcher(Logger):
+    """Coalesces concurrent :meth:`submit` calls into batched
+    ``run_batch(rows) -> (outputs, bucket)`` dispatches."""
+
+    def __init__(self, run_batch, max_batch=64, max_queue=256,
+                 max_wait_ms=2.0, default_timeout_ms=1000.0,
+                 name="batcher"):
+        self.name = name
+        self._run_batch = run_batch
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.max_wait = float(max_wait_ms) / 1000.0
+        self.default_timeout = float(default_timeout_ms) / 1000.0
+        self._lock = threading.Lock()
+        self._have_work = threading.Condition(self._lock)
+        self._queue = collections.deque()
+        self._queued_rows = 0
+        self._running = True
+        # -- counters (under _lock) --
+        self.requests_total = 0
+        self.shed_total = 0
+        self.expired_total = 0
+        self.error_total = 0
+        self.batches_total = 0
+        self.batched_requests_total = 0   # requests served IN batches
+        self.batched_rows_total = 0
+        self.bucket_rows_total = 0        # rows incl. bucket padding
+        self._latencies = collections.deque(maxlen=2048)
+        self._completions = collections.deque(maxlen=4096)
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True,
+            name="%s-worker" % name)
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+
+    def submit(self, rows, timeout_ms=None):
+        """Enqueue ``rows`` (n, *sample); -> a wait()able handle.
+        Raises :class:`QueueFull` when the queue is at capacity."""
+        n = int(rows.shape[0])
+        if n < 1 or n > self.max_batch:
+            raise ValueError("request rows %d outside [1, %d]"
+                             % (n, self.max_batch))
+        timeout = (self.default_timeout if timeout_ms is None
+                   else float(timeout_ms) / 1000.0)
+        req = _Request(rows, time.monotonic() + timeout)
+        with self._lock:
+            if not self._running:
+                raise RuntimeError("batcher is closed")
+            if self._queued_rows + n > self.max_queue:
+                self.shed_total += 1
+                raise QueueFull(
+                    "queue full (%d rows pending, max %d)"
+                    % (self._queued_rows, self.max_queue))
+            self.requests_total += 1
+            self._queue.append(req)
+            self._queued_rows += n
+            self._have_work.notify()
+        return req
+
+    def predict(self, rows, timeout_ms=None):
+        """submit + wait; raises DeadlineExceeded / the batch error."""
+        req = self.submit(rows, timeout_ms=timeout_ms)
+        req.event.wait(timeout=(req.deadline - time.monotonic())
+                       + self.max_wait + 30.0)
+        if req.error is not None:
+            raise req.error
+        if not req.event.is_set():
+            raise DeadlineExceeded("no result before deadline")
+        return req.result
+
+    # -- worker --------------------------------------------------------
+
+    def _collect(self):
+        """Wait for work, then drain up to ``max_batch`` rows — holding
+        the batch open at most ``max_wait`` past the OLDEST request's
+        arrival (late joiners don't extend the window)."""
+        with self._lock:
+            while self._running and not self._queue:
+                self._have_work.wait()
+            if not self._running and not self._queue:
+                return None
+            head = self._queue[0]
+            close_at = head.t_enqueue + self.max_wait
+            while self._running:
+                left = close_at - time.monotonic()
+                if self._queued_rows >= self.max_batch or left <= 0:
+                    break
+                self._have_work.wait(timeout=left)
+            batch, total = [], 0
+            while self._queue:
+                head = self._queue[0]
+                n = head.rows.shape[0]
+                if batch and total + n > self.max_batch:
+                    break
+                if batch and head.rows.shape[1:] != \
+                        batch[0].rows.shape[1:]:
+                    # a differently-shaped request (possible when the
+                    # archive records no input_sample_shape) starts its
+                    # own batch: concatenating would fail the WHOLE
+                    # dispatch and 500 innocent co-batched requests
+                    break
+                req = self._queue.popleft()
+                self._queued_rows -= n
+                batch.append(req)
+                total += n
+            return batch
+
+    def _worker(self):
+        import numpy
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live = []
+            for req in batch:
+                if req.deadline < now:
+                    req.error = DeadlineExceeded(
+                        "expired %.0fms before dispatch"
+                        % ((now - req.deadline) * 1000))
+                    with self._lock:
+                        self.expired_total += 1
+                    req.event.set()
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            rows = numpy.concatenate([r.rows for r in live], axis=0) \
+                if len(live) > 1 else live[0].rows
+            try:
+                outputs, bucket = self._run_batch(rows)
+            except Exception as exc:
+                self.warning("batch of %d failed: %s: %s",
+                             len(live), type(exc).__name__, exc)
+                with self._lock:
+                    self.error_total += len(live)
+                for req in live:
+                    req.error = exc
+                    req.event.set()
+                continue
+            done = time.monotonic()
+            off = 0
+            for req in live:
+                n = req.rows.shape[0]
+                req.result = outputs[off:off + n]
+                off += n
+                req.event.set()
+            with self._lock:
+                self.batches_total += 1
+                self.batched_requests_total += len(live)
+                self.batched_rows_total += rows.shape[0]
+                self.bucket_rows_total += bucket
+                for req in live:
+                    self._latencies.append(done - req.t_enqueue)
+                    self._completions.append(done)
+
+    def close(self):
+        with self._lock:
+            self._running = False
+            self._have_work.notify_all()
+        self._thread.join(timeout=5)
+        # fail anything still queued rather than leaving waiters hung
+        # — UNDER the lock: if the join timed out (worker wedged in a
+        # long run_batch) the worker still popleft()s concurrently,
+        # and its own in-flight batch is no longer in the queue, so
+        # completed requests are never clobbered here
+        with self._lock:
+            while self._queue:
+                req = self._queue.popleft()
+                req.error = RuntimeError("batcher closed")
+                req.event.set()
+            self._queued_rows = 0
+
+    # -- metrics -------------------------------------------------------
+
+    def metrics(self, rps_window=10.0):
+        with self._lock:
+            lat = sorted(self._latencies)
+            now = time.monotonic()
+            recent = [t for t in self._completions
+                      if t > now - rps_window]
+            m = {
+                "queue_depth": self._queued_rows,
+                "requests_total": self.requests_total,
+                "shed_total": self.shed_total,
+                "expired_total": self.expired_total,
+                "error_total": self.error_total,
+                "batches_total": self.batches_total,
+                "batch_fill_ratio": round(
+                    self.batched_requests_total
+                    / max(self.batches_total, 1), 3),
+                "bucket_pad_ratio": round(
+                    self.bucket_rows_total
+                    / max(self.batched_rows_total, 1), 3),
+                # completions in the window over the WHOLE window: a
+                # time-since-oldest denominator read ~1000 rps off a
+                # single fresh completion
+                "requests_per_sec": round(
+                    len(recent) / rps_window, 2),
+            }
+            if lat:
+                m["latency_ms_p50"] = round(
+                    lat[len(lat) // 2] * 1000, 3)
+                m["latency_ms_p99"] = round(
+                    lat[min(len(lat) - 1,
+                            int(len(lat) * 0.99))] * 1000, 3)
+            return m

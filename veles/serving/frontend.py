@@ -1,0 +1,262 @@
+"""Threaded HTTP/JSON serving frontend + ``velescli serve``.
+
+Same zero-dependency stack as ``web_status.py``: a stdlib
+``ThreadingHTTPServer`` where each request thread parks inside the
+micro-batcher until its batch completes — the dynamic batching happens
+BETWEEN these threads, so concurrency on the socket side directly
+becomes batch fill on the device side.
+
+Endpoints:
+
+* ``GET  /v1/models``  — registry listing (name, version, shapes,
+  compiled buckets)
+* ``POST /v1/predict`` — ``{"model": name, "inputs": [[...], ...],
+  "timeout_ms": 250}`` -> ``{"outputs": [...], "version": n}``;
+  503 when shed (queue full), 504 when the deadline expired
+* ``GET  /healthz``    — liveness
+* ``GET  /metrics``    — queue depth, batch-fill ratio, p50/p99
+  latency, requests/s, per model
+
+``register_status(web_status)`` surfaces the same metrics in the
+training dashboard (``web_status.py``) so one page shows both halves
+of a train→serve deployment.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy
+
+from veles.logger import Logger
+from veles.serving.batcher import DeadlineExceeded, QueueFull
+
+
+class ServingFrontend(Logger):
+    """HTTP face of a :class:`ModelRegistry`; port=0 picks a free
+    one (see ``.port``)."""
+
+    def __init__(self, registry, port=0, host="127.0.0.1"):
+        self.name = "serving"
+        self.registry = registry
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, code, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"status": "ok"})
+                elif self.path.startswith("/metrics"):
+                    self._reply(200, front.metrics())
+                elif self.path.startswith("/v1/models"):
+                    self._reply(200,
+                                {"models": front.registry.describe()})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/predict":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n))
+                except ValueError:
+                    self._reply(400, {"error": "bad json"})
+                    return
+                code, reply = front.predict_request(doc)
+                self._reply(code, reply)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serving-http")
+        self._thread.start()
+        self.info("serving on http://%s:%d/", host, self.port)
+
+    # -- request handling ----------------------------------------------
+
+    def predict_request(self, doc):
+        """-> (http_code, reply_dict); shared by the HTTP handler and
+        tests (no socket needed to exercise the logic)."""
+        try:
+            name = doc["model"]
+            inputs = numpy.asarray(doc["inputs"], numpy.float32)
+        except (KeyError, TypeError, ValueError) as exc:
+            return 400, {"error": "bad request: %s" % exc}
+        try:
+            entry = self.registry.get(name)
+        except KeyError as exc:
+            return 404, {"error": str(exc)}
+        sample = entry.model.input_sample_shape
+        if inputs.ndim > 0 and sample is not None \
+                and inputs.shape[1:] != sample:
+            # accept a single un-batched sample by promoting it
+            if inputs.shape == sample:
+                inputs = inputs[None]
+            else:
+                return 400, {"error": "input shape %s != (n,)+%s"
+                             % (inputs.shape, sample)}
+        elif sample is None and inputs.ndim == 1:
+            # no recorded sample shape to validate against: a flat
+            # list is one sample, not N scalar rows
+            inputs = inputs[None]
+        if inputs.ndim == 0 or inputs.shape[0] == 0:
+            return 400, {"error": "empty inputs"}
+        try:
+            out = entry.predict(inputs,
+                                timeout_ms=doc.get("timeout_ms"))
+        except QueueFull as exc:
+            return 503, {"error": str(exc)}
+        except DeadlineExceeded as exc:
+            return 504, {"error": str(exc)}
+        except (ValueError, TypeError) as exc:
+            # client-fixable: too many rows for max_batch, garbage
+            # timeout_ms — a 4xx, not a server fault
+            return 400, {"error": str(exc)}
+        except Exception as exc:
+            return 500, {"error": "%s: %s"
+                         % (type(exc).__name__, exc)}
+        return 200, {"model": name, "version": entry.version,
+                     "outputs": numpy.asarray(out).tolist()}
+
+    def metrics(self):
+        return {"models": self.registry.metrics()}
+
+    # -- dashboard integration -----------------------------------------
+
+    def register_status(self, web_status):
+        """Surface serving metrics in the web-status dashboard."""
+        front = self
+
+        def provider():
+            per_model = front.registry.metrics()
+            agg_rps = round(sum(m["requests_per_sec"]
+                                for m in per_model.values()), 2)
+            return {
+                "mode": "serving",
+                "workflow": ",".join(sorted(per_model) or ["-"]),
+                "epoch": "",
+                "best_metric": "",
+                "last_metrics": {
+                    name: {"rps": m["requests_per_sec"],
+                           "fill": m["batch_fill_ratio"],
+                           "p99_ms": m.get("latency_ms_p99"),
+                           "queue": m["queue_depth"],
+                           "shed": m["shed_total"]}
+                    for name, m in per_model.items()},
+                "complete": "rps=%s" % agg_rps,
+            }
+
+        web_status.register("serving:%d" % self.port, provider)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+# -- velescli serve -----------------------------------------------------
+
+
+def build_serve_argparser():
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="velescli serve",
+        description="Serve exported models over HTTP with dynamic "
+                    "batching")
+    p.add_argument("--model", action="append", required=True,
+                   metavar="NAME=DIR",
+                   help="model name = export_inference artifact "
+                        "directory (repeatable)")
+    p.add_argument("--checkpoint", action="append", default=[],
+                   metavar="NAME=PATH",
+                   help="refresh NAME's params from a snapshotter "
+                        "checkpoint (local path or http(s):// URI)")
+    p.add_argument("--port", type=int, default=8080,
+                   help="HTTP port (0 = pick a free one)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--backend", default="auto",
+                   choices=("auto", "jit", "numpy"),
+                   help="forward executor: jax.jit compiled (device) "
+                        "or plain numpy")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="largest padded batch bucket")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="pending-row cap before requests are shed "
+                        "with 503")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="batching window from the oldest queued "
+                        "request")
+    p.add_argument("--timeout-ms", type=float, default=1000.0,
+                   help="default per-request deadline")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip bucket-ladder precompilation")
+    p.add_argument("--web-status", type=int, default=None,
+                   metavar="PORT",
+                   help="also serve the status dashboard on this "
+                        "port (0 = pick a free one)")
+    return p
+
+
+def _parse_kv(pairs, what):
+    out = {}
+    for pair in pairs:
+        name, sep, value = pair.partition("=")
+        if not sep or not name or not value:
+            raise SystemExit("%s %r: expected NAME=VALUE"
+                             % (what, pair))
+        out[name] = value
+    return out
+
+
+def serve_main(argv=None):
+    """``velescli.py serve ...`` — build the registry, start the
+    frontend, run until interrupted."""
+    from veles.serving.registry import ModelRegistry
+    args = build_serve_argparser().parse_args(argv)
+    models = _parse_kv(args.model, "--model")
+    checkpoints = _parse_kv(args.checkpoint, "--checkpoint")
+    unknown = sorted(set(checkpoints) - set(models))
+    if unknown:
+        raise SystemExit("--checkpoint for unloaded model(s): %s"
+                         % ", ".join(unknown))
+    registry = ModelRegistry(
+        backend=args.backend, max_batch=args.max_batch,
+        max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
+        default_timeout_ms=args.timeout_ms)
+    for name, source in sorted(models.items()):
+        registry.load(name, source,
+                      checkpoint=checkpoints.get(name),
+                      warmup=not args.no_warmup)
+    front = ServingFrontend(registry, port=args.port, host=args.host)
+    if args.web_status is not None:
+        from veles.web_status import WebStatus
+        status = WebStatus(port=args.web_status, host=args.host)
+        front.register_status(status)
+    print(json.dumps({
+        "serving": "http://%s:%d" % (front.host, front.port),
+        "models": [{"name": d["name"], "version": d["version"],
+                    "backend": d["backend"],
+                    "compiled_buckets": d["compiled_buckets"]}
+                   for d in registry.describe()],
+    }), flush=True)
+    try:
+        threading.Event().wait()        # serve until ^C / SIGTERM
+    except KeyboardInterrupt:
+        pass
+    finally:
+        front.close()
+        registry.close()
+    return 0

@@ -1,0 +1,412 @@
+"""Archive loading + the pure forward interpreter.
+
+``export_inference`` writes ``contents.json`` + ``*.npy`` (the C++
+engine's input format, SURVEY.md §3.5). :class:`ArchiveModel` loads
+that archive back in Python and evaluates it as a PURE function
+``apply(xp, params, x)`` — generic over the array module exactly like
+the training ops, so the numpy backend and the jitted engine share one
+formula set (and the jitted form needs no re-derivation: ``jax.jit``
+traces the same code with ``xp = jax.numpy``).
+
+The per-type forward math is NOT re-invented here: every formula is
+the module-level helper the training units already share with their
+oracles (``dense_attention_core_fwd``, ``ln_fwd``, ``block_fwd``,
+``route_tokens``/``experts_fwd``, ``conv_math.im2col/col2im``, the
+activation table) — one copy of the math repo-wide, so serving can
+never drift from training. Unknown unit types fail loudly, mirroring
+the C++ ``UnitFactory`` contract.
+
+Parameters live OUTSIDE the spec (a ``{unit_name: {key: array}}``
+pytree) so the registry can hot-swap freshly trained weights — from a
+re-exported archive or a snapshotter checkpoint — without touching
+the compiled forward.
+"""
+
+import json
+import os
+
+import numpy
+
+from veles.znicz_tpu.ops import activations as A
+from veles.znicz_tpu.ops import conv_math as CM
+
+
+def _act(xp, name, v):
+    return A.ACTIVATIONS[name][0](xp, v)
+
+
+def _split_heads(t, heads):
+    b, s, d = t.shape
+    return t.reshape(b, s, heads, d // heads).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(t):
+    b, h, s, dh = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
+
+
+# -- per-type forward functions: fn(xp, x, p, spec) -> y ----------------
+
+
+def _dense(act):
+    def fn(xp, x, p, spec):
+        cfg = spec["config"]
+        x2 = x.reshape(x.shape[0], -1)
+        w = p["weights"]
+        v = xp.matmul(x2, w.T if spec.get("weights_transposed") else w)
+        if p.get("bias") is not None:
+            v = v + p["bias"]
+        sample = tuple(cfg.get("output_sample_shape")
+                       or (cfg["neurons"],))
+        return _act(xp, act, v).reshape((x.shape[0],) + sample)
+    return fn
+
+
+def _conv(act):
+    def fn(xp, x, p, spec):
+        cfg = spec["config"]
+        cols = CM.im2col(xp, x, cfg["ky"], cfg["kx"],
+                         tuple(cfg["sliding"]),
+                         CM.normalize_padding(tuple(cfg["padding"])))
+        v = xp.matmul(cols, p["weights"].T)
+        if p.get("bias") is not None:
+            v = v + p["bias"]
+        return _act(xp, act, v)
+    return fn
+
+
+def _pool_patches(xp, x, cfg, pad_value):
+    """Ceil-semantics window patches (B,oy,ox,ky*kx,C) — the
+    PoolingBase edge geometry (partial bottom/right windows pool)."""
+    ky, kx = cfg["ky"], cfg["kx"]
+    sy, sx = cfg["sliding"]
+    b, h, w, c = x.shape
+    oy = -(-max(h - ky, 0) // sy) + 1
+    ox = -(-max(w - kx, 0) // sx) + 1
+    need_h = (oy - 1) * sy + ky
+    need_w = (ox - 1) * sx + kx
+    if need_h > h or need_w > w:
+        x = xp.pad(x, ((0, 0), (0, need_h - h), (0, need_w - w),
+                       (0, 0)), constant_values=pad_value)
+    cols = CM.im2col(xp, x, ky, kx, (sy, sx), (0, 0, 0, 0))
+    return cols.reshape(b, oy, ox, ky * kx, c)
+
+
+def _max_pool(xp, x, p, spec):
+    return xp.max(_pool_patches(xp, x, spec["config"], -numpy.inf),
+                  axis=3)
+
+
+def _avg_pool_counts(shape, ky, kx, sy, sx):
+    """True (unpadded) window sizes per output position — a pure
+    function of the geometry, memoized so each request pays ONE
+    im2col, not two (and jit traces embed it as a constant)."""
+    key = (shape, ky, kx, sy, sx)
+    counts = _AVG_COUNTS.get(key)
+    if counts is None:
+        ones = numpy.ones((1,) + shape, numpy.float32)
+        cfg = {"ky": ky, "kx": kx, "sliding": (sy, sx)}
+        counts = numpy.maximum(
+            _pool_patches(numpy, ones, cfg, 0.0).sum(axis=3), 1.0)
+        _AVG_COUNTS[key] = counts
+    return counts
+
+
+_AVG_COUNTS = {}
+
+
+def _avg_pool(xp, x, p, spec):
+    cfg = spec["config"]
+    patches = _pool_patches(xp, x, cfg, 0.0)
+    sy, sx = cfg["sliding"]
+    counts = _avg_pool_counts(tuple(x.shape[1:]), cfg["ky"],
+                              cfg["kx"], sy, sx)
+    return patches.sum(axis=3) / counts
+
+
+def _lrn(xp, x, p, spec):
+    cfg = spec["config"]
+    d = cfg["k"] + cfg["alpha"] * CM.sliding_channel_sum(
+        xp, x * x, cfg["n"])
+    if cfg["beta"] == 0.75:       # the LRNormalizerForward rewrite
+        return x * (1.0 / xp.sqrt(d * xp.sqrt(d)))
+    return x * d ** (-cfg["beta"])
+
+
+def _embedding(xp, x, p, spec):
+    ids = x.astype(numpy.int32 if xp is numpy else "int32")
+    y = p["weights"][ids]
+    pos = p.get("positions")
+    if pos is not None:
+        s = ids.shape[1]
+        if s > pos.shape[0]:
+            raise ValueError(
+                "%s: sequence %d longer than the exported positions "
+                "table (%d)" % (spec["name"], s, pos.shape[0]))
+        y = y + pos[:s]
+    return y
+
+
+def _layernorm(xp, x, p, spec):
+    from veles.znicz_tpu.ops.layernorm import ln_fwd
+    return ln_fwd(xp, x, p["weights"], p["bias"],
+                  spec["config"]["eps"])
+
+
+def _token_dense(act):
+    def fn(xp, x, p, spec):
+        v = xp.matmul(x, p["weights"])
+        if p.get("bias") is not None:
+            v = v + p["bias"]
+        return _act(xp, act, v)
+    return fn
+
+
+def _ffn(xp, x, p, spec):
+    cfg = spec["config"]
+    h = _act(xp, "strict_relu",
+             xp.matmul(x, p["weights"]) + p["bias"])
+    y = xp.matmul(h, p["weights2"]) + p["bias2"]
+    return y + x if cfg["residual"] else y
+
+
+def _attention(xp, x, p, spec):
+    from veles.znicz_tpu.ops.attention import dense_attention_core_fwd
+    cfg = spec["config"]
+    heads = cfg["heads"]
+    d = x.shape[-1]
+    qkv = xp.matmul(x, p["weights"])
+    if p.get("bias") is not None:
+        qkv = qkv + p["bias"]
+    q = _split_heads(qkv[..., :d], heads)
+    k = _split_heads(qkv[..., d:2 * d], heads)
+    v = _split_heads(qkv[..., 2 * d:], heads)
+    scale = numpy.float32(1.0 / numpy.sqrt(d // heads))
+    _, ctx = dense_attention_core_fwd(xp, q, k, v, cfg["causal"],
+                                      scale)
+    y = xp.matmul(_merge_heads(ctx), p["weights_out"])
+    if p.get("bias_out") is not None:
+        y = y + p["bias_out"]
+    return y + x if cfg["residual"] else y
+
+
+def _moe_one(xp, x, p, cfg):
+    """Top-1 MoE over ONE sample's tokens (T, D)."""
+    from veles.znicz_tpu.ops.moe import experts_fwd, route_tokens
+    xt = x.reshape(-1, x.shape[-1])
+    cap = max(1, int(numpy.ceil(
+        cfg["capacity_factor"] * xt.shape[0] / cfg["experts"])))
+    _, _, gate, dispatch = route_tokens(xp, xt, p["router"],
+                                        cfg["experts"], cap)
+    xe = xp.einsum("tec,td->ecd", dispatch, xt)
+    _, ye = experts_fwd(xp, xe, p["weights"], p["bias"],
+                        p["weights2"], p["bias2"], "strict_relu",
+                        xp.einsum)
+    yt = xp.einsum("tec,ecd->td", dispatch * gate[:, None, None], ye)
+    return yt.reshape(x.shape)
+
+
+def _moe_ffn(xp, x, p, spec):
+    # route PER SAMPLE, not over the coalesced micro-batch: expert
+    # capacity and the rank-based token dropping must depend only on
+    # the request's own tokens, never on co-batched traffic or the
+    # engine's bucket pad rows (training flat-routes its minibatch,
+    # but a serving answer has to be a function of its input alone)
+    cfg = spec["config"]
+    y = xp.concatenate([_moe_one(xp, x[i:i + 1], p, cfg)
+                        for i in range(x.shape[0])], axis=0)
+    return y + x if cfg["residual"] else y
+
+
+def _transformer_stack(xp, x, p, spec):
+    from veles.znicz_tpu.parallel.pipeline import block_fwd
+    cfg = spec["config"]
+    for i in range(cfg["layers"]):
+        x, _ = block_fwd(xp, x, {k: v[i] for k, v in p.items()},
+                         cfg["heads"], cfg["causal"], cfg["eps"])
+    return x
+
+
+def _deconv(xp, x, p, spec):
+    cfg = spec["config"]
+    b, oy, ox, k = x.shape
+    cols = xp.matmul(x.reshape(-1, k), p["weights"])
+    return CM.col2im(xp, cols.reshape(b, oy, ox, -1),
+                     (b,) + tuple(cfg["out_shape"]),
+                     cfg["ky"], cfg["kx"], tuple(cfg["sliding"]),
+                     CM.normalize_padding(tuple(cfg["padding"])))
+
+
+def _depooling(xp, x, p, spec):
+    cfg = spec["config"]
+    ky, kx = cfg["ky"], cfg["kx"]
+    sy, sx = cfg["sliding"]
+    b, oy, ox, c = x.shape
+    kk = ky * kx
+    patches = xp.broadcast_to(x[:, :, :, None, :] / float(kk),
+                              (b, oy, ox, kk, c))
+    need_h = sy * (oy - 1) + ky
+    need_w = sx * (ox - 1) + kx
+    full = CM.col2im(xp, patches.reshape(b, oy, ox, kk * c),
+                     (b, need_h, need_w, c), ky, kx, (sy, sx),
+                     (0, 0, 0, 0))
+    h, w, _ = cfg["out_shape"]
+    return full[:, :h, :w, :]
+
+
+def _identity(xp, x, p, spec):
+    return x
+
+
+def _activation(act):
+    def fn(xp, x, p, spec):
+        return _act(xp, act, x)
+    return fn
+
+
+#: type name -> forward fn; keys mirror export_inference.ENGINE_TYPES
+#: (and libveles/src/units.cc registrations) one to one
+FORWARD_OPS = {
+    "all2all": _dense("linear"),
+    "all2all_tanh": _dense("tanh"),
+    "all2all_relu": _dense("relu"),
+    "all2all_str": _dense("strict_relu"),
+    "all2all_sigmoid": _dense("sigmoid"),
+    "softmax": _dense("softmax"),
+    "conv": _conv("linear"),
+    "conv_tanh": _conv("tanh"),
+    "conv_relu": _conv("relu"),
+    "conv_str": _conv("strict_relu"),
+    "conv_sigmoid": _conv("sigmoid"),
+    "max_pooling": _max_pool,
+    "avg_pooling": _avg_pool,
+    "norm": _lrn,
+    "dropout": _identity,       # inverted dropout: inference identity
+    "activation_tanh": _activation("tanh"),
+    "activation_relu": _activation("relu"),
+    "activation_str": _activation("strict_relu"),
+    "activation_sigmoid": _activation("sigmoid"),
+    "embedding": _embedding,
+    "layernorm": _layernorm,
+    "token_dense": _token_dense("linear"),
+    "token_dense_relu": _token_dense("strict_relu"),
+    "transformer_ffn": _ffn,
+    "attention": _attention,
+    "moe_ffn": _moe_ffn,
+    "transformer_stack": _transformer_stack,
+    "deconv": _deconv,
+    "depooling": _depooling,
+}
+
+#: spec keys that are metadata, not .npy parameter references
+_NON_PARAM_KEYS = frozenset({"type", "name", "config",
+                             "weights_transposed"})
+
+
+class ArchiveModel:
+    """A loaded inference archive: ordered unit specs + a params
+    pytree, evaluated by :meth:`apply`."""
+
+    def __init__(self, workflow_name, input_sample_shape, units,
+                 params):
+        self.workflow_name = workflow_name
+        self.input_sample_shape = (None if input_sample_shape is None
+                                   else tuple(input_sample_shape))
+        self.units = units          # list of spec dicts
+        self.params = params        # {unit_name: {key: np.float32 arr}}
+        for spec in units:
+            if spec["type"] not in FORWARD_OPS:
+                raise ValueError(
+                    "cannot serve unit %s: unknown type %r"
+                    % (spec.get("name"), spec["type"]))
+
+    @classmethod
+    def from_dir(cls, path):
+        """Load ``contents.json`` + every referenced .npy from an
+        ``export_inference`` artifact directory."""
+        doc_path = os.path.join(path, "contents.json")
+        with open(doc_path) as f:
+            doc = json.load(f)
+        if doc.get("format") != 1:
+            raise ValueError("%s: unsupported archive format %r"
+                             % (doc_path, doc.get("format")))
+        units, params = [], {}
+        for spec in doc["units"]:
+            tree = {}
+            for key, value in spec.items():
+                if key in _NON_PARAM_KEYS or value is None:
+                    continue
+                if isinstance(value, str) and value.endswith(".npy"):
+                    tree[key] = numpy.ascontiguousarray(
+                        numpy.load(os.path.join(path, value)),
+                        numpy.float32)
+            units.append(spec)
+            if tree:
+                params[spec["name"]] = tree
+        return cls(doc.get("workflow"), doc.get("input_sample_shape"),
+                   units, params)
+
+    # -- evaluation ----------------------------------------------------
+
+    def apply(self, xp, params, x):
+        """Pure forward through every unit; ``x``: (B, *sample)."""
+        for spec in self.units:
+            x = FORWARD_OPS[spec["type"]](
+                xp, x, params.get(spec["name"], {}), spec)
+        return x
+
+    def __call__(self, x):
+        return self.apply(numpy, self.params,
+                          numpy.asarray(x, numpy.float32))
+
+    # -- structure identity (the compiled-cache key) -------------------
+
+    def signature(self):
+        """Hashable architecture identity: types, configs and param
+        shapes. Two models with equal signatures can share compiled
+        programs (only the param VALUES differ)."""
+        def freeze(v):
+            return tuple(v) if isinstance(v, list) else v
+        return tuple(
+            (spec["type"], spec["name"],
+             tuple(sorted((k, freeze(v))
+                          for k, v in spec["config"].items())),
+             tuple(sorted(
+                 (k, t.shape)
+                 for k, t in self.params.get(spec["name"], {})
+                 .items())))
+            for spec in self.units)
+
+    # -- checkpoint refresh --------------------------------------------
+
+    def load_checkpoint(self, target):
+        """Refresh params from a snapshotter checkpoint (local path or
+        ``http(s)://`` URI via HTTPSnapshotStore). The checkpoint's
+        ``params`` tree is keyed by unit name with the same attr keys
+        the archive uses; unit names absent from this model are
+        ignored (the checkpoint also carries GD units), shape
+        mismatches fail loudly."""
+        from veles.snapshotter import load_snapshot
+        state = load_snapshot(target)
+        loaded = 0
+        for uname, tree in state.get("params", {}).items():
+            if uname not in self.params:
+                continue
+            for key, value in tree.items():
+                if key not in self.params[uname]:
+                    continue
+                value = numpy.asarray(value, numpy.float32)
+                have = self.params[uname][key]
+                if value.shape != have.shape:
+                    raise ValueError(
+                        "checkpoint %s: %s.%s shape %s != archive %s"
+                        % (target, uname, key, value.shape,
+                           have.shape))
+                self.params[uname][key] = value
+                loaded += 1
+        if not loaded:
+            raise ValueError(
+                "checkpoint %s shares no parameters with this model "
+                "(unit names: %s)" % (target,
+                                      sorted(self.params)))
+        return loaded

@@ -1,0 +1,171 @@
+"""Model registry: named models, versions, hot reload.
+
+Each registered model is a :class:`ServedModel` wiring one
+:class:`ArchiveModel` (the weights + architecture, from an
+``export_inference`` artifact directory) into an
+:class:`InferenceEngine` (compiled forward cache) and a
+:class:`MicroBatcher` (request coalescing). A model may additionally
+be refreshed from a snapshotter checkpoint — local file or
+``http(s)://`` URI through :class:`veles.snapshotter.HTTPSnapshotStore`
+— which is how a serving process tracks a training run's best
+checkpoint without re-exporting.
+
+Hot reload (:meth:`ModelRegistry.reload`) re-reads the model's source
+in place and atomically swaps it under the SAME name with a bumped
+version; in-flight batches finish on the old params, the next batch
+sees the new ones. When the architecture signature is unchanged the
+engine keeps its compiled programs (params are runtime arguments) —
+reload costs one host→device upload, no recompilation.
+"""
+
+import threading
+import time
+
+from veles.logger import Logger
+from veles.serving.batcher import MicroBatcher
+from veles.serving.engine import InferenceEngine
+from veles.serving.model import ArchiveModel
+
+
+class ServedModel:
+    """One registry entry: model + engine + batcher + metadata."""
+
+    def __init__(self, name, model, engine, batcher, source,
+                 checkpoint=None):
+        self.name = name
+        self.model = model
+        self.engine = engine
+        self.batcher = batcher
+        self.source = source
+        self.checkpoint = checkpoint
+        self.version = 1
+        self.loaded_at = time.time()
+
+    def predict(self, rows, timeout_ms=None):
+        return self.batcher.predict(rows, timeout_ms=timeout_ms)
+
+    def describe(self):
+        return {
+            "name": self.name,
+            "version": self.version,
+            "workflow": self.model.workflow_name,
+            "source": self.source,
+            "checkpoint": self.checkpoint,
+            "input_sample_shape": self.model.input_sample_shape,
+            "units": [s["type"] for s in self.model.units],
+            "backend": self.engine.backend,
+            "compiled_buckets": self.engine.compiled_buckets,
+            "loaded_at": self.loaded_at,
+        }
+
+    def close(self):
+        self.batcher.close()
+
+
+class ModelRegistry(Logger):
+    """Thread-safe name -> :class:`ServedModel` map."""
+
+    def __init__(self, backend="auto", max_batch=64, max_queue=256,
+                 max_wait_ms=2.0, default_timeout_ms=1000.0):
+        self.name = "registry"
+        self.backend = backend
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.max_wait_ms = float(max_wait_ms)
+        self.default_timeout_ms = float(default_timeout_ms)
+        self._lock = threading.Lock()
+        self._models = {}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def load(self, name, source, checkpoint=None, warmup=False):
+        """Load (or replace) model ``name`` from artifact directory
+        ``source``; optionally refresh its params from ``checkpoint``
+        and precompile the bucket ladder."""
+        model = ArchiveModel.from_dir(source)
+        if checkpoint:
+            model.load_checkpoint(checkpoint)
+        with self._lock:
+            old = self._models.get(name)
+            if old is not None and \
+                    old.model.signature() == model.signature():
+                # same architecture: swap params, keep the compiled
+                # cache and the running batcher
+                old.model = model
+                old.engine.set_model(model, params_only=True)
+                old.source = source
+                old.checkpoint = checkpoint
+                old.version += 1
+                old.loaded_at = time.time()
+                self.info("model %s reloaded in place -> v%d",
+                          name, old.version)
+                return old
+            engine = InferenceEngine(model, backend=self.backend,
+                                     max_batch=self.max_batch)
+            batcher = MicroBatcher(
+                engine.predict, max_batch=self.max_batch,
+                max_queue=self.max_queue,
+                max_wait_ms=self.max_wait_ms,
+                default_timeout_ms=self.default_timeout_ms,
+                name="batcher-%s" % name)
+            entry = ServedModel(name, model, engine, batcher, source,
+                                checkpoint)
+            if old is not None:
+                entry.version = old.version + 1
+            self._models[name] = entry
+        if old is not None:
+            # close OUTSIDE the lock: draining the old batcher can
+            # block for seconds and must not stall get() for every
+            # other model's request threads
+            old.close()
+        if warmup:
+            entry.engine.warmup()
+        self.info("model %s v%d loaded from %s (%d units, backend "
+                  "%s)", name, entry.version, source,
+                  len(model.units), entry.engine.backend)
+        return entry
+
+    def reload(self, name):
+        """Hot reload from the entry's recorded source+checkpoint."""
+        entry = self.get(name)
+        return self.load(name, entry.source,
+                         checkpoint=entry.checkpoint)
+
+    def unload(self, name):
+        with self._lock:
+            entry = self._models.pop(name)
+        entry.close()
+
+    def close(self):
+        with self._lock:
+            entries = list(self._models.values())
+            self._models.clear()
+        for entry in entries:
+            entry.close()
+
+    # -- lookup --------------------------------------------------------
+
+    def get(self, name):
+        with self._lock:
+            try:
+                return self._models[name]
+            except KeyError:
+                raise KeyError("no model %r (serving: %s)"
+                               % (name, sorted(self._models) or "none"))
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def describe(self):
+        with self._lock:
+            entries = list(self._models.values())
+        return [e.describe() for e in entries]
+
+    def metrics(self):
+        with self._lock:
+            entries = list(self._models.items())
+        return {name: dict(e.batcher.metrics(),
+                           version=e.version,
+                           compiled_buckets=e.engine.compiled_buckets)
+                for name, e in entries}

@@ -191,6 +191,11 @@ class HTTPSnapshotStore(SnapshotStore):
         prefix = urlsplit(self.base_url).path.lstrip("/")
         out = []
         for n in names:
+            if "://" in n:
+                # absolute-URL hrefs (some WebDAV servers return full
+                # URLs, not paths): reduce to the path before the
+                # base-prefix strip or every entry is dropped
+                n = urlsplit(n).path
             n = n.lstrip("/")   # WebDAV-style absolute hrefs
             if prefix and n.startswith(prefix + "/"):
                 n = n[len(prefix) + 1:]
@@ -201,6 +206,16 @@ class HTTPSnapshotStore(SnapshotStore):
                 continue
             if ".ckpt." in n:
                 out.append(n)
+        if names and not out:
+            # an endpoint whose every name got filtered probably
+            # speaks a listing dialect this normalization misses —
+            # an empty list() silently disables retention/resume, so
+            # say what was seen
+            import logging
+            logging.getLogger(type(self).__name__).warning(
+                "%s/: all %d listed names filtered out (first: %r) — "
+                "no checkpoints visible", self.base_url, len(names),
+                names[0])
         return sorted(out)
 
     def delete(self, name):

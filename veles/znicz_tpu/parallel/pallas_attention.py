@@ -70,6 +70,54 @@ def _on_tpu():
         return False
 
 
+def _device_vmem_bytes():
+    """Scoped-VMEM capacity of the local TPU generation. v2/v3 cores
+    have 16MB; v4 and later (v4/v5e/v5p/v6e) have 128MB+. Unknown
+    kinds assume the modern 128MB — the same assumption the old
+    hardcoded grant made implicitly."""
+    import jax
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 128 << 20
+    if "v2" in kind or "v3" in kind:
+        return 16 << 20
+    return 128 << 20
+
+
+def _fused_bwd_vmem_limit(s, dh, block_q, block_k, itemsize,
+                          device_vmem=None):
+    """Scoped-VMEM grant for the fused dkvq kernel, derived from its
+    RESIDENT footprint instead of a hardcoded 64MB (ADVICE r5: the
+    constant assumed a >=64MB-VMEM generation; on v2/v3 the default
+    fused path could fail to compile where ``fused=False`` worked).
+
+    Resident per grid step: full q/do rows (storage dtype), the full
+    f32 dq accumulator, lse/delta lanes, the k/v/dk/dv blocks and the
+    (block_q, block_k) f32 score/prob temporaries. The 6x margin
+    covers Mosaic's double buffering and spill slack (measured 16.75MB
+    actual vs ~4.4MB resident at S=8k/dh=64/bf16 — a 3.8x ratio).
+    Raises with the escape hatches when even that exceeds the device:
+    ``fused=False`` (the two-kernel backward never holds dq resident)
+    or a smaller ``pallas_tile``."""
+    resident = (s * dh * (2 * itemsize + 4)    # q + do + f32 dq
+                + 2 * 4 * s                    # lse + delta lanes
+                + 4 * block_k * dh * itemsize  # k/v/dk/dv blocks
+                + 4 * block_q * block_k * 4)   # score/prob temps
+    need = 6 * resident
+    vmem = device_vmem if device_vmem is not None \
+        else _device_vmem_bytes()
+    limit = min(max(need, 16 << 20), vmem)
+    if need > vmem:
+        raise ValueError(
+            "fused attention backward needs ~%dMB scoped VMEM at "
+            "S=%d, dh=%d, blocks (%d, %d) but the device has %dMB: "
+            "use fused=False (the two-kernel backward) or a smaller "
+            "pallas_tile"
+            % (need >> 20, s, dh, block_q, block_k, vmem >> 20))
+    return limit
+
+
 def _split_loop(spans, make_body, init):
     """Chained ``fori_loop``s over ``spans`` = [(lo, hi, masked), ...]
     — the causal diagonal split shared by all four kernels (round 5):
@@ -422,12 +470,14 @@ def flash_attention_bwd(q, k, v, out, lse, dout, causal=True,
         dq_full_f32 = pl.BlockSpec((1, s, dh), lambda bh, i: (bh, 0, 0))
         # the resident q/do/dq rows push past the default 16MB scoped-
         # vmem budget at S=8k inside a larger program (measured
-        # 16.75MB); v5e VMEM is 128MB — grant the kernel what it needs
+        # 16.75MB) — grant the kernel what its footprint needs,
+        # clamped to the device generation's actual VMEM
         params = {}
         if not interpret:
             from jax.experimental.pallas import tpu as pltpu
             params["compiler_params"] = pltpu.CompilerParams(
-                vmem_limit_bytes=64 << 20)
+                vmem_limit_bytes=_fused_bwd_vmem_limit(
+                    s, dh, block_q, block_k, q.dtype.itemsize))
         dk, dv, dq = pl.pallas_call(
             dkvq,
             grid=(b * h, s // block_k),
