@@ -1,0 +1,608 @@
+"""Fault-tolerance layer under injected faults (ISSUE 2): leases +
+fencing, drop→requeue, slave auto-reconnect, the ChaosProxy harness,
+and the snapshot store's retry/circuit-breaker degradation.
+
+Everything here is seeded/deterministic in its DECISIONS (what gets
+dropped/duplicated is a fixed plan or a seeded PRNG, never wall-clock
+luck); assertions are on convergence and counters, not on timing.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.chaos import (C2S, S2C, DELAY, DROP, DUP, PASS, TRUNCATE,
+                         ChaosEvent, ChaosProxy)
+from veles.client import SlaveClient
+from veles.distributable import DistributionRegistry
+from veles.loader.base import CLASS_TRAIN
+from veles.server import MasterServer, recv_frame, send_frame
+from tests.test_service import make_wf
+
+
+def run_iteration(wf):
+    """What SlaveClient._run_iteration does on the numpy backend."""
+    for u in wf.forwards:
+        u.run()
+    wf.evaluator.run()
+    if wf.loader.minibatch_class == CLASS_TRAIN:
+        for gd in reversed(wf.gds):
+            gd.run()
+
+
+def sequential_reference(max_epochs=2):
+    """Fault-free single-process run over the exact master job order
+    (shuffling disabled on both sides for parity), as in
+    test_service.test_single_slave_matches_standalone."""
+    ref = make_wf("ChaosRef")
+    ref.loader.shuffle_enabled = False
+    ref.loader._start_epoch(first=True)
+    loader = ref.loader
+    for _ in range(max_epochs * loader.effective_batches_per_epoch):
+        loader.run()
+        run_iteration(ref)
+    return numpy.array(ref.forwards[0].weights.map_read().mem)
+
+
+# -- lease fencing (deterministic, handle-level) -----------------------
+
+
+def test_unknown_or_revoked_slave_is_fenced():
+    """Satellite: job/update/ping from ids not in self.slaves (never
+    helloed, or dropped) are rejected, not served/merged."""
+    wf = make_wf("FenceUnknown", max_epochs=None)
+    wf.decision.max_epochs = 2
+    server = MasterServer(wf, "127.0.0.1:0", max_epochs=2)
+
+    assert server.handle(("job", 999, "bogus")) == ("stale",)
+    assert server.handle(("ping", 999, "bogus")) == ("stale",)
+    assert server.handle(
+        ("update", 999, "bogus", 1, 0, {})) == ("stale",)
+    assert server.faults["stale_jobs"] == 1
+    assert server.faults["stale_pings"] == 1
+    assert server.faults["fenced_updates"] == 1
+
+    # a real hello with a WRONG lease id is equally dead (a slave
+    # from a previous master incarnation whose id got re-minted)
+    kind, sid, lease = server.handle(("hello", "zombie"))
+    assert kind == "welcome" and lease
+    assert server.handle(("job", sid, "not-the-lease")) == ("stale",)
+    assert server.handle(("ping", sid, lease)) == ("pong", 0)
+
+    # dropping the slave revokes the lease outright
+    server.drop_slave(sid)
+    assert server.faults["drops"] == 1
+    assert server.handle(("job", sid, lease)) == ("stale",)
+
+
+def test_duplicate_update_fenced_weights_identical():
+    """Satellite: replaying an already-applied update must leave the
+    master weights BITWISE identical — the job_id was consumed, the
+    duplicate is fenced instead of double-counted."""
+    master_wf = make_wf("FenceMaster", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2)
+    _, sid, lease = server.handle(("hello", "fence-slave"))
+
+    slave_wf = make_wf("FenceSlave")
+    slave_wf.is_slave = True
+    sreg = DistributionRegistry(slave_wf)
+
+    # pull jobs until a TRAIN minibatch (valid/test jobs carry no
+    # weight delta, so a double-apply of them would prove nothing)
+    loader_name = master_wf.loader.name
+    for _ in range(64):
+        resp = server.handle(("job", sid, lease))
+        assert resp[0] == "job", resp
+        _, payload, job_id, epoch = resp[:4]
+        if payload[loader_name][0] == CLASS_TRAIN:
+            break
+    else:
+        pytest.fail("no train job served")
+
+    sreg.apply_job(payload)
+    run_iteration(slave_wf)
+    update = sreg.generate_update()
+
+    assert server.handle(
+        ("update", sid, lease, job_id, epoch, update)) == ("ok",)
+    w_once = numpy.array(master_wf.forwards[0].weights.map_read().mem)
+    # the replay: same lease, same job_id, same bytes
+    assert server.handle(
+        ("update", sid, lease, job_id, epoch, update)) == ("stale",)
+    assert server.faults["fenced_updates"] == 1
+    numpy.testing.assert_array_equal(
+        master_wf.forwards[0].weights.map_read().mem, w_once)
+
+    # stale-epoch fencing: a job minted now, acknowledged with a wrong
+    # epoch tag, is refused too
+    resp = server.handle(("job", sid, lease))
+    if resp[0] == "job":
+        _, payload2, job2, epoch2 = resp[:4]
+        assert server.handle(
+            ("update", sid, lease, job2, epoch2 + 1, {})) == ("stale",)
+
+
+def test_mid_job_kill_requeues_and_completes():
+    """Satellite: kill a slave mid-job (socket severed, no update) —
+    the master requeues its minibatch within the timeout bound and a
+    healthy slave finishes the run."""
+    master_wf = make_wf("KillMaster", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2,
+                          slave_timeout=5.0)
+    server.start_background()
+    addr = server.bound_address
+
+    # raw-frame slave: hello, take a job, die without updating
+    sock = socket.create_connection(addr, timeout=10)
+    send_frame(sock, ("hello", "doomed"))
+    _, sid, lease = recv_frame(sock)
+    send_frame(sock, ("job", sid, lease))
+    resp = recv_frame(sock)
+    assert resp[0] == "job"
+    stolen_job = resp[1][master_wf.loader.name]
+    # impolite death: RST, not FIN (SO_LINGER 0)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                    struct.pack("ii", 1, 0))
+    sock.close()
+
+    deadline = time.time() + 10
+    while time.time() < deadline and server.faults["drops"] < 1:
+        time.sleep(0.02)
+    st = server.status()
+    assert st["faults"]["drops"] >= 1, st
+    assert st["faults"]["requeued_jobs"] >= 1, st
+    # the stolen minibatch is back at the head of the queue
+    assert master_wf.loader._pending_jobs[0] == stolen_job
+
+    healthy = make_wf("KillHealthy")
+    healthy.is_slave = True
+    client = SlaveClient(healthy, "127.0.0.1:%d" % addr[1],
+                         name="healthy", io_timeout=10.0)
+    client.run_forever()
+    assert server.done.is_set()
+    assert server.status()["faults"]["drops"] >= 1
+
+
+def test_slave_reconnects_through_connection_kill():
+    """Auto-reconnect: sever the slave's connection mid-run (via the
+    proxy) — run_forever re-hellos on a fresh lease and finishes."""
+    master_wf = make_wf("ReconMaster", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2,
+                          slave_timeout=5.0)
+    server.start_background()
+
+    with ChaosProxy(("127.0.0.1", server.bound_address[1])) as proxy:
+        slave_wf = make_wf("ReconSlave")
+        slave_wf.is_slave = True
+        client = SlaveClient(slave_wf, proxy.address, name="recon",
+                             io_timeout=2.0, retry_base=0.02,
+                             retry_max=0.2, max_retries=20)
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(client.run_forever()))
+        t.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and client.jobs_done < 2:
+            time.sleep(0.01)
+        assert client.jobs_done >= 2, "slave never got going"
+        proxy.kill_all()
+        t.join(timeout=120)
+        assert done, "slave did not survive the kill"
+    assert server.done.is_set()
+    assert client.reconnects >= 1
+    assert server.status()["faults"]["drops"] >= 1
+
+
+def test_clean_completion_counts_no_faults():
+    """A fault-free run must report ZERO drops/fenced updates — the
+    counters measure degradation, and a polite bye is not a fault."""
+    master_wf = make_wf("CleanMaster", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2)
+    server.start_background()
+    slave_wf = make_wf("CleanSlave")
+    slave_wf.is_slave = True
+    SlaveClient(slave_wf, "127.0.0.1:%d" % server.bound_address[1],
+                name="clean").run_forever()
+    assert server.done.is_set()
+    st = server.status()
+    assert st["faults"]["drops"] == 0, st
+    assert st["faults"]["fenced_updates"] == 0, st
+    assert st["faults"]["requeued_jobs"] == 0, st
+
+
+# -- the acceptance chaos run ------------------------------------------
+
+
+def test_chaos_convergence_two_slaves():
+    """Acceptance: 2 slaves through a ChaosProxy injecting seeded
+    drops/delays, one duplicated update and one mid-job kill —
+    training finishes, status() shows >=1 drop and >=1 fenced update,
+    and the final master weights match the fault-free single-process
+    run within tolerance (every minibatch merged exactly once)."""
+    w_ref = sequential_reference(max_epochs=2)
+
+    master_wf = make_wf("ChaosMaster", max_epochs=None)
+    master_wf.loader.shuffle_enabled = False
+    master_wf.loader._start_epoch(first=True)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2,
+                          slave_timeout=5.0)
+    server.start_background()
+
+    lock = threading.Lock()
+    seen = {"updates": 0, "jobs": 0, "dup_done": False,
+            "kill_done": False}
+
+    def plan(evt):
+        with lock:
+            if evt.direction == C2S and evt.kind == "update":
+                seen["updates"] += 1
+                # exactly one duplicated update frame: the fence must
+                # keep it from double-counting
+                if seen["updates"] == 3 and not seen["dup_done"]:
+                    seen["dup_done"] = True
+                    return DUP
+            if evt.direction == S2C and evt.kind == "job":
+                seen["jobs"] += 1
+                # exactly one mid-job kill: the job payload dies on
+                # the wire, the connection is severed, the master must
+                # requeue
+                if seen["jobs"] == 5 and not seen["kill_done"]:
+                    seen["kill_done"] = True
+                    return TRUNCATE
+        return None                   # fall through to seeded rates
+
+    with ChaosProxy(("127.0.0.1", server.bound_address[1]), seed=1337,
+                    plan=plan, drop_rate=0.01, delay_rate=0.10,
+                    delay_s=0.01) as proxy:
+        slaves = [make_wf("ChaosSlave%d" % i) for i in range(2)]
+        clients = []
+        for wf in slaves:
+            wf.is_slave = True
+        errors = []
+
+        def run_slave(wf, idx):
+            client = SlaveClient(
+                wf, proxy.address, name="chaos-%d" % idx,
+                io_timeout=2.0, retry_base=0.02, retry_max=0.25,
+                max_retries=25)
+            clients.append(client)
+            try:
+                client.run_forever()
+            except ConnectionError:
+                # the master tears down after done: a slave caught
+                # mid-reconnect is allowed to give up THEN, never
+                # before
+                if not server.done.is_set():
+                    errors.append("gave up before done")
+
+        threads = [threading.Thread(target=run_slave, args=(wf, i))
+                   for i, wf in enumerate(slaves)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, errors
+        assert server.done.is_set(), server.status()
+        stats = proxy.stats()
+
+    st = server.status()
+    assert st["faults"]["drops"] >= 1, (st, stats)
+    assert st["faults"]["fenced_updates"] >= 1, (st, stats)
+    assert seen["dup_done"] and seen["kill_done"], (seen, stats)
+
+    w_master = numpy.asarray(
+        master_wf.forwards[0].weights.map_read().mem)
+    assert numpy.isfinite(w_master).all()
+    # exactly-once merge per minibatch: only slave-interleaving keeps
+    # this from being bitwise
+    numpy.testing.assert_allclose(
+        w_master, w_ref, atol=0.02,
+        err_msg=str({"status": st, "proxy": stats}))
+
+
+@pytest.mark.slow
+def test_chaos_soak_heavy_rates():
+    """Soak variant: sustained seeded drop/dup/delay rates over more
+    epochs; completion + exactly-once accounting only (no weight
+    parity — requeue reordering compounds)."""
+    master_wf = make_wf("SoakMaster", max_epochs=None)
+    master_wf.decision.max_epochs = 4
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=4,
+                          slave_timeout=5.0)
+    server.start_background()
+    with ChaosProxy(("127.0.0.1", server.bound_address[1]), seed=99,
+                    drop_rate=0.03, dup_rate=0.02, delay_rate=0.2,
+                    delay_s=0.02) as proxy:
+        slaves = [make_wf("SoakSlave%d" % i) for i in range(3)]
+        for wf in slaves:
+            wf.is_slave = True
+
+        def run_slave(wf, idx):
+            try:
+                SlaveClient(wf, proxy.address, name="soak-%d" % idx,
+                            io_timeout=2.0, retry_base=0.02,
+                            retry_max=0.25,
+                            max_retries=50).run_forever()
+            except ConnectionError:
+                pass
+        threads = [threading.Thread(target=run_slave, args=(wf, i))
+                   for i, wf in enumerate(slaves)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert server.done.is_set()
+        assert proxy.faults_injected() > 0
+    w = master_wf.forwards[0].weights.map_read().mem
+    assert numpy.isfinite(w).all()
+
+
+# -- client robustness -------------------------------------------------
+
+
+def test_connect_rejects_bad_welcome():
+    """Satellite: a malformed handshake raises ConnectionError (not a
+    bare assert that vanishes under python -O), and a server that
+    hangs up mid-handshake does too."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen()
+    port = listener.getsockname()[1]
+    wf = make_wf("BadWelcome")
+    wf.is_slave = True
+
+    def serve_one(frame):
+        conn, _ = listener.accept()
+        recv_frame(conn)
+        if frame is not None:
+            send_frame(conn, frame)
+        conn.close()
+
+    for frame in [("hello", "i-am-not-a-master"), ("welcome", 1),
+                  None]:
+        t = threading.Thread(target=serve_one, args=(frame,))
+        t.start()
+        client = SlaveClient(wf, "127.0.0.1:%d" % port,
+                             io_timeout=5.0)
+        with pytest.raises(ConnectionError):
+            client.connect()
+        t.join(timeout=10)
+    listener.close()
+
+
+def test_backoff_is_capped_with_jitter():
+    wf = make_wf("BackoffWf")
+    wf.is_slave = True
+    client = SlaveClient(wf, "127.0.0.1:1", retry_base=0.05,
+                         retry_max=2.0)
+    for attempt in range(1, 12):
+        d = client._backoff(attempt)
+        assert 0.0 < d <= 2.0 * 1.25
+    assert client._backoff(1) <= 0.05 * 1.25
+
+
+def test_client_gives_up_after_max_retries():
+    """Capped retries: with nothing listening, run_forever raises
+    after max_retries consecutive failures instead of spinning."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()                     # nothing listens here now
+    wf = make_wf("GiveUpWf")
+    wf.is_slave = True
+    client = SlaveClient(wf, "127.0.0.1:%d" % dead_port,
+                         io_timeout=0.5, retry_base=0.01,
+                         retry_max=0.05, max_retries=3)
+    with pytest.raises(ConnectionError, match="giving up"):
+        client.run_forever()
+    assert client.reconnects == 3
+
+
+# -- ChaosProxy mechanics ----------------------------------------------
+
+
+def test_chaos_decide_plan_beats_rates_and_is_seeded():
+    import random
+    proxy = ChaosProxy.__new__(ChaosProxy)    # no sockets needed
+    proxy.plan = None
+    proxy.drop_rate, proxy.dup_rate = 0.5, 0.5
+    proxy.delay_rate = proxy.truncate_rate = 0.0
+    evt = ChaosEvent(C2S, 0, 0, "update", 1)
+    # seeded rates: same rng seed -> same decision sequence
+    a = [proxy._decide(evt, random.Random(7)) for _ in range(5)]
+    b = [proxy._decide(evt, random.Random(7)) for _ in range(5)]
+    assert a == b and set(a) <= {DROP, DUP}
+    # cumulative thresholds exhaust to PASS
+    proxy.drop_rate = proxy.dup_rate = 0.0
+    assert proxy._decide(evt, random.Random(7)) == PASS
+    # an explicit plan wins over any rates
+    proxy.plan = lambda e: DELAY
+    proxy.drop_rate = 1.0
+    assert proxy._decide(evt, random.Random(7)) == DELAY
+    proxy.plan = lambda e: "explode"
+    with pytest.raises(ValueError):
+        proxy._decide(evt, random.Random(7))
+
+
+def test_chaos_proxy_counts_and_passes_frames():
+    """A plain proxied hello/ping round-trip works and is counted."""
+    wf = make_wf("ProxyCount", max_epochs=None)
+    wf.decision.max_epochs = 2
+    server = MasterServer(wf, "127.0.0.1:0", max_epochs=2,
+                          slave_timeout=5.0)
+    server.start_background()
+    with ChaosProxy(("127.0.0.1", server.bound_address[1])) as proxy:
+        sock = socket.create_connection(("127.0.0.1", proxy.port),
+                                        timeout=10)
+        send_frame(sock, ("hello", "count-me"))
+        kind, sid, lease = recv_frame(sock)
+        assert kind == "welcome"
+        send_frame(sock, ("ping", sid, lease))
+        assert recv_frame(sock) == ("pong", 0)
+        sock.close()
+        stats = proxy.stats()
+    assert stats["connections"] == 1
+    assert stats[C2S][PASS] >= 2 and stats[S2C][PASS] >= 2
+    server.done.set()
+
+
+# -- snapshot store degradation ----------------------------------------
+
+
+def _dead_port():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
+def test_http_store_retries_then_breaker_opens():
+    from veles.snapshotter import CircuitOpenError, HTTPSnapshotStore
+    store = HTTPSnapshotStore(
+        "http://127.0.0.1:%d/snaps" % _dead_port(), timeout=0.5,
+        retries=1, retry_backoff=0.01, breaker_threshold=2,
+        breaker_reset=60.0)
+    for _ in range(2):
+        with pytest.raises(OSError):
+            store.get("x.ckpt.npz.gz")
+    m = store.metrics()
+    assert m["breaker_open"] and m["breaker_trips"] == 1
+    assert m["retries"] >= 2          # each attempt retried once
+    # breaker open -> instant fail, no socket work
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        store.get("x.ckpt.npz.gz")
+    assert time.monotonic() - t0 < 0.1
+    assert store.metrics()["breaker_fast_fails"] == 1
+
+
+def test_http_store_breaker_half_open_recovers():
+    """After breaker_reset one probe goes through; success closes the
+    breaker (and a 5xx-flapping server is retried to success)."""
+    import http.server
+    import json as _json
+    fails = {"n": 2}
+    blobs = {"snaps/ok.ckpt.npz": b"payload"}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                self.send_response(503)
+                self.end_headers()
+                return
+            name = self.path.lstrip("/")
+            if name.endswith("/") or not name:
+                body = _json.dumps(sorted(blobs)).encode()
+            elif name in blobs:
+                body = blobs[name]
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from veles.snapshotter import (CircuitOpenError,
+                                       HTTPSnapshotStore)
+        url = "http://127.0.0.1:%d/snaps" % httpd.server_address[1]
+        # 5xx then success within one call's retry budget
+        store = HTTPSnapshotStore(url, timeout=5, retries=3,
+                                  retry_backoff=0.01)
+        assert store.get("ok.ckpt.npz") == b"payload"
+        assert store.metrics()["retries"] == 2
+        assert not store.metrics()["breaker_open"]
+
+        # force the breaker open, then let the reset window pass: the
+        # half-open probe succeeds and closes it
+        store2 = HTTPSnapshotStore(url, timeout=5, retries=0,
+                                   breaker_threshold=1,
+                                   breaker_reset=0.2)
+        fails["n"] = 1
+        with pytest.raises(OSError):
+            store2.get("ok.ckpt.npz")
+        assert store2.breaker_open()
+        with pytest.raises(CircuitOpenError):
+            store2.get("ok.ckpt.npz")
+        time.sleep(0.25)
+        # half-open admits exactly one probe: a second caller racing
+        # the probe window fast-fails instead of stacking timeouts
+        with store2._lock:
+            store2._probe_in_flight = True
+        with pytest.raises(CircuitOpenError):
+            store2.get("ok.ckpt.npz")
+        with store2._lock:
+            store2._probe_in_flight = False
+        assert store2.get("ok.ckpt.npz") == b"payload"
+        assert not store2.breaker_open()
+        # a 404 is an ANSWER, not a health event: no breaker action
+        with pytest.raises(KeyError):
+            store2.get("missing.ckpt.npz")
+        assert not store2.breaker_open()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_store_for_shares_breaker_state():
+    """store_for caches one HTTPSnapshotStore per base URL so repeated
+    checkpoint refreshes share a circuit breaker."""
+    from veles.snapshotter import store_for
+    url = "http://127.0.0.1:%d/bucket" % _dead_port()
+    s1, name1 = store_for(url + "/a.ckpt.npz.gz")
+    s2, name2 = store_for(url + "/b.ckpt.npz.gz")
+    assert s1 is s2
+    assert (name1, name2) == ("a.ckpt.npz.gz", "b.ckpt.npz.gz")
+
+
+def test_registry_reload_degrades_not_dies():
+    """A failed hot reload (source gone / checkpoint store down) keeps
+    serving the loaded version and counts the failure."""
+    from veles.serving.registry import ModelRegistry
+
+    class FakeEntry:
+        name = "m"
+        source = "/nonexistent/archive-dir"
+        checkpoint = None
+        version = 3
+
+    reg = ModelRegistry(backend="numpy")
+    entry = FakeEntry()
+    reg._models["m"] = entry
+    assert reg.reload("m") is entry           # degraded, not raised
+    assert reg._refresh_failures["m"] == 1
+    assert reg.reload("m") is entry
+    assert reg._refresh_failures["m"] == 2
+
+
+def test_web_status_renders_cluster_faults():
+    from veles.web_status import WebStatus
+    status = WebStatus(port=0)
+    try:
+        status.register("cluster", lambda: {
+            "mode": "master", "n_slaves": 2,
+            "faults": {"drops": 1, "fenced_updates": 2}})
+        page = status.render_page()
+        assert "n_slaves" in page and "faults" in page
+        assert "fenced_updates" in page
+    finally:
+        status.close()

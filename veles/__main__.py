@@ -110,13 +110,15 @@ def build_argparser():
                         "6x12x4 evaluates 4 individuals concurrently "
                         "in spawned worker processes); fitness = best "
                         "validation metric")
-    p.add_argument("--slave-timeout", type=float, default=3600.0,
+    p.add_argument("--slave-timeout", type=float, default=None,
                    metavar="SECONDS",
-                   help="GA master (--optimize + --listen-address): "
-                        "drop a silent slave and requeue its task "
-                        "after this long; must exceed the longest "
-                        "single evaluation (a slave is legitimately "
-                        "mute while training an individual)")
+                   help="master modes: drop a silent slave and "
+                        "requeue its work after this long. Default "
+                        "60s for the training master (jobs are one "
+                        "minibatch) and 3600s for the GA master "
+                        "(--optimize: jobs are whole training runs, "
+                        "so this must exceed the longest single "
+                        "evaluation)")
     p.add_argument("--ensemble", type=int, default=None, metavar="N",
                    help="train N differently-seeded instances and "
                         "report ensemble vs member validation error")
@@ -183,7 +185,8 @@ class Main:
             master_address=args.master_address,
             graphics_dir=args.graphics_dir,
             web_status_port=args.web_status,
-            profile_dir=args.profile_dir)
+            profile_dir=args.profile_dir,
+            slave_timeout=args.slave_timeout)
         if args.graphics_dir and not getattr(
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):
@@ -333,7 +336,9 @@ class Main:
         if slaves:
             map_cm = GATaskServer(
                 self.args.listen_address,
-                slave_timeout=self.args.slave_timeout)
+                slave_timeout=3600.0
+                if self.args.slave_timeout is None
+                else self.args.slave_timeout)
             print(json.dumps({"ga_master_listen":
                               "%s:%d" % map_cm.bound_address}),
                   flush=True)

@@ -1,0 +1,280 @@
+"""Deterministic fault injection for the master↔slave wire layer.
+
+:class:`ChaosProxy` is a TCP proxy that sits between a
+:class:`~veles.client.SlaveClient` and a
+:class:`~veles.server.MasterServer` and mutates traffic at FRAME
+granularity (the 4-byte length + 32-byte HMAC + pickle framing from
+``veles/server.py``), so tests can prove the fault-tolerance story —
+drop→requeue, duplicate-update fencing, reconnect-with-backoff — end
+to end over real sockets without ever being flaky themselves:
+
+* every decision comes from either an explicit ``plan`` callable
+  (exact frames: "duplicate the 2nd update on connection 0") or a
+  per-(connection, direction) PRNG seeded from ``seed`` — never from
+  wall-clock or thread scheduling;
+* actions: ``pass``, ``drop`` (swallow the frame), ``dup`` (forward
+  it twice), ``delay`` (sleep ``delay_s`` first), ``truncate`` (send
+  a partial frame, then sever the connection — the mid-frame host
+  death);
+* :meth:`kill_all` severs every live connection (whole-process slave
+  kill); :meth:`stats` counts what was done to whom.
+
+The proxy peeks inside frames (they're this repo's own pickles, on
+loopback, in tests) to expose the request kind (``hello`` / ``job`` /
+``update`` / ...) to the plan — fencing tests target "the update
+frame", not "frame #7".
+"""
+
+import pickle
+import random
+import socket
+import struct
+import threading
+import time
+
+from veles.logger import Logger
+from veles.server import _recv_exact
+
+PASS = "pass"
+DROP = "drop"
+DUP = "dup"
+DELAY = "delay"
+TRUNCATE = "truncate"
+
+ACTIONS = (PASS, DROP, DUP, DELAY, TRUNCATE)
+
+#: client→server / server→client direction tags handed to plans
+C2S = "c2s"
+S2C = "s2c"
+
+
+class ChaosEvent:
+    """What the plan sees for one frame."""
+
+    __slots__ = ("direction", "conn_id", "index", "kind", "nth")
+
+    def __init__(self, direction, conn_id, index, kind, nth):
+        self.direction = direction   # C2S | S2C
+        self.conn_id = conn_id       # accept order, 0-based
+        self.index = index           # frame number in this direction
+        self.kind = kind             # request/response tuple tag
+        self.nth = nth               # occurrence number of this kind
+
+    def __repr__(self):
+        return ("ChaosEvent(%s conn=%d #%d kind=%r nth=%d)"
+                % (self.direction, self.conn_id, self.index,
+                   self.kind, self.nth))
+
+
+class _Pump(threading.Thread):
+    """One direction of one proxied connection."""
+
+    def __init__(self, proxy, src, dst, direction, conn_id):
+        super().__init__(daemon=True,
+                         name="chaos-%s-%d" % (direction, conn_id))
+        self.proxy = proxy
+        self.src = src
+        self.dst = dst
+        self.direction = direction
+        self.conn_id = conn_id
+        # schedule determinism: the rng depends only on (seed,
+        # conn_id, direction), never on which pump thread ran first
+        self.rng = random.Random(
+            (proxy.seed, conn_id, direction).__repr__())
+        self.index = 0
+        self.kind_counts = {}
+
+    def run(self):
+        try:
+            while not self.proxy._closing.is_set():
+                header = _recv_exact(self.src, 4)
+                if header is None:
+                    break
+                size, = struct.unpack(">I", header)
+                tag = _recv_exact(self.src, 32)
+                blob = _recv_exact(self.src, size) \
+                    if tag is not None else None
+                if blob is None:
+                    break
+                if not self._relay(header, tag, blob):
+                    break
+        except OSError:
+            pass
+        finally:
+            self.proxy._sever(self.conn_id)
+
+    def _relay(self, header, tag, blob):
+        kind = self._peek(blob)
+        nth = self.kind_counts[kind] = self.kind_counts.get(kind, 0) + 1
+        event = ChaosEvent(self.direction, self.conn_id, self.index,
+                           kind, nth)
+        self.index += 1
+        action = self.proxy._decide(event, self.rng)
+        self.proxy._count(self.direction, action)
+        frame = header + tag + blob
+        if action == DROP:
+            self.proxy.debug("drop %r", event)
+            return True
+        if action == TRUNCATE:
+            self.proxy.debug("truncate %r", event)
+            try:
+                self.dst.sendall(frame[:max(5, len(frame) // 2)])
+            except OSError:
+                pass
+            return False               # sever the connection
+        if action == DELAY:
+            time.sleep(self.proxy.delay_s)
+        try:
+            self.dst.sendall(frame)
+            if action == DUP:
+                self.proxy.debug("dup %r", event)
+                self.dst.sendall(frame)
+        except OSError:
+            return False
+        return True
+
+    def _peek(self, blob):
+        # frames are our own HMAC-verified-shape pickles on loopback;
+        # surface the protocol tag so plans can target by meaning
+        try:
+            obj = pickle.loads(blob)
+            return obj[0] if isinstance(obj, tuple) and obj else None
+        except Exception:
+            return None
+
+
+class ChaosProxy(Logger):
+    """``ChaosProxy(("127.0.0.1", master_port), seed=7, drop_rate=.02)``
+    then point slaves at ``"127.0.0.1:%d" % proxy.port``.
+
+    ``plan(event) -> action|None`` wins when it returns an action;
+    ``None`` falls through to the seeded rates (cumulative
+    drop/dup/delay/truncate probabilities per frame)."""
+
+    def __init__(self, target, seed=0, plan=None, drop_rate=0.0,
+                 dup_rate=0.0, delay_rate=0.0, delay_s=0.05,
+                 truncate_rate=0.0, listen_host="127.0.0.1"):
+        self.name = "ChaosProxy"
+        host, _, port = str(target).rpartition(":") \
+            if isinstance(target, str) else (target[0], ":", target[1])
+        self.target = (host or "127.0.0.1", int(port))
+        self.seed = seed
+        self.plan = plan
+        self.drop_rate = float(drop_rate)
+        self.dup_rate = float(dup_rate)
+        self.delay_rate = float(delay_rate)
+        self.delay_s = float(delay_s)
+        self.truncate_rate = float(truncate_rate)
+        self._lock = threading.Lock()
+        self._stats = {C2S: dict.fromkeys(ACTIONS, 0),
+                       S2C: dict.fromkeys(ACTIONS, 0)}
+        self._conns = {}              # conn_id -> (client, upstream)
+        self._next_conn = 0
+        self._closing = threading.Event()
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, 0))
+        self._listener.listen()
+        self.port = self._listener.getsockname()[1]
+        self.address = "%s:%d" % (listen_host, self.port)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="chaos-accept")
+        self._accept_thread.start()
+
+    # -- wiring --------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                break
+            try:
+                upstream = socket.create_connection(self.target,
+                                                    timeout=10)
+            except OSError as exc:
+                self.warning("upstream %s unreachable: %s",
+                             self.target, exc)
+                client.close()
+                continue
+            with self._lock:
+                conn_id = self._next_conn
+                self._next_conn += 1
+                self._conns[conn_id] = (client, upstream)
+            _Pump(self, client, upstream, C2S, conn_id).start()
+            _Pump(self, upstream, client, S2C, conn_id).start()
+
+    def _sever(self, conn_id):
+        with self._lock:
+            pair = self._conns.pop(conn_id, None)
+        if pair:
+            for sock in pair:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    # -- chaos ---------------------------------------------------------
+
+    def _decide(self, event, rng):
+        if self.plan is not None:
+            action = self.plan(event)
+            if action is not None:
+                if action not in ACTIONS:
+                    raise ValueError("plan returned %r (want one of "
+                                     "%s)" % (action, ACTIONS))
+                return action
+        r = rng.random()
+        for rate, action in ((self.drop_rate, DROP),
+                             (self.dup_rate, DUP),
+                             (self.delay_rate, DELAY),
+                             (self.truncate_rate, TRUNCATE)):
+            if r < rate:
+                return action
+            r -= rate
+        return PASS
+
+    def _count(self, direction, action):
+        with self._lock:
+            self._stats[direction][action] += 1
+
+    # -- control / inspection ------------------------------------------
+
+    def kill_all(self):
+        """Sever every live connection NOW (abrupt whole-slave death:
+        both peers see a reset mid-conversation, nobody sees a FIN
+        handshake's politeness)."""
+        with self._lock:
+            conn_ids = list(self._conns)
+        for conn_id in conn_ids:
+            self._sever(conn_id)
+        return len(conn_ids)
+
+    def stats(self):
+        with self._lock:
+            return {"connections": self._next_conn,
+                    "live": len(self._conns),
+                    C2S: dict(self._stats[C2S]),
+                    S2C: dict(self._stats[S2C])}
+
+    def faults_injected(self):
+        s = self.stats()
+        return sum(s[d][a] for d in (C2S, S2C)
+                   for a in (DROP, DUP, DELAY, TRUNCATE))
+
+    def close(self):
+        self._closing.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.kill_all()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

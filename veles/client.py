@@ -6,9 +6,25 @@ per-unit payloads (loader gets minibatch indices, GD units get fresh
 weights); run one local iteration; push per-unit updates (weights,
 eval counters) }. The compute inside the iteration is whatever the
 local device does best — on TPU the fused per-step program.
+
+Fault tolerance: the client holds a master-minted lease
+``(slave_id, lease_id)`` and tags every request with it. Any
+``("stale",)`` response means the master revoked the lease (the slave
+was dropped and its work requeued) — the client abandons it and
+re-hellos for a fresh one instead of corrupting the average. Any
+socket failure, timeout or protocol desync triggers reconnect with
+exponential backoff + jitter (capped retries), so ``run_forever``
+survives master restarts and flaky networks; a run of successful work
+resets the budget. A background heartbeat thread sends ``("ping",)``
+every ``ping_interval`` whenever the socket is otherwise idle (both
+parked on ``("wait",)`` AND deep in a long local iteration), so the
+master's ``slave_timeout`` measures actual silence, not compute time,
+and the slave sees lease revocation early.
 """
 
+import random
 import socket
+import threading
 import time
 
 from veles.distributable import DistributionRegistry
@@ -17,8 +33,21 @@ from veles.logger import Logger
 from veles.server import send_frame, recv_frame, require_secret_for
 
 
+class StaleLease(ConnectionError):
+    """Master fenced us: the lease is revoked — re-hello, don't retry
+    the same identity."""
+
+
+class ProtocolDesync(ConnectionError):
+    """Response doesn't match the request in flight (e.g. a network
+    middlebox duplicated a frame): the req/resp pairing is lost, the
+    only safe move is a fresh connection."""
+
+
 class SlaveClient(Logger):
-    def __init__(self, workflow, address, name=None):
+    def __init__(self, workflow, address, name=None, io_timeout=30.0,
+                 retry_base=0.05, retry_max=2.0, max_retries=8,
+                 ping_interval=1.0):
         self.name = name or "SlaveClient"
         self.workflow = workflow
         self._check_mode()
@@ -26,16 +55,90 @@ class SlaveClient(Logger):
         self.address = (host or "127.0.0.1", int(port))
         require_secret_for(self.address[0], "slave master")
         self.registry = DistributionRegistry(workflow)
+        self.sock = None
         self.slave_id = None
+        self.lease_id = None
         self.jobs_done = 0
+        #: serializes whole request/response round-trips, so the
+        #: heartbeat thread can ping while the main thread computes
+        #: (socket idle) without ever interleaving half-frames
+        self._io_lock = threading.Lock()
+        self._hb_stop = None
+        self._last_io = 0.0
+        #: per-request socket deadline — a silent master (or a dropped
+        #: frame) unblocks here instead of hanging the slave forever
+        self.io_timeout = float(io_timeout)
+        #: reconnect policy: sleep retry_base·2^k (capped at
+        #: retry_max, +0..25 % jitter so a restarted master isn't
+        #: stampeded) for up to max_retries consecutive failures
+        self.retry_base = float(retry_base)
+        self.retry_max = float(retry_max)
+        self.max_retries = int(max_retries)
+        #: heartbeat period while the master says ("wait",)
+        self.ping_interval = float(ping_interval)
+        #: robustness counters (mirrors MasterServer.faults)
+        self.reconnects = 0
+        self.stale_resyncs = 0
+        self.pings_sent = 0
 
     def connect(self):
-        self.sock = socket.create_connection(self.address, timeout=30)
+        self.sock = socket.create_connection(self.address,
+                                             timeout=self.io_timeout)
+        self.sock.settimeout(self.io_timeout)
         send_frame(self.sock, ("hello", self.name))
-        kind, slave_id = recv_frame(self.sock)
-        assert kind == "welcome"
-        self.slave_id = slave_id
+        welcome = recv_frame(self.sock)
+        # no asserts: they vanish under ``python -O`` and a bad
+        # handshake must fail LOUDLY either way
+        if welcome is None:
+            raise ConnectionError(
+                "master %s:%d closed the connection during handshake"
+                % self.address)
+        if not isinstance(welcome, tuple) or len(welcome) < 3 \
+                or welcome[0] != "welcome":
+            raise ConnectionError(
+                "bad handshake from master %s:%d: expected "
+                "('welcome', slave_id, lease_id), got %r"
+                % (self.address + (welcome,)))
+        self.slave_id, self.lease_id = welcome[1], welcome[2]
+        self._last_io = time.monotonic()
+        self._start_heartbeat()
         return self
+
+    def _start_heartbeat(self):
+        """Best-effort liveness pings whenever the socket has been
+        idle for ``ping_interval`` — covers both ("wait",) parking and
+        LONG LOCAL ITERATIONS, so the master's slave_timeout measures
+        silence, not compute time, and revocation is noticed early.
+        The thread is pinned to THIS connection's socket and does its
+        round-trip under the io lock, so it can never interleave
+        half-frames with the main loop or touch a reconnected socket.
+        Errors just stop the beat: the main loop's next round-trip
+        surfaces them with full reconnect handling."""
+        if self.ping_interval <= 0:
+            return
+        self._hb_stop = stop = threading.Event()
+        sock = self.sock
+
+        def beat():
+            while not stop.wait(self.ping_interval):
+                try:
+                    if time.monotonic() - self._last_io \
+                            < self.ping_interval:
+                        continue
+                    with self._io_lock:
+                        if self.sock is not sock or stop.is_set():
+                            return
+                        send_frame(sock, ("ping", self.slave_id,
+                                          self.lease_id))
+                        resp = recv_frame(sock)
+                        self._last_io = time.monotonic()
+                    if resp is None or resp[0] != "pong":
+                        return
+                    self.pings_sent += 1
+                except Exception:
+                    return
+        threading.Thread(target=beat, daemon=True,
+                         name="%s-heartbeat" % self.name).start()
 
     def _check_mode(self):
         """A slave must serve the indices the MASTER assigns per job;
@@ -50,23 +153,42 @@ class SlaveClient(Logger):
                 "mode; set workflow.is_slave = True before "
                 "initialize()")
 
+    def _roundtrip(self, request):
+        with self._io_lock:
+            send_frame(self.sock, request)
+            resp = recv_frame(self.sock)
+            self._last_io = time.monotonic()
+        if resp is None:
+            raise ConnectionError("master closed the connection")
+        if resp == ("stale",):
+            self.stale_resyncs += 1
+            raise StaleLease(
+                "master fenced %r for slave %s — lease %s revoked"
+                % (request[0], self.slave_id, self.lease_id))
+        return resp
+
     def run_one(self):
         """Request + run one job; False when the master says stop."""
         self._check_mode()
-        send_frame(self.sock, ("job", self.slave_id))
-        resp = recv_frame(self.sock)
-        if resp is None or resp[0] == "bye":
+        resp = self._roundtrip(("job", self.slave_id, self.lease_id))
+        if resp[0] == "bye":
             return False
         if resp[0] == "wait":
             time.sleep(0.02)
             return True
-        self.registry.apply_job(resp[1])
+        if resp[0] != "job" or len(resp) < 4:
+            raise ProtocolDesync(
+                "expected a job, got %r" % (resp[:1],))
+        _, payload, job_id, epoch = resp[:4]
+        self.registry.apply_job(payload)
         self._run_iteration()
-        send_frame(self.sock,
-                   ("update", self.slave_id, self.registry.generate_update()))
-        ok = recv_frame(self.sock)
+        ok = self._roundtrip(
+            ("update", self.slave_id, self.lease_id, job_id, epoch,
+             self.registry.generate_update()))
+        if ok[0] != "ok":
+            raise ProtocolDesync("expected ok, got %r" % (ok[:1],))
         self.jobs_done += 1
-        return ok is not None
+        return True
 
     def _run_iteration(self):
         """One forward/backward/update pass over the minibatch the
@@ -86,15 +208,64 @@ class SlaveClient(Logger):
                 for gd in reversed(wf.gds):
                     gd.run()
 
-    def run_forever(self):
-        self.connect()
-        try:
-            while self.run_one():
-                pass
-        finally:
+    def _close_sock(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
+        if self.sock is not None:
             try:
                 self.sock.close()
             except OSError:
                 pass
-        self.info("slave done after %d jobs", self.jobs_done)
+            self.sock = None
+
+    def _backoff(self, attempt):
+        delay = min(self.retry_max,
+                    self.retry_base * (2 ** max(0, attempt - 1)))
+        return delay * (1.0 + 0.25 * random.random())
+
+    def run_forever(self):
+        """Pump jobs until the master says ``bye``, surviving master
+        restarts, revoked leases and connection hiccups: reconnect +
+        re-hello with exponential backoff, giving up only after
+        ``max_retries`` consecutive failures without progress."""
+        attempt = 0
+        while True:
+            try:
+                if self.sock is None:
+                    self.connect()
+                if not self.run_one():
+                    break
+                attempt = 0           # progress resets the budget
+            except (ConnectionError, OSError) as exc:
+                # socket.timeout is an OSError; StaleLease and
+                # ProtocolDesync are ConnectionErrors. A StaleLease is
+                # the normal zombie outcome (the master already
+                # requeued our in-flight work when it dropped us), the
+                # rest are network trouble — either way the old
+                # identity is abandoned cleanly (id/lease zeroed so no
+                # further frame can reuse them) and we re-hello, with
+                # the same consecutive-failure budget guarding against
+                # a master that fences or drops us forever.
+                attempt += 1
+                if attempt > self.max_retries:
+                    self._close_sock()
+                    raise ConnectionError(
+                        "giving up on master %s:%d after %d failed "
+                        "attempts (last: %s)"
+                        % (self.address + (attempt - 1, exc)))
+                self.warning(
+                    "%s: %s; re-sync %d/%d", type(exc).__name__, exc,
+                    attempt, self.max_retries)
+                self._resync(attempt)
+        self._close_sock()
+        self.info("slave done after %d jobs (%d reconnects, %d stale "
+                  "re-syncs)", self.jobs_done, self.reconnects,
+                  self.stale_resyncs)
         return self.jobs_done
+
+    def _resync(self, attempt):
+        self._close_sock()
+        self.slave_id = self.lease_id = None
+        self.reconnects += 1
+        time.sleep(self._backoff(attempt))

@@ -37,7 +37,9 @@ class IDistributable:
         """Master: merge a slave update (e.g. parameter averaging)."""
 
     def drop_slave(self, slave=None):
-        """Master: a slave died — requeue its in-flight work."""
+        """Master: a slave died — requeue its in-flight work. May
+        return the number of requeued items (the registry sums these
+        into the master's robustness counters)."""
 
 
 class TriviallyDistributable(IDistributable):
@@ -73,11 +75,23 @@ class DistributionRegistry:
                 if isinstance(unit, IDistributable)}
 
     def apply_update(self, update, slave=None):
+        """Merge one slave update; -> how many units consumed data
+        (0 means the payload named no unit of this workflow — a
+        config-mismatched peer the master should hear about)."""
+        merged = 0
         for unit in self.workflow:
             if isinstance(unit, IDistributable) and unit.name in update:
                 unit.apply_data_from_slave(update[unit.name], slave)
+                merged += 1
+        return merged
 
     def drop_slave(self, slave=None):
+        """Requeue a dead slave's in-flight work across all units;
+        -> total requeued items (for the fault counters)."""
+        requeued = 0
         for unit in self.workflow:
             if isinstance(unit, IDistributable):
-                unit.drop_slave(slave)
+                count = unit.drop_slave(slave)
+                if isinstance(count, int):
+                    requeued += count
+        return requeued

@@ -425,9 +425,11 @@ class GATaskServer(Logger):
                 return ("ok",)
         return ("error", "unknown request %r" % (kind,))
 
-    def drop_slave(self, slave_id):
+    def drop_slave(self, slave_id, clean=False):
         """Death mid-task -> the task goes back to the pending pool
-        (same requeue contract as the training master)."""
+        (same requeue contract as the training master; ``clean`` is
+        the framed_server polite-bye flag — inflight is empty then,
+        so the requeue below is a no-op)."""
         with self.lock:
             idx = self.inflight.pop(slave_id, None)
             if idx is not None and idx not in self.results:

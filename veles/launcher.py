@@ -29,13 +29,21 @@ class Launcher(Logger):
     def __init__(self, device=None, snapshot=None, stats=True,
                  listen_address=None, master_address=None,
                  graphics_dir=None, web_status_port=None,
-                 profile_dir=None):
+                 profile_dir=None, slave_timeout=None,
+                 slave_options=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
         self.stats = stats
         self.listen_address = listen_address
         self.master_address = master_address
+        #: master mode: drop a silent slave (and requeue its work)
+        #: after this many seconds; None -> MasterServer's finite
+        #: default
+        self.slave_timeout = slave_timeout
+        #: slave mode: SlaveClient fault-tolerance kwargs
+        #: (io_timeout, retry_base, retry_max, max_retries, ...)
+        self.slave_options = dict(slave_options or {})
         self.workflow = None
         self.interrupted = False
         #: directory for a jax.profiler trace of the run (XLA op/HLO
@@ -137,7 +145,10 @@ class Launcher(Logger):
 
     def _run_master(self):
         from veles.server import MasterServer
-        server = MasterServer(self.workflow, self.listen_address)
+        kwargs = {} if self.slave_timeout is None \
+            else {"slave_timeout": self.slave_timeout}
+        server = MasterServer(self.workflow, self.listen_address,
+                              **kwargs)
         self.master_server = server
         if self.web_status is not None:
             # cluster topology on the dashboard: connected slaves and
@@ -147,7 +158,9 @@ class Launcher(Logger):
 
     def _run_slave(self):
         from veles.client import SlaveClient
-        client = SlaveClient(self.workflow, self.master_address)
+        client = SlaveClient(self.workflow, self.master_address,
+                             **self.slave_options)
+        self.slave_client = client
         client.run_forever()
 
 

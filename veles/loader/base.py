@@ -353,6 +353,9 @@ class Loader(Unit, IDistributable):
             self._inflight[slave].pop(0)
 
     def drop_slave(self, slave=None):
-        """Re-queue in-flight minibatches of a dead slave (§5.3)."""
-        for job in self._inflight.pop(slave, []):
+        """Re-queue in-flight minibatches of a dead slave (§5.3);
+        -> how many were requeued."""
+        jobs = self._inflight.pop(slave, [])
+        for job in jobs:
             self._pending_jobs.insert(0, job)
+        return len(jobs)

@@ -9,25 +9,46 @@ length-prefixed pickle frames is sufficient and dependency-free.
 
 Protocol (client-initiated, synchronous per connection):
 
-* ``("hello", name)``            → ``("welcome", slave_id)``
-* ``("job", slave_id)``          → ``("job", payload)`` |
-                                   ``("wait",)`` | ``("bye",)``
-* ``("update", slave_id, data)`` → ``("ok",)``
+* ``("hello", name)``       → ``("welcome", slave_id, lease_id)``
+* ``("job", sid, lease)``   → ``("job", payload, job_id, epoch)`` |
+                              ``("wait",)`` | ``("bye",)`` |
+                              ``("stale",)``
+* ``("update", sid, lease, job_id, epoch, data)``
+                            → ``("ok",)`` | ``("stale",)``
+* ``("ping", sid, lease)``  → ``("pong", epoch)`` | ``("stale",)``
 
 ``payload`` is the per-unit dict from
 :class:`veles.distributable.DistributionRegistry` (loader ships
 minibatch index ranges, GD units ship weights). A dead slave's
 in-flight jobs are re-queued (``drop_slave``, SURVEY.md §5.3).
+
+Fault tolerance (the elastic story under IMPOLITE failure):
+
+* every hello mints a **lease** ``(slave_id, lease_id)``; every served
+  job carries a unique ``job_id`` plus the master ``epoch``. An update
+  is merged ONLY while its lease is live, its job_id is outstanding
+  and its epoch is current — anything else is **fenced** with
+  ``("stale",)`` (a zombie slave that was dropped and requeued must
+  not double-count its gradients; a duplicated update frame must not
+  be applied twice).
+* ``slave_timeout`` bounds a SILENT peer (host power loss, no
+  FIN/RST): the per-connection handler times out, the slave is
+  dropped and its in-flight minibatches requeued within the bound.
+* every drop / fenced update / stale job / requeue is counted in
+  ``MasterServer.faults`` and surfaced through :meth:`status` (and
+  from there the web-status dashboard).
 """
 
 import hashlib
 import hmac
 import os
 import pickle
+import secrets
 import socket
 import socketserver
 import struct
 import threading
+import time
 
 from veles.distributable import DistributionRegistry
 from veles.logger import Logger
@@ -116,8 +137,10 @@ def framed_server(address, handle_request, done_event, on_drop,
     GA task master (``veles/genetics.py``): a ``ThreadingTCPServer``
     whose per-connection handler pumps HMAC frames through
     ``handle_request`` until ``done_event``, captures the slave id
-    from the hello exchange, and calls ``on_drop(slave_id)`` when the
-    connection dies — the drop->requeue elasticity hook. ``timeout``
+    from the hello exchange, and calls ``on_drop(slave_id, clean=...)``
+    when the connection ends — the drop->requeue elasticity hook;
+    ``clean=True`` marks a polite ``("bye",)`` completion so it can be
+    deregistered without counting as a fault. ``timeout``
     (seconds) bounds a silent peer: a slave whose host vanishes
     without FIN/RST would otherwise block its handler thread forever
     and strand its in-flight work. The caller owns shutdown +
@@ -128,22 +151,36 @@ def framed_server(address, handle_request, done_event, on_drop,
             if timeout:
                 self.request.settimeout(timeout)
             slave_id = None
+            clean = False
             try:
-                while not done_event.is_set():
+                # NOT `while not done_event.is_set()`: that slammed
+                # the connection between recv and response, so a slave
+                # whose request was in flight when done fired saw a
+                # reset instead of the ("bye",) both handle()s return
+                # once done — and would retry/requeue a finished run.
+                # done still bounds the loop: every post-done request
+                # is answered "bye", which breaks below.
+                while True:
                     req = recv_frame(self.request)
                     if req is None:
                         break
                     resp = handle_request(req)
-                    if req[0] == "hello":
+                    if req[0] == "hello" and resp[0] == "welcome":
+                        if slave_id is not None and slave_id != resp[1]:
+                            # a duplicated hello frame minted a second
+                            # lease on this connection: revoke the one
+                            # we stop tracking or it leaks forever
+                            on_drop(slave_id)
                         slave_id = resp[1]
                     send_frame(self.request, resp)
                     if resp[0] == "bye":
+                        clean = True
                         break
             except (ConnectionError, OSError):
                 pass               # socket.timeout is an OSError too
             finally:
                 if slave_id is not None:
-                    on_drop(slave_id)
+                    on_drop(slave_id, clean=clean)
 
     class Server(socketserver.ThreadingTCPServer):
         allow_reuse_address = True
@@ -152,10 +189,18 @@ def framed_server(address, handle_request, done_event, on_drop,
     return Server(address, Handler)
 
 
+#: default bound on a silent slave (seconds). Training jobs are one
+#: minibatch, so a peer mute for a minute is dead, not busy — the GA
+#: master (veles/genetics.py), whose jobs are whole training runs,
+#: overrides this with hours.
+DEFAULT_SLAVE_TIMEOUT = 60.0
+
+
 class MasterServer(Logger):
     """Owns canonical weights + the job queue; never computes."""
 
-    def __init__(self, workflow, address, max_epochs=None):
+    def __init__(self, workflow, address, max_epochs=None,
+                 slave_timeout=DEFAULT_SLAVE_TIMEOUT):
         self.name = "MasterServer"
         self.workflow = workflow
         host, _, port = str(address).rpartition(":")
@@ -165,7 +210,18 @@ class MasterServer(Logger):
         self.lock = threading.RLock()
         self.slaves = {}
         self._next_slave = 1
+        self._next_job = 1
         self.epoch = 0
+        #: finite by default — ``None``/0 disables the bound and
+        #: restores the documented stranded-handler hazard, so only
+        #: opt into that knowingly
+        self.slave_timeout = slave_timeout
+        #: robustness event counters (status()/dashboard): how often
+        #: the cluster degraded and recovered, not just whether
+        self.faults = {"drops": 0, "requeued_jobs": 0,
+                       "fenced_updates": 0, "stale_jobs": 0,
+                       "stale_pings": 0, "unmerged_updates": 0,
+                       "joins": 0}
         if max_epochs is None:
             max_epochs = getattr(
                 getattr(workflow, "decision", None), "max_epochs", None)
@@ -184,18 +240,51 @@ class MasterServer(Logger):
 
     # -- job lifecycle -------------------------------------------------
 
+    def _live_slave(self, request):
+        """The (slave_id, info) behind ``request`` iff its lease is
+        live: the id is registered AND the lease_id matches what the
+        hello minted. A dropped-then-requeued slave, or one from a
+        previous master incarnation, fails here and must re-hello."""
+        slave_id = request[1]
+        info = self.slaves.get(slave_id)
+        if info is None:
+            return slave_id, None
+        lease = request[2] if len(request) > 2 else None
+        if lease != info["lease"]:
+            return slave_id, None
+        info["last_seen"] = time.monotonic()
+        return slave_id, info
+
     def handle(self, request):
         kind = request[0]
         with self.lock:
             if kind == "hello":
                 slave_id = self._next_slave
                 self._next_slave += 1
-                self.slaves[slave_id] = {"name": request[1], "jobs": 0}
-                self.info("slave %d (%s) joined", slave_id, request[1])
-                return ("welcome", slave_id)
+                lease = secrets.token_hex(8)
+                self.slaves[slave_id] = {
+                    "name": request[1], "jobs": 0, "lease": lease,
+                    "outstanding": set(),
+                    "last_seen": time.monotonic()}
+                self.faults["joins"] += 1
+                self.info("slave %d (%s) joined, lease %s",
+                          slave_id, request[1], lease)
+                return ("welcome", slave_id, lease)
+            if kind == "ping":
+                _, info = self._live_slave(request)
+                if info is None:
+                    self.faults["stale_pings"] += 1
+                    return ("stale",)
+                return ("pong", self.epoch)
             if kind == "job":
                 if self.done.is_set():
                     return ("bye",)
+                slave_id, info = self._live_slave(request)
+                if info is None:
+                    # never-helloed or dropped: serving it a job would
+                    # leak work onto a revoked lease — make it re-sync
+                    self.faults["stale_jobs"] += 1
+                    return ("stale",)
                 # cheap emptiness check BEFORE serializing weight
                 # payloads — idle slaves poll here every 20ms
                 if not self.workflow.loader._pending_jobs:
@@ -203,13 +292,42 @@ class MasterServer(Logger):
                     if self.done.is_set():
                         return ("bye",)
                     return ("wait",)
-                job = self.registry.generate_job(request[1])
+                job = self.registry.generate_job(slave_id)
                 if job.get(self.workflow.loader.name) is None:
                     return ("wait",)
-                self.slaves[request[1]]["jobs"] += 1
-                return ("job", job)
+                job_id = self._next_job
+                self._next_job += 1
+                info["jobs"] += 1
+                info["outstanding"].add(job_id)
+                return ("job", job, job_id, self.epoch)
             if kind == "update":
-                self.registry.apply_update(request[2], request[1])
+                slave_id, info = self._live_slave(request)
+                if len(request) < 6:       # pre-lease protocol frame
+                    self.faults["fenced_updates"] += 1
+                    return ("stale",)
+                job_id, epoch, data = request[3], request[4], request[5]
+                if info is None or job_id not in info["outstanding"] \
+                        or epoch != self.epoch:
+                    # fence: revoked lease (drop_slave already
+                    # requeued this minibatch — merging would double-
+                    # count it), duplicated frame (job_id already
+                    # consumed) or a stale epoch
+                    self.faults["fenced_updates"] += 1
+                    self.warning(
+                        "fenced update from slave %s (job %s, epoch "
+                        "%s)", slave_id, job_id, epoch)
+                    return ("stale",)
+                info["outstanding"].discard(job_id)
+                merged = self.registry.apply_update(data, slave_id)
+                if not merged and data:
+                    # the payload named no unit of this workflow — a
+                    # config-mismatched peer silently burning jobs is
+                    # a degradation the run owner must hear about
+                    self.faults["unmerged_updates"] += 1
+                    self.warning(
+                        "update from slave %s named no unit of this "
+                        "workflow (%d keys) — config mismatch?",
+                        slave_id, len(data))
                 return ("ok",)
         return ("error", "unknown request %r" % (kind,))
 
@@ -223,34 +341,57 @@ class MasterServer(Logger):
             return
         loader.master_start_epoch()
 
-    def drop_slave(self, slave_id):
+    def drop_slave(self, slave_id, clean=False):
+        """Revoke ``slave_id``'s lease and requeue its in-flight
+        minibatches — the connection-death hook (framed_server
+        ``on_drop``) and the liveness bound's teeth. ``clean`` marks a
+        polite bye after a completed run: deregistration only, not a
+        fault (the counters must measure degradation, not goodbyes)."""
         with self.lock:
-            if slave_id in self.slaves:
-                self.info("slave %d dropped; requeueing", slave_id)
-                self.registry.drop_slave(slave_id)
-                del self.slaves[slave_id]
+            if slave_id not in self.slaves:
+                return
+            requeued = self.registry.drop_slave(slave_id)
+            del self.slaves[slave_id]
+            if clean and not requeued:
+                self.info("slave %d left cleanly", slave_id)
+                return
+            self.faults["drops"] += 1
+            self.faults["requeued_jobs"] += requeued
+            self.info("slave %d dropped; %d job(s) requeued",
+                      slave_id, requeued)
 
     def status(self):
         """Cluster topology snapshot for the dashboard (SURVEY.md
-        §5.5): connected slaves with their served-job counts, plus
-        master progress."""
+        §5.5): connected slaves with their served-job counts and lease
+        liveness, master progress, plus the robustness counters."""
+        now = time.monotonic()
         with self.lock:
+            slaves = {}
+            for sid, info in self.slaves.items():
+                slaves[str(sid)] = {
+                    "name": info["name"], "jobs": info["jobs"],
+                    # prefix only: status.json is a dashboard surface,
+                    # not a place to hand out whole fencing tokens
+                    "lease": info["lease"][:6],
+                    "outstanding": len(info["outstanding"]),
+                    "idle_s": round(now - info["last_seen"], 3)}
             return {
                 "mode": "master",
                 "epoch": self.epoch,
                 "max_epochs": self.max_epochs,
                 "complete": self.done.is_set(),
+                "slave_timeout": self.slave_timeout,
                 "n_slaves": len(self.slaves),
-                "slaves": {
-                    str(sid): dict(info)
-                    for sid, info in self.slaves.items()},
+                "slaves": slaves,
+                "faults": dict(self.faults),
             }
 
     # -- socket plumbing ----------------------------------------------
 
     def serve_forever(self, poll=0.05):
         with framed_server(self.address, self.handle, self.done,
-                           self.drop_slave) as server:
+                           self.drop_slave,
+                           timeout=self.slave_timeout) as server:
             self._server = server
             self.bound_address = server.server_address
             threading.Thread(target=server.serve_forever,

@@ -75,6 +75,12 @@ class ModelRegistry(Logger):
         self.default_timeout_ms = float(default_timeout_ms)
         self._lock = threading.Lock()
         self._models = {}
+        #: per-model count of failed hot reloads (checkpoint store
+        #: down, bad archive): the registry DEGRADES — keeps serving
+        #: the loaded version — instead of dying, and these counters
+        #: plus the store's circuit-breaker state surface the
+        #: degradation through /metrics
+        self._refresh_failures = {}
 
     # -- lifecycle -----------------------------------------------------
 
@@ -126,14 +132,34 @@ class ModelRegistry(Logger):
         return entry
 
     def reload(self, name):
-        """Hot reload from the entry's recorded source+checkpoint."""
+        """Hot reload from the entry's recorded source+checkpoint.
+
+        A refresh failure (flapping snapshot endpoint — possibly
+        fast-failed by its circuit breaker — or a half-written
+        archive) must not take down a serving process that has a
+        perfectly good model in memory: the failure is counted and
+        the CURRENT entry keeps serving unchanged."""
         entry = self.get(name)
-        return self.load(name, entry.source,
-                         checkpoint=entry.checkpoint)
+        try:
+            return self.load(name, entry.source,
+                             checkpoint=entry.checkpoint)
+        except Exception as exc:
+            with self._lock:
+                self._refresh_failures[name] = \
+                    self._refresh_failures.get(name, 0) + 1
+                n = self._refresh_failures[name]
+            self.warning(
+                "hot reload of %s failed (%s: %s; failure #%d) — "
+                "still serving v%d", name, type(exc).__name__, exc,
+                n, entry.version)
+            return entry
 
     def unload(self, name):
         with self._lock:
             entry = self._models.pop(name)
+            # a future model loaded under the same name must not
+            # inherit this one's degradation history
+            self._refresh_failures.pop(name, None)
         entry.close()
 
     def close(self):
@@ -165,7 +191,22 @@ class ModelRegistry(Logger):
     def metrics(self):
         with self._lock:
             entries = list(self._models.items())
-        return {name: dict(e.batcher.metrics(),
-                           version=e.version,
-                           compiled_buckets=e.engine.compiled_buckets)
-                for name, e in entries}
+            failures = dict(self._refresh_failures)
+        out = {}
+        for name, e in entries:
+            m = dict(e.batcher.metrics(), version=e.version,
+                     compiled_buckets=e.engine.compiled_buckets,
+                     refresh_failures=failures.get(name, 0))
+            store = self._checkpoint_store(e.checkpoint)
+            if store is not None:
+                m["checkpoint_store"] = store.metrics()
+            out[name] = m
+        return out
+
+    @staticmethod
+    def _checkpoint_store(checkpoint):
+        if not checkpoint or not str(checkpoint).startswith(
+                ("http://", "https://")):
+            return None
+        from veles.snapshotter import store_for
+        return store_for(str(checkpoint))[0]

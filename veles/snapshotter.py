@@ -29,6 +29,8 @@ import io
 import json
 import lzma
 import os
+import threading
+import time
 
 import numpy
 
@@ -147,33 +149,143 @@ class FileSnapshotStore(SnapshotStore):
             pass
 
 
+class CircuitOpenError(ConnectionError):
+    """The HTTP store's circuit breaker is open: recent requests all
+    failed, so callers fail FAST instead of stacking timeouts against
+    a dead endpoint. Retry after the breaker's reset window."""
+
+
 class HTTPSnapshotStore(SnapshotStore):
     """REST-style remote backend: ``PUT/GET/DELETE <base>/<name>``,
     ``GET <base>/`` -> JSON name list. Matches any object-store-shaped
     endpoint (an S3 bucket behind a signer, the forge host, a plain
     nginx WebDAV location); the transport is stdlib urllib, so
-    zero-dependency like the rest of the service layer."""
+    zero-dependency like the rest of the service layer.
 
-    def __init__(self, base_url, timeout=60):
+    Degradation policy (a flapping snapshot server must degrade
+    checkpoint refresh, not kill it): transient transport errors and
+    5xx responses retry ``retries`` times with exponential backoff;
+    ``breaker_threshold`` consecutive request failures OPEN a circuit
+    breaker that fails every call instantly (:class:`CircuitOpenError`)
+    for ``breaker_reset`` seconds, after which ONE probe request is
+    let through (half-open) — success closes the breaker, failure
+    re-opens it. :meth:`metrics` exposes the counters."""
+
+    def __init__(self, base_url, timeout=60, retries=2,
+                 retry_backoff=0.1, breaker_threshold=4,
+                 breaker_reset=30.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = float(timeout)
+        self.retries = int(retries)
+        self.retry_backoff = float(retry_backoff)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset = float(breaker_reset)
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._breaker_open_until = 0.0
+        self._probe_in_flight = False
+        self.stats = {"requests": 0, "retries": 0, "failures": 0,
+                      "breaker_trips": 0, "breaker_fast_fails": 0}
+
+    # -- breaker bookkeeping -------------------------------------------
+
+    def _gate(self):
+        with self._lock:
+            self.stats["requests"] += 1
+            if not self._breaker_open_until:
+                return
+            now = time.monotonic()
+            # half-open admits exactly ONE probe: concurrent callers
+            # keep fast-failing or they would all stack their full
+            # retry ladders against a possibly-still-dead endpoint
+            if now < self._breaker_open_until or self._probe_in_flight:
+                self.stats["breaker_fast_fails"] += 1
+                raise CircuitOpenError(
+                    "snapshot store %s: circuit open after %d "
+                    "consecutive failures (retry in %.1fs)"
+                    % (self.base_url, self._consecutive_failures,
+                       max(0.0, self._breaker_open_until - now)))
+            self._probe_in_flight = True
+
+    def _record(self, ok):
+        with self._lock:
+            self._probe_in_flight = False
+            if ok:
+                self._consecutive_failures = 0
+                self._breaker_open_until = 0.0
+                return
+            self._consecutive_failures += 1
+            self.stats["failures"] += 1
+            if self._consecutive_failures >= self.breaker_threshold:
+                self._breaker_open_until = \
+                    time.monotonic() + self.breaker_reset
+                self.stats["breaker_trips"] += 1
+
+    def breaker_open(self):
+        with self._lock:
+            return time.monotonic() < self._breaker_open_until
+
+    def metrics(self):
+        with self._lock:
+            return dict(
+                self.stats, base_url=self.base_url,
+                consecutive_failures=self._consecutive_failures,
+                breaker_open=time.monotonic()
+                < self._breaker_open_until)
 
     def _request(self, method, name="", data=None):
+        """One logical request -> the full response BODY bytes. The
+        body read happens INSIDE the retry/breaker accounting: a
+        connection that dies mid-body (truncation — the same fault
+        class the chaos harness injects) must retry and count like
+        any other transport failure, not escape after the breaker was
+        already told the request succeeded."""
+        import http.client
+        import urllib.error
         import urllib.request
+        self._gate()
         url = self.base_url + "/" + name
-        req = urllib.request.Request(url, data=data, method=method)
-        if data is not None:
-            req.add_header("Content-Type", "application/octet-stream")
-        return urllib.request.urlopen(req, timeout=self.timeout)
+        last = None
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(url, data=data, method=method)
+            if data is not None:
+                req.add_header("Content-Type",
+                               "application/octet-stream")
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout) as resp:
+                    body = resp.read()
+                self._record(ok=True)
+                return body
+            except urllib.error.HTTPError as exc:
+                if exc.code < 500:
+                    # the endpoint is alive and answered (404 etc.):
+                    # not a store-health event, callers map the code
+                    self._record(ok=True)
+                    raise
+                last = exc              # 5xx: flapping backend
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as exc:
+                # HTTPException (e.g. BadStatusLine from a garbled
+                # response) is neither URLError nor OSError; letting
+                # it escape would skip _record() and leave a half-open
+                # probe claimed forever
+                last = exc
+            if attempt < self.retries:
+                with self._lock:
+                    self.stats["retries"] += 1
+                time.sleep(self.retry_backoff * (2 ** attempt))
+        self._record(ok=False)
+        raise last
 
     def put(self, name, data):
-        self._request("PUT", name, data).read()
+        self._request("PUT", name, data)
         return self.base_url + "/" + name
 
     def get(self, name):
         import urllib.error
         try:
-            return self._request("GET", name).read()
+            return self._request("GET", name)
         except urllib.error.HTTPError as exc:
             if exc.code == 404:
                 raise KeyError(name) from None
@@ -187,7 +299,7 @@ class HTTPSnapshotStore(SnapshotStore):
         like :meth:`FileSnapshotStore.list` (tests/test_service.py
         covers the round-trip against the reference blob server)."""
         from urllib.parse import urlsplit
-        names = json.loads(self._request("GET").read().decode())
+        names = json.loads(self._request("GET").decode())
         prefix = urlsplit(self.base_url).path.lstrip("/")
         out = []
         for n in names:
@@ -221,19 +333,32 @@ class HTTPSnapshotStore(SnapshotStore):
     def delete(self, name):
         import urllib.error
         try:
-            self._request("DELETE", name).read()
+            self._request("DELETE", name)
         except urllib.error.HTTPError as exc:
             if exc.code != 404:
                 raise
 
 
+#: one HTTPSnapshotStore per base URL, so repeated resolutions of the
+#: same endpoint (a serving process refreshing its checkpoint every
+#: few minutes) share ONE circuit breaker — without this every refresh
+#: would mint a fresh store whose breaker has no memory of the
+#: endpoint flapping
+_STORE_CACHE = {}
+_STORE_CACHE_LOCK = threading.Lock()
+
+
 def store_for(target):
     """A store + name resolver for a snapshot TARGET: an http(s) URI
-    maps to (HTTPSnapshotStore(base), name); anything else is a local
-    path handled by the file machinery."""
+    maps to (a cached HTTPSnapshotStore(base), name); anything else is
+    a local path handled by the file machinery."""
     if target.startswith(("http://", "https://")):
         base, _, name = target.rpartition("/")
-        return HTTPSnapshotStore(base), name
+        with _STORE_CACHE_LOCK:
+            store = _STORE_CACHE.get(base)
+            if store is None:
+                store = _STORE_CACHE[base] = HTTPSnapshotStore(base)
+        return store, name
     return None, target
 
 

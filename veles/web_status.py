@@ -123,8 +123,11 @@ class WebStatus(Logger):
         snap = self.snapshot()
         if not snap:
             return _PAGE % "<p>no runs registered</p>"
+        # n_slaves/faults render the master's cluster row: topology
+        # plus the robustness counters (drops, fenced updates,
+        # requeues) — empty cells for plain workflow rows
         keys = ["mode", "workflow", "epoch", "best_metric",
-                "last_metrics", "complete"]
+                "last_metrics", "complete", "n_slaves", "faults"]
         rows = [_row(["run"] + keys, "th")]
         for name, st in sorted(snap.items()):
             rows.append(_row(
