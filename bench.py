@@ -28,6 +28,11 @@ explicit ``*_best`` suffix. Round 3 alone put best under the primary
 keys — compare r3 primary keys against r4's ``*_best``, not r4's
 primaries. Every timed chunk carries its full share of dispatch +
 metric-fetch cost; nothing is served from pre-computed results.
+
+Work counts come from the telemetry registry (ISSUE 3): every row's
+numerator is a delta of the SAME ``veles_loader_*_total`` counters the
+runtime increments per served minibatch (``_train_counter``), so bench
+figures and a /metrics scrape of the same run can never disagree.
 """
 
 import json
@@ -180,10 +185,27 @@ def _build_mnist(backend, name, mb=100, n_train=6000, n_valid=1000,
     return wf
 
 
+def _train_counter(loader, kind="minibatches", scale=1.0):
+    """A cumulative work-count reader over the telemetry registry
+    (ISSUE 3): bench rows and runtime metrics read the SAME
+    ``veles_loader_*_total{cls="train"}`` counters the loader
+    increments per served minibatch, so the two can never disagree.
+    ``kind``: 'minibatches' (steps) or 'samples' (images; × seq =
+    tokens via ``scale``)."""
+    from veles import telemetry
+    name = "veles_loader_%s_total" % kind
+
+    def read():
+        return telemetry.get_registry().counter_total(
+            name, loader=loader.name, cls="train") * scale
+    return read
+
+
 def numpy_steps_per_sec(n_steps=30):
     from veles.loader.base import CLASS_TRAIN
     wf = _build_mnist("numpy", "BenchNumpy")
     loader = wf.loader
+    steps_done = _train_counter(loader)
 
     def one_step():
         loader.run()
@@ -196,30 +218,31 @@ def numpy_steps_per_sec(n_steps=30):
             gd.run()
 
     one_step()  # warm caches
+    c0 = steps_done()
     t0 = time.perf_counter()
     for _ in range(n_steps):
         one_step()
-    return n_steps / (time.perf_counter() - t0)
+    return (steps_done() - c0) / (time.perf_counter() - t0)
 
 
-def _run_one_chunk(loader, step, count):
+def _run_one_chunk(loader, step):
     """Serve exactly one dispatch chunk (the serve that crosses into an
-    undispatched epoch triggers the next chunk); sum ``count()`` over
-    the serves. The ONE place that reads XLAStep's chunk bookkeeping."""
-    total = 0
+    undispatched epoch triggers the next chunk). The ONE place that
+    reads XLAStep's chunk bookkeeping."""
     while True:
         loader.run()
         step.run()
-        total += count(loader)
         if bool(loader.epoch_ended) and \
                 loader.epoch_number + 1 >= \
                 step._chunk_epoch0 + step._chunk_len:
-            return total
+            return
 
 
-def _timed_chunks(loader, step, count, measure_chunks):
+def _timed_chunks(loader, step, counter, measure_chunks):
     """(best_rate, median_rate) over ``measure_chunks`` individually
     timed chunks, after one warmup chunk that covers compilation.
+    ``counter()`` is a cumulative registry reader (_train_counter);
+    each chunk's rate is its counter delta over its wall time.
     Per-chunk timing (not a sum): the remote tunnel adds multi-second
     jitter to individual dispatches, and the chunk's metric fetch
     blocks on device completion, so the fastest chunk is the stable
@@ -227,12 +250,14 @@ def _timed_chunks(loader, step, count, measure_chunks):
     (same convention as bench_alexnet; the fetch inside
     _run_one_chunk is the synchronization point — block_until_ready
     alone does not block through the tunnel, BASELINE.md round 3)."""
-    _run_one_chunk(loader, step, count)
+    _run_one_chunk(loader, step)
     rates = []
     for _ in range(measure_chunks):
+        c0 = counter()
         t0 = time.perf_counter()
-        n = _run_one_chunk(loader, step, count)
-        rates.append(n / (time.perf_counter() - t0))
+        _run_one_chunk(loader, step)
+        rates.append((counter() - c0)
+                     / (time.perf_counter() - t0))
     rates.sort()
     return rates[-1], rates[len(rates) // 2]
 
@@ -243,14 +268,11 @@ def xla_mnist_bench(measure_chunks=2):
     The chunk size is pinned to the adaptive mode's steady state for
     this workload (auto mode ramps 1 → 64 over a few dispatches; the
     pin just skips timing the ramp)."""
-    from veles.loader.base import CLASS_TRAIN
     wf = _build_mnist("xla", "BenchXLA", max_epochs=1024)
     loader, step = wf.loader, wf.xla_step
     step.epochs_per_dispatch = 64
     best, median = _timed_chunks(
-        loader, step,
-        lambda ld: int(ld.minibatch_class == CLASS_TRAIN),
-        measure_chunks)
+        loader, step, _train_counter(loader), measure_chunks)
     return best, median, _grad_sync_bytes(step)
 
 
@@ -265,11 +287,13 @@ def _grad_sync_bytes(step):
     return parallel.grad_sync_bytes(host)
 
 
-def _xla_throughput(create_workflow, cfg, count, epochs_per_dispatch,
-                    name, measure_chunks=1):
+def _xla_throughput(create_workflow, cfg, counter_kind, scale,
+                    epochs_per_dispatch, name, measure_chunks=1):
     """Shared build-and-time scaffold: seed, size the dataset via the
     sample's config section, init on the XLA device, time whole
-    dispatch chunks; -> (best, median) count units per second."""
+    dispatch chunks; rates come from the telemetry registry's
+    ``veles_loader_*`` counters (see ``_train_counter``);
+    -> (best, median) count units per second."""
     import veles.prng as prng
     prng.seed_all(99)
     cfg.decision.max_epochs = 1024
@@ -282,14 +306,14 @@ def _xla_throughput(create_workflow, cfg, count, epochs_per_dispatch,
     wf.initialize(device="xla")
     loader, step = wf.loader, wf.xla_step
     step.epochs_per_dispatch = epochs_per_dispatch
-    best, median = _timed_chunks(loader, step, count,
-                                 measure_chunks)
+    best, median = _timed_chunks(
+        loader, step, _train_counter(loader, counter_kind, scale),
+        measure_chunks)
     return best, median
 
 
 def xla_cifar_images_per_sec(measure_chunks=3):
     """Conv-stack throughput (images/sec) on the XLA device."""
-    from veles.loader.base import CLASS_TRAIN
     from veles.config import root
     from veles.znicz_tpu.models import cifar10
     root.cifar.loader.update({"minibatch_size": 100, "n_train": 2000,
@@ -298,9 +322,7 @@ def xla_cifar_images_per_sec(measure_chunks=3):
     # per-chunk metric fetch on this small model (r4 sweep: 167k at
     # 16, 256k at 64, flat at 128+)
     return _xla_throughput(
-        cifar10.create_workflow, root.cifar,
-        lambda ld: int(ld.minibatch_size)
-        if ld.minibatch_class == CLASS_TRAIN else 0,
+        cifar10.create_workflow, root.cifar, "samples", 1,
         epochs_per_dispatch=64, name="BenchCifar",
         measure_chunks=measure_chunks)
 
@@ -316,7 +338,6 @@ def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
     loss/softmax/stat math — bf16 WINS on the 57M LM too (205k vs
     195k tok/s on a v5e; round 2's per-matmul-cast design lost ~4%
     here, which is why it used to pin float32)."""
-    from veles.loader.base import CLASS_TRAIN
     from veles.config import root
     from veles.znicz_tpu.models import transformer_lm
     saved_loader = root.lm.loader.to_dict()
@@ -325,10 +346,10 @@ def _lm_throughput(loader_cfg, model_cfg, name, epochs_per_dispatch,
     root.lm.model.update(model_cfg)
     seq = root.lm.loader.seq_len
     try:
+        # tokens/sec = train samples/sec × seq (samples counter from
+        # the registry)
         return _xla_throughput(
-            transformer_lm.create_workflow, root.lm,
-            lambda ld: int(ld.minibatch_size) * seq
-            if ld.minibatch_class == CLASS_TRAIN else 0,
+            transformer_lm.create_workflow, root.lm, "samples", seq,
             epochs_per_dispatch=epochs_per_dispatch, name=name,
             measure_chunks=measure_chunks)
     finally:

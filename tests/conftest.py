@@ -48,3 +48,18 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return numpy.random.Generator(numpy.random.PCG64(1234))
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Every test runs under a FRESH scoped telemetry registry
+    (veles/telemetry.py): instruments created by one test can never
+    leak counts into another or into tier-1 flakiness. LazyChild
+    handles on long-lived units re-resolve automatically when the
+    registry generation changes. The span tracer is reset too, in
+    case a test enabled it and failed before stopping."""
+    from veles import telemetry
+    with telemetry.scoped():
+        yield
+    telemetry.tracer.stop()
+    telemetry.tracer.clear()

@@ -4,7 +4,7 @@ absolute-URL snapshot listing, footprint-derived pallas VMEM grant).
 
 The acceptance path (ISSUE 1): ``velescli.py serve`` answering a
 concurrent-client predict load against an exported MNIST model with
-dynamic batching — batch-fill ratio > 1 observed via ``/metrics``,
+dynamic batching — batch-fill ratio > 1 observed via ``/metrics.json``,
 deadlines enforced, shedding instead of unbounded queueing — on the
 numpy/CPU backend.
 """
@@ -379,7 +379,7 @@ def _get(url, timeout=15):
 
 def test_http_predict_round_trip(mnist_artifact):
     """End-to-end on the numpy backend: concurrent clients coalesce
-    (fill ratio > 1 in /metrics), predictions match the oracle."""
+    (fill ratio > 1 in /metrics.json), predictions match the oracle."""
     from veles.serving import ModelRegistry
     from veles.serving.frontend import ServingFrontend
     reg = ModelRegistry(backend="numpy", max_wait_ms=15.0)
@@ -412,7 +412,7 @@ def test_http_predict_round_trip(mnist_artifact):
             numpy.testing.assert_allclose(
                 numpy.asarray(doc["outputs"][0]),
                 expected[i % len(x)], atol=1e-5)
-        m = _get(base + "/metrics")["models"]["mnist"]
+        m = _get(base + "/metrics.json")["models"]["mnist"]
         assert m["requests_total"] >= 24
         assert m["batch_fill_ratio"] > 1.0
         assert m["shed_total"] == 0
@@ -489,7 +489,7 @@ def test_web_status_surfaces_serving_metrics(mnist_artifact):
 
 def test_velescli_serve_subcommand(mnist_artifact):
     """The acceptance path: ``velescli.py serve`` under concurrent
-    HTTP load — dynamic batching visible in /metrics."""
+    HTTP load — dynamic batching visible in /metrics.json."""
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "velescli.py"), "serve",
          "--model", "mnist=%s" % mnist_artifact["archive"],
@@ -520,7 +520,7 @@ def test_velescli_serve_subcommand(mnist_artifact):
             numpy.testing.assert_allclose(
                 numpy.asarray(doc["outputs"][0]),
                 expected[i % len(x)], atol=1e-5)
-        m = _get(base + "/metrics")["models"]["mnist"]
+        m = _get(base + "/metrics.json")["models"]["mnist"]
         assert m["requests_total"] >= 16
         assert m["batch_fill_ratio"] > 1.0
         assert m["expired_total"] == 0

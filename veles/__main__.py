@@ -89,6 +89,12 @@ def build_argparser():
                    help="write a jax.profiler trace of the run here "
                         "(kernel-level timeline; view in TensorBoard "
                         "or Perfetto)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write a Chrome-trace/Perfetto JSON of the "
+                        "run's HOST-side spans here (unit runs, step "
+                        "builds, fused dispatches — the veles span "
+                        "tracer; load in chrome://tracing or "
+                        "ui.perfetto.dev)")
     p.add_argument("--background", action="store_true",
                    help="daemonize before running: fork, detach from "
                         "the terminal (setsid), redirect stdio to "
@@ -178,6 +184,26 @@ class Main:
                 f.write(self.workflow.generate_graph())
             print("workflow graph -> %s" % args.workflow_graph)
             return self.workflow
+        if not args.trace_out:
+            return self._launch(**kwargs)
+        # start BEFORE initialize so step-build spans are captured;
+        # dump in a finally — a crashed run's spans are exactly the
+        # postmortem the trace is for
+        from veles import telemetry
+        telemetry.tracer.start()
+        try:
+            return self._launch(**kwargs)
+        finally:
+            telemetry.tracer.stop()
+            try:
+                telemetry.tracer.dump(args.trace_out)
+                print("trace -> %s" % args.trace_out)
+            except OSError as exc:
+                # never let a failed dump mask the run's own outcome
+                print("trace dump failed: %s" % exc, file=sys.stderr)
+
+    def _launch(self, **kwargs):
+        args = self.args
         self.launcher = Launcher(
             device=args.device, snapshot=args.snapshot,
             stats=not args.no_stats,

@@ -22,14 +22,40 @@ a port (SURVEY.md §3.2: the reference's per-unit launch overhead is
 eliminated by construction).
 """
 
+import time
 from collections import OrderedDict
 
 import numpy
 
+from veles import telemetry
 from veles.backends import Device, NumpyDevice, XLADevice, get_device
 from veles.memory import Array
 from veles.units import Unit
 from veles.workflow import Workflow
+
+
+def _compile_cache_event(kind, hit, build_seconds=None, start=None):
+    """Registry bookkeeping for the step-program cache: hits vs
+    (re)builds and the time spent tracing/jitting each program kind
+    ('step' / 'epoch' / 'window')."""
+    if hit:
+        telemetry.counter(
+            "veles_xla_cache_hits_total",
+            "Compiled-program cache hits", ("kind",)).labels(kind).inc()
+        return
+    telemetry.counter(
+        "veles_xla_cache_misses_total",
+        "Compiled-program cache misses (trace + jit builds)",
+        ("kind",)).labels(kind).inc()
+    telemetry.histogram(
+        "veles_xla_build_seconds",
+        "Time spent building a step program (trace + jit wrap; XLA "
+        "compiles lazily on first dispatch — see "
+        "veles_xla_dispatch_seconds{warm=\"0\"})",
+        ("kind",)).labels(kind).observe(build_seconds)
+    if start is not None:
+        telemetry.tracer.add_complete(
+            "xla.build.%s" % kind, start, build_seconds, kind=kind)
 
 
 class AcceleratedUnit(Unit):
@@ -341,7 +367,12 @@ class StepCompiler:
                             for name, (unit, attr) in batch_spec.items())),
                train)
         if key not in self._compiled:
+            t0 = time.perf_counter()
             self._compiled[key] = self.build_step(batch_spec, train=train)
+            _compile_cache_event("step", False,
+                                 time.perf_counter() - t0, t0)
+        else:
+            _compile_cache_event("step", True)
         return self._compiled[key]
 
     # class-scan compilation (SURVEY.md §7 design stance, taken one
@@ -435,8 +466,13 @@ class StepCompiler:
                      for k, t, us in segments),
                _transform_key(transform))
         if key not in self._compiled:
+            t0 = time.perf_counter()
             self._compiled[key] = self.build_epoch_scan(
                 batch_spec, segments, transform)
+            _compile_cache_event("epoch", False,
+                                 time.perf_counter() - t0, t0)
+        else:
+            _compile_cache_event("epoch", True)
         return self._compiled[key]
 
     # window-scan compilation (the STREAMING fast path: the dataset
@@ -493,8 +529,13 @@ class StepCompiler:
                train, tuple(u.name for u in units),
                _transform_key(transform))
         if key not in self._compiled:
+            t0 = time.perf_counter()
             self._compiled[key] = self.build_window_scan(
                 batch_spec, train, units, transform)
+            _compile_cache_event("window", False,
+                                 time.perf_counter() - t0, t0)
+        else:
+            _compile_cache_event("window", True)
         return self._compiled[key]
 
 

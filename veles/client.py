@@ -27,10 +27,28 @@ import socket
 import threading
 import time
 
+from veles import telemetry
 from veles.distributable import DistributionRegistry
 from veles.loader.base import CLASS_TRAIN
 from veles.logger import Logger
 from veles.server import send_frame, recv_frame, require_secret_for
+
+#: counter families a slave must NOT push to its master: the master
+#: owns these names in its own registry (and in co-located test runs
+#: both sides share one registry — echoing them back would manufacture
+#: fake slave-labelled cluster series)
+_NO_PUSH_PREFIXES = ("veles_cluster_", "veles_master_")
+
+#: PER-PROCESS push token: the counter state a client pushes is the
+#: process-wide registry, so the master's dedup baseline must be
+#: per-process too — two SlaveClients threading in one process (chaos
+#: tests) each push the shared totals, and a per-CLIENT token would
+#: absorb them twice. Stable across reconnects/re-hellos by
+#: construction. (Per-slave attribution is inherently approximate for
+#: co-located clients — they share one registry — but sums stay
+#: exact; separate-process slaves keep exact attribution.)
+import secrets
+_PUSH_TOKEN = secrets.token_hex(8)
 
 
 class StaleLease(ConnectionError):
@@ -80,6 +98,28 @@ class SlaveClient(Logger):
         self.reconnects = 0
         self.stale_resyncs = 0
         self.pings_sent = 0
+        # telemetry: local mirrors of the attribute counters, plus the
+        # last counter state acknowledged by the master (deltas against
+        # it ride each update frame — see _telemetry_delta)
+        self._tele = {
+            key: telemetry.LazyChild(
+                lambda name=name, help=help: telemetry.counter(
+                    name, help))
+            for key, name, help in (
+                ("jobs", "veles_slave_jobs_done_total",
+                 "Jobs completed and acknowledged by the master"),
+                ("reconnects", "veles_slave_reconnects_total",
+                 "Reconnect/re-hello cycles"),
+                ("stale", "veles_slave_stale_resyncs_total",
+                 "Lease revocations noticed (fenced responses)"),
+            )}
+        #: stable token identifying this PROCESS's counter stream
+        #: across re-hellos: the master diffs pushed absolute state
+        #: per token, so a lost ok-ack (state absorbed, ack dropped,
+        #: slave re-pushes under a fresh slave_id) or co-located
+        #: clients pushing the same shared registry can never double-
+        #: count — see MasterServer._absorb_telemetry
+        self._push_token = _PUSH_TOKEN
 
     def connect(self):
         self.sock = socket.create_connection(self.address,
@@ -162,6 +202,7 @@ class SlaveClient(Logger):
             raise ConnectionError("master closed the connection")
         if resp == ("stale",):
             self.stale_resyncs += 1
+            self._tele["stale"].get().inc()
             raise StaleLease(
                 "master fenced %r for slave %s — lease %s revoked"
                 % (request[0], self.slave_id, self.lease_id))
@@ -182,13 +223,39 @@ class SlaveClient(Logger):
         _, payload, job_id, epoch = resp[:4]
         self.registry.apply_job(payload)
         self._run_iteration()
+        # count the job BEFORE building the pushed state: the state
+        # rides the update that completes this very job, so the master
+        # sees N jobs after N accepted updates (post-ack counting
+        # would lag by one forever — the final job's increment has no
+        # later update to ride). If THIS update is fenced/lost the
+        # master doesn't absorb, and the next accepted push carries
+        # the cumulative value — at-least-once on the fault path,
+        # exact on the fault-free one.
+        self._tele["jobs"].get().inc()
+        update = self.registry.generate_update()
+        tele = self._telemetry_state()
+        if tele:
+            update["__telemetry__"] = tele
         ok = self._roundtrip(
             ("update", self.slave_id, self.lease_id, job_id, epoch,
-             self.registry.generate_update()))
+             update))
         if ok[0] != "ok":
             raise ProtocolDesync("expected ok, got %r" % (ok[:1],))
         self.jobs_done += 1
         return True
+
+    def _telemetry_state(self):
+        """The ABSOLUTE counter state pushed on each update — what
+        makes one scrape of the master show the whole cluster. Absolute
+        values + the stable token make the push idempotent: the master
+        increments by the per-token diff, so retransmits after a lost
+        ack (or a re-hello) are no-ops rather than double counts."""
+        state = telemetry.get_registry().counter_state(
+            exclude_prefixes=_NO_PUSH_PREFIXES,
+            exclude_label_keys=("slave",))
+        if not state:
+            return None
+        return {"token": self._push_token, "state": state}
 
     def _run_iteration(self):
         """One forward/backward/update pass over the minibatch the
@@ -268,4 +335,5 @@ class SlaveClient(Logger):
         self._close_sock()
         self.slave_id = self.lease_id = None
         self.reconnects += 1
+        self._tele["reconnects"].get().inc()
         time.sleep(self._backoff(attempt))

@@ -11,7 +11,7 @@ master→slave payload is minibatch index ranges (SURVEY.md §3.3).
 
 import numpy
 
-from veles import prng
+from veles import prng, telemetry
 from veles.distributable import IDistributable
 from veles.memory import Array
 from veles.mutable import Bool
@@ -85,6 +85,19 @@ class Loader(Unit, IDistributable):
         # distributed: master-side queue of pending (cls, lo, hi) jobs
         self._pending_jobs = []
         self._inflight = {}
+
+        # telemetry: epoch counter/gauge plus per-class minibatch and
+        # sample counters (samples-per-second = rate() over the scrape;
+        # bench.py reads its throughput rows from these same counters)
+        self._tele_epochs = telemetry.LazyChild(
+            lambda: telemetry.counter(
+                "veles_loader_epochs_total", "Epochs served",
+                ("loader",)).labels(self.name))
+        self._tele_epoch_gauge = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_loader_epoch", "Current epoch number",
+                ("loader",)).labels(self.name))
+        self._tele_serve = {}     # cls -> (minibatches, samples)
 
     # -- to be implemented by subclasses ------------------------------
 
@@ -163,6 +176,8 @@ class Loader(Unit, IDistributable):
             self._future_orders = []
         else:
             self.epoch_number += 1
+            self._tele_epochs.get().inc()
+        self._tele_epoch_gauge.get().set(self.epoch_number)
         future = getattr(self, "_future_orders", None)
         if not first and future:
             # consume the order peek_epoch_orders pre-generated (the
@@ -203,6 +218,22 @@ class Loader(Unit, IDistributable):
         self.minibatch_class = cls
         self.train_phase << (cls == CLASS_TRAIN)
         self.minibatch_size = len(chunk)
+        tele = self._tele_serve.get(cls)
+        if tele is None:
+            cname = TRIAGE[cls]
+            tele = self._tele_serve[cls] = (
+                telemetry.LazyChild(
+                    lambda n=cname: telemetry.counter(
+                        "veles_loader_minibatches_total",
+                        "Minibatches served", ("loader", "cls"))
+                    .labels(self.name, n)),
+                telemetry.LazyChild(
+                    lambda n=cname: telemetry.counter(
+                        "veles_loader_samples_total",
+                        "Samples served", ("loader", "cls"))
+                    .labels(self.name, n)))
+        tele[0].get().inc()
+        tele[1].get().inc(len(chunk))
         self.minibatch_indices.map_invalidate()
         self.minibatch_indices.mem[...] = self.pad_indices(
             chunk, self.max_minibatch_size)

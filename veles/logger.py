@@ -10,7 +10,7 @@ import json
 import logging
 import os
 import sys
-import time
+import traceback
 
 _COLORS = {
     logging.DEBUG: "\033[37m",
@@ -48,12 +48,24 @@ class _JsonlHandler(logging.Handler):
 
     def emit(self, record):
         try:
-            self._fp.write(json.dumps({
-                "t": time.time(),
+            doc = {
+                # the record's own timestamp, not a second
+                # time.time() call (keeps JSONL rows ordered exactly
+                # like the console lines they mirror)
+                "t": record.created,
                 "level": record.levelname,
                 "name": record.name,
                 "msg": record.getMessage(),
-            }) + "\n")
+            }
+            if record.exc_info:
+                # serialize the formatted traceback: structured logs
+                # must be usable for postmortems, and exc_info itself
+                # is not JSON-serializable
+                doc["exc"] = "".join(traceback.format_exception(
+                    *record.exc_info)).rstrip("\n")
+            elif record.exc_text:
+                doc["exc"] = record.exc_text
+            self._fp.write(json.dumps(doc) + "\n")
         except Exception:  # pragma: no cover - never break on logging
             self.handleError(record)
 

@@ -50,6 +50,7 @@ import struct
 import threading
 import time
 
+from veles import telemetry
 from veles.distributable import DistributionRegistry
 from veles.logger import Logger
 
@@ -217,11 +218,22 @@ class MasterServer(Logger):
         #: opt into that knowingly
         self.slave_timeout = slave_timeout
         #: robustness event counters (status()/dashboard): how often
-        #: the cluster degraded and recovered, not just whether
+        #: the cluster degraded and recovered, not just whether. The
+        #: dict is the JSON view; every increment goes through
+        #: _count_fault so the telemetry registry carries the same
+        #: counters for the Prometheus scrape.
         self.faults = {"drops": 0, "requeued_jobs": 0,
                        "fenced_updates": 0, "stale_jobs": 0,
                        "stale_pings": 0, "unmerged_updates": 0,
                        "joins": 0}
+        #: per-client-token (state, last_seen) of absorbed counter
+        #: pushes (see _absorb_telemetry). One entry per SlaveClient
+        #: instance; idle tokens are evicted after _TELE_TOKEN_TTL so
+        #: days of slave churn cannot grow this unboundedly — the TTL
+        #: comfortably outlives any reconnect/re-hello window, which
+        #: is when the dedup baseline matters.
+        self._tele_states = {}
+        self._req_counters = {}
         if max_epochs is None:
             max_epochs = getattr(
                 getattr(workflow, "decision", None), "max_epochs", None)
@@ -237,6 +249,55 @@ class MasterServer(Logger):
         self._server = None
         loader = workflow.loader
         loader.master_start_epoch()
+
+    # -- telemetry -----------------------------------------------------
+
+    def _count_fault(self, kind, n=1):
+        self.faults[kind] += n
+        telemetry.counter(
+            "veles_cluster_faults_total",
+            "Cluster degradation/recovery events by kind",
+            ("kind",)).labels(kind).inc(n)
+
+    def _set_slaves_gauge(self):
+        telemetry.gauge(
+            "veles_cluster_slaves",
+            "Slaves currently holding a live lease").set(
+            len(self.slaves))
+
+    #: seconds an absorbed client token may stay idle before its
+    #: dedup baseline is dropped (far beyond any reconnect window)
+    _TELE_TOKEN_TTL = 6 * 3600.0
+
+    def _absorb_telemetry(self, tele, slave_id):
+        """Merge a slave's pushed counter state into the registry.
+
+        The payload carries ABSOLUTE values plus a stable per-client
+        token; this side increments by the per-token diff since the
+        last absorbed state. Idempotent by construction: a retransmit
+        after a lost ok-ack, a duplicated frame, or the same client
+        re-helloing under a new slave_id can never double-count
+        (called under self.lock)."""
+        token = tele.get("token")
+        state = tele.get("state")
+        if token is None or not isinstance(state, dict):
+            return
+        now = time.monotonic()
+        last, _ = self._tele_states.get(token, ({}, now))
+        self._tele_states[token] = (last, now)
+        deltas = {}
+        for key, value in state.items():
+            dv = value - last.get(key, 0.0)
+            if dv > 0:
+                deltas[key] = dv
+                last[key] = value
+        if deltas:
+            telemetry.get_registry().absorb_counters(
+                deltas, extra_labels=(("slave", str(slave_id)),))
+        if len(self._tele_states) > 64:
+            for tok, (_, seen) in list(self._tele_states.items()):
+                if now - seen > self._TELE_TOKEN_TTL:
+                    del self._tele_states[tok]
 
     # -- job lifecycle -------------------------------------------------
 
@@ -257,6 +318,19 @@ class MasterServer(Logger):
 
     def handle(self, request):
         kind = request[0]
+        kind_key = str(kind)
+        req_counter = self._req_counters.get(kind_key)
+        if req_counter is None:
+            # per-kind LazyChild cache: idle slaves poll here every
+            # 20ms, so the steady state must not pay family+child
+            # resolution per frame
+            req_counter = self._req_counters[kind_key] = \
+                telemetry.LazyChild(
+                    lambda k=kind_key: telemetry.counter(
+                        "veles_master_requests_total",
+                        "Frames handled by the master, by request "
+                        "kind", ("kind",)).labels(k))
+        req_counter.get().inc()
         with self.lock:
             if kind == "hello":
                 slave_id = self._next_slave
@@ -266,14 +340,15 @@ class MasterServer(Logger):
                     "name": request[1], "jobs": 0, "lease": lease,
                     "outstanding": set(),
                     "last_seen": time.monotonic()}
-                self.faults["joins"] += 1
+                self._count_fault("joins")
+                self._set_slaves_gauge()
                 self.info("slave %d (%s) joined, lease %s",
                           slave_id, request[1], lease)
                 return ("welcome", slave_id, lease)
             if kind == "ping":
                 _, info = self._live_slave(request)
                 if info is None:
-                    self.faults["stale_pings"] += 1
+                    self._count_fault("stale_pings")
                     return ("stale",)
                 return ("pong", self.epoch)
             if kind == "job":
@@ -283,7 +358,7 @@ class MasterServer(Logger):
                 if info is None:
                     # never-helloed or dropped: serving it a job would
                     # leak work onto a revoked lease — make it re-sync
-                    self.faults["stale_jobs"] += 1
+                    self._count_fault("stale_jobs")
                     return ("stale",)
                 # cheap emptiness check BEFORE serializing weight
                 # payloads — idle slaves poll here every 20ms
@@ -303,7 +378,7 @@ class MasterServer(Logger):
             if kind == "update":
                 slave_id, info = self._live_slave(request)
                 if len(request) < 6:       # pre-lease protocol frame
-                    self.faults["fenced_updates"] += 1
+                    self._count_fault("fenced_updates")
                     return ("stale",)
                 job_id, epoch, data = request[3], request[4], request[5]
                 if info is None or job_id not in info["outstanding"] \
@@ -312,18 +387,27 @@ class MasterServer(Logger):
                     # requeued this minibatch — merging would double-
                     # count it), duplicated frame (job_id already
                     # consumed) or a stale epoch
-                    self.faults["fenced_updates"] += 1
+                    self._count_fault("fenced_updates")
                     self.warning(
                         "fenced update from slave %s (job %s, epoch "
                         "%s)", slave_id, job_id, epoch)
                     return ("stale",)
                 info["outstanding"].discard(job_id)
+                # slave-pushed telemetry counter state rides the update
+                # frame under a reserved key: pop BEFORE the unit merge
+                # (it is not a unit payload). One scrape of the master
+                # then shows the whole cluster, each slave's series
+                # tagged slave="<id>".
+                tele = data.pop("__telemetry__", None) \
+                    if isinstance(data, dict) else None
+                if tele:
+                    self._absorb_telemetry(tele, slave_id)
                 merged = self.registry.apply_update(data, slave_id)
                 if not merged and data:
                     # the payload named no unit of this workflow — a
                     # config-mismatched peer silently burning jobs is
                     # a degradation the run owner must hear about
-                    self.faults["unmerged_updates"] += 1
+                    self._count_fault("unmerged_updates")
                     self.warning(
                         "update from slave %s named no unit of this "
                         "workflow (%d keys) — config mismatch?",
@@ -352,11 +436,13 @@ class MasterServer(Logger):
                 return
             requeued = self.registry.drop_slave(slave_id)
             del self.slaves[slave_id]
+            self._set_slaves_gauge()
             if clean and not requeued:
                 self.info("slave %d left cleanly", slave_id)
                 return
-            self.faults["drops"] += 1
-            self.faults["requeued_jobs"] += requeued
+            self._count_fault("drops")
+            if requeued:
+                self._count_fault("requeued_jobs", requeued)
             self.info("slave %d dropped; %d job(s) requeued",
                       slave_id, requeued)
 

@@ -18,13 +18,20 @@ Overload policy, in order:
   (HTTP 504) WITHOUT wasting forward compute on them.
 
 Metrics (queue depth, batch fill, latency percentiles, rps) are
-collected here — the one place every request passes through.
+collected here — the one place every request passes through. Since the
+unified telemetry core (ISSUE 3) they live in the process-wide
+registry (``veles.telemetry``) as ``veles_serving_*`` counters /
+histograms labelled by model, and :meth:`MicroBatcher.metrics` is a
+JSON *view* over those instruments with the exact pre-registry key
+shape (served on ``/metrics.json``; the Prometheus scrape is
+``/metrics``).
 """
 
 import collections
 import threading
 import time
 
+from veles import telemetry
 from veles.logger import Logger
 
 
@@ -53,10 +60,31 @@ class MicroBatcher(Logger):
     """Coalesces concurrent :meth:`submit` calls into batched
     ``run_batch(rows) -> (outputs, bucket)`` dispatches."""
 
+    #: (metrics-view key, registry counter suffix, help) — the one
+    #: table both the instrument creation and the JSON view read, so
+    #: the /metrics.json key shape can never drift from the registry
+    COUNTERS = (
+        ("requests_total", "requests", "Requests submitted"),
+        ("shed_total", "shed", "Requests shed on a full queue (503)"),
+        ("expired_total", "expired",
+         "Requests expired before dispatch (504)"),
+        ("error_total", "errors", "Requests failed by batch errors"),
+        ("batches_total", "batches", "Batches dispatched"),
+        ("batched_requests_total", "batched_requests",
+         "Requests served inside batches"),
+        ("batched_rows_total", "batched_rows",
+         "Rows dispatched (pre-padding)"),
+        ("bucket_rows_total", "bucket_rows",
+         "Rows incl. bucket padding"),
+    )
+
     def __init__(self, run_batch, max_batch=64, max_queue=256,
                  max_wait_ms=2.0, default_timeout_ms=1000.0,
-                 name="batcher"):
+                 name="batcher", model=None):
         self.name = name
+        #: label value for this batcher's registry series (the model
+        #: name when owned by a ModelRegistry entry)
+        self.model = model or name
         self._run_batch = run_batch
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
@@ -67,16 +95,24 @@ class MicroBatcher(Logger):
         self._queue = collections.deque()
         self._queued_rows = 0
         self._running = True
-        # -- counters (under _lock) --
-        self.requests_total = 0
-        self.shed_total = 0
-        self.expired_total = 0
-        self.error_total = 0
-        self.batches_total = 0
-        self.batched_requests_total = 0   # requests served IN batches
-        self.batched_rows_total = 0
-        self.bucket_rows_total = 0        # rows incl. bucket padding
-        self._latencies = collections.deque(maxlen=2048)
+        # -- instruments: registry-backed (ISSUE 3), metrics() is the
+        # JSON view over them --
+        self._c = {
+            key: telemetry.LazyChild(
+                lambda s=suffix, h=help: telemetry.counter(
+                    "veles_serving_%s_total" % s, h,
+                    ("model",)).labels(self.model))
+            for key, suffix, help in self.COUNTERS}
+        self._h_latency = telemetry.LazyChild(
+            lambda: telemetry.histogram(
+                "veles_serving_latency_seconds",
+                "Request latency enqueue -> batch completion",
+                ("model",)).labels(self.model))
+        self._g_queue = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_serving_queue_rows",
+                "Rows pending in the batcher queue",
+                ("model",)).labels(self.model))
         self._completions = collections.deque(maxlen=4096)
         self._thread = threading.Thread(
             target=self._worker, daemon=True,
@@ -99,13 +135,14 @@ class MicroBatcher(Logger):
             if not self._running:
                 raise RuntimeError("batcher is closed")
             if self._queued_rows + n > self.max_queue:
-                self.shed_total += 1
+                self._c["shed_total"].get().inc()
                 raise QueueFull(
                     "queue full (%d rows pending, max %d)"
                     % (self._queued_rows, self.max_queue))
-            self.requests_total += 1
+            self._c["requests_total"].get().inc()
             self._queue.append(req)
             self._queued_rows += n
+            self._g_queue.get().set(self._queued_rows)
             self._have_work.notify()
         return req
 
@@ -155,6 +192,7 @@ class MicroBatcher(Logger):
                 self._queued_rows -= n
                 batch.append(req)
                 total += n
+            self._g_queue.get().set(self._queued_rows)
             return batch
 
     def _worker(self):
@@ -170,8 +208,7 @@ class MicroBatcher(Logger):
                     req.error = DeadlineExceeded(
                         "expired %.0fms before dispatch"
                         % ((now - req.deadline) * 1000))
-                    with self._lock:
-                        self.expired_total += 1
+                    self._c["expired_total"].get().inc()
                     req.event.set()
                 else:
                     live.append(req)
@@ -184,8 +221,7 @@ class MicroBatcher(Logger):
             except Exception as exc:
                 self.warning("batch of %d failed: %s: %s",
                              len(live), type(exc).__name__, exc)
-                with self._lock:
-                    self.error_total += len(live)
+                self._c["error_total"].get().inc(len(live))
                 for req in live:
                     req.error = exc
                     req.event.set()
@@ -197,16 +233,20 @@ class MicroBatcher(Logger):
                 req.result = outputs[off:off + n]
                 off += n
                 req.event.set()
+            self._c["batches_total"].get().inc()
+            self._c["batched_requests_total"].get().inc(len(live))
+            self._c["batched_rows_total"].get().inc(rows.shape[0])
+            self._c["bucket_rows_total"].get().inc(bucket)
+            latency = self._h_latency.get()
             with self._lock:
-                self.batches_total += 1
-                self.batched_requests_total += len(live)
-                self.batched_rows_total += rows.shape[0]
-                self.bucket_rows_total += bucket
                 for req in live:
-                    self._latencies.append(done - req.t_enqueue)
+                    latency.observe(done - req.t_enqueue)
                     self._completions.append(done)
 
-    def close(self):
+    def close(self, zero_gauge=True):
+        """``zero_gauge=False`` is for the hot-reload path: the
+        replacement batcher shares this model's queue-gauge series and
+        is already live, so the dying batcher must not stomp it."""
         with self._lock:
             self._running = False
             self._have_work.notify_all()
@@ -222,38 +262,44 @@ class MicroBatcher(Logger):
                 req.error = RuntimeError("batcher closed")
                 req.event.set()
             self._queued_rows = 0
+            if zero_gauge:
+                self._g_queue.get().set(0)
 
     # -- metrics -------------------------------------------------------
 
     def metrics(self, rps_window=10.0):
+        """The JSON view over the registry instruments — exact
+        pre-registry key shape (regression-tested)."""
+        c = {key: int(self._c[key].get().value)
+             for key, _, _ in self.COUNTERS}
+        latency = self._h_latency.get()
         with self._lock:
-            lat = sorted(self._latencies)
+            queued = self._queued_rows
             now = time.monotonic()
             recent = [t for t in self._completions
                       if t > now - rps_window]
-            m = {
-                "queue_depth": self._queued_rows,
-                "requests_total": self.requests_total,
-                "shed_total": self.shed_total,
-                "expired_total": self.expired_total,
-                "error_total": self.error_total,
-                "batches_total": self.batches_total,
-                "batch_fill_ratio": round(
-                    self.batched_requests_total
-                    / max(self.batches_total, 1), 3),
-                "bucket_pad_ratio": round(
-                    self.bucket_rows_total
-                    / max(self.batched_rows_total, 1), 3),
-                # completions in the window over the WHOLE window: a
-                # time-since-oldest denominator read ~1000 rps off a
-                # single fresh completion
-                "requests_per_sec": round(
-                    len(recent) / rps_window, 2),
-            }
-            if lat:
-                m["latency_ms_p50"] = round(
-                    lat[len(lat) // 2] * 1000, 3)
-                m["latency_ms_p99"] = round(
-                    lat[min(len(lat) - 1,
-                            int(len(lat) * 0.99))] * 1000, 3)
-            return m
+        m = {
+            "queue_depth": queued,
+            "requests_total": c["requests_total"],
+            "shed_total": c["shed_total"],
+            "expired_total": c["expired_total"],
+            "error_total": c["error_total"],
+            "batches_total": c["batches_total"],
+            "batch_fill_ratio": round(
+                c["batched_requests_total"]
+                / max(c["batches_total"], 1), 3),
+            "bucket_pad_ratio": round(
+                c["bucket_rows_total"]
+                / max(c["batched_rows_total"], 1), 3),
+            # completions in the window over the WHOLE window: a
+            # time-since-oldest denominator read ~1000 rps off a
+            # single fresh completion
+            "requests_per_sec": round(
+                len(recent) / rps_window, 2),
+        }
+        p50 = latency.percentile(0.5)
+        if p50 is not None:
+            m["latency_ms_p50"] = round(p50 * 1000, 3)
+            m["latency_ms_p99"] = round(
+                latency.percentile(0.99) * 1000, 3)
+        return m

@@ -13,9 +13,13 @@ Endpoints:
 * ``POST /v1/predict`` — ``{"model": name, "inputs": [[...], ...],
   "timeout_ms": 250}`` -> ``{"outputs": [...], "version": n}``;
   503 when shed (queue full), 504 when the deadline expired
-* ``GET  /healthz``    — liveness
-* ``GET  /metrics``    — queue depth, batch-fill ratio, p50/p99
-  latency, requests/s, per model
+* ``GET  /healthz``      — liveness
+* ``GET  /metrics``      — Prometheus text exposition of the process
+  telemetry registry (serving latency histograms, queue gauges, shed/
+  expired counters — plus whatever else this process instruments)
+* ``GET  /metrics.json`` — the original JSON view (queue depth,
+  batch-fill ratio, p50/p99 latency, requests/s, per model), exact
+  pre-registry key shape
 
 ``register_status(web_status)`` surfaces the same metrics in the
 training dashboard (``web_status.py``) so one page shows both halves
@@ -28,6 +32,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy
 
+from veles import telemetry
 from veles.logger import Logger
 from veles.serving.batcher import DeadlineExceeded, QueueFull
 
@@ -46,9 +51,12 @@ class ServingFrontend(Logger):
                 pass
 
             def _reply(self, code, doc):
-                body = json.dumps(doc).encode()
+                self._reply_raw(code, json.dumps(doc).encode(),
+                                "application/json")
+
+            def _reply_raw(self, code, body, ctype):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -56,8 +64,15 @@ class ServingFrontend(Logger):
             def do_GET(self):
                 if self.path == "/healthz":
                     self._reply(200, {"status": "ok"})
-                elif self.path.startswith("/metrics"):
+                elif self.path.startswith("/metrics.json"):
+                    # the pre-registry JSON shape, now a view over
+                    # the telemetry registry
                     self._reply(200, front.metrics())
+                elif self.path.startswith("/metrics"):
+                    reg = telemetry.get_registry()
+                    self._reply_raw(
+                        200, reg.render_prometheus().encode(),
+                        reg.CONTENT_TYPE)
                 elif self.path.startswith("/v1/models"):
                     self._reply(200,
                                 {"models": front.registry.describe()})

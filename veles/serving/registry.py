@@ -21,6 +21,7 @@ reload costs one host→device upload, no recompilation.
 import threading
 import time
 
+from veles import telemetry
 from veles.logger import Logger
 from veles.serving.batcher import MicroBatcher
 from veles.serving.engine import InferenceEngine
@@ -103,6 +104,7 @@ class ModelRegistry(Logger):
                 old.checkpoint = checkpoint
                 old.version += 1
                 old.loaded_at = time.time()
+                self._version_gauge(name).set(old.version)
                 self.info("model %s reloaded in place -> v%d",
                           name, old.version)
                 return old
@@ -113,17 +115,19 @@ class ModelRegistry(Logger):
                 max_queue=self.max_queue,
                 max_wait_ms=self.max_wait_ms,
                 default_timeout_ms=self.default_timeout_ms,
-                name="batcher-%s" % name)
+                name="batcher-%s" % name, model=name)
             entry = ServedModel(name, model, engine, batcher, source,
                                 checkpoint)
             if old is not None:
                 entry.version = old.version + 1
             self._models[name] = entry
+        self._version_gauge(name).set(entry.version)
         if old is not None:
             # close OUTSIDE the lock: draining the old batcher can
             # block for seconds and must not stall get() for every
-            # other model's request threads
-            old.close()
+            # other model's request threads. The replacement batcher
+            # owns the model's queue-gauge series now — don't zero it.
+            old.batcher.close(zero_gauge=False)
         if warmup:
             entry.engine.warmup()
         self.info("model %s v%d loaded from %s (%d units, backend "
@@ -148,6 +152,10 @@ class ModelRegistry(Logger):
                 self._refresh_failures[name] = \
                     self._refresh_failures.get(name, 0) + 1
                 n = self._refresh_failures[name]
+            telemetry.counter(
+                "veles_serving_refresh_failures_total",
+                "Hot reloads that failed and degraded to the loaded "
+                "version", ("model",)).labels(name).inc()
             self.warning(
                 "hot reload of %s failed (%s: %s; failure #%d) — "
                 "still serving v%d", name, type(exc).__name__, exc,
@@ -168,6 +176,12 @@ class ModelRegistry(Logger):
             self._models.clear()
         for entry in entries:
             entry.close()
+
+    @staticmethod
+    def _version_gauge(name):
+        return telemetry.gauge(
+            "veles_serving_model_version",
+            "Currently served model version", ("model",)).labels(name)
 
     # -- lookup --------------------------------------------------------
 

@@ -1,0 +1,600 @@
+"""Unified telemetry core: metrics registry + span tracer.
+
+One spine for every metric surface in the tree (SURVEY.md §5.5 — the
+reference VELES treated observability as a subsystem: web status,
+plotter streams, MongoDB-shipped logs). Before this module, three
+disconnected ad-hoc surfaces had grown: ``Unit.run_time`` floats,
+hand-rolled p50/p99 dicts in ``veles/serving/batcher.py`` and the
+fault-counter dict on ``MasterServer``. They now all emit into ONE
+process-wide registry of **Counter / Gauge / Histogram** instruments
+with label support, scrapeable in Prometheus text format from both
+``web_status.py`` and the serving frontend (``GET /metrics``), while
+every pre-existing JSON shape stays available as a *view* over the
+registry (``/metrics.json``, ``MasterServer.status()``,
+``Workflow.print_stats``).
+
+Registry model
+--------------
+
+* module-level **active registry** (:func:`get_registry`); tests swap
+  in a fresh one per test via :func:`scoped` so telemetry state can
+  never leak across tests;
+* instruments are *families* created idempotently by name
+  (:func:`counter` / :func:`gauge` / :func:`histogram`); a family with
+  declared ``labels`` hands out per-label-value children via
+  ``.labels(...)``, a label-less family acts as its own child;
+* hot paths hold a :class:`LazyChild` — a call-site handle that
+  re-resolves its child only when the active registry changes
+  (one int compare per observation in the steady state);
+* histograms keep Prometheus cumulative buckets AND a bounded
+  reservoir of raw observations, so the serving JSON's p50/p99 view
+  stays bit-identical to the pre-registry implementation.
+
+Span tracer
+-----------
+
+``with telemetry.span("conv.forward", unit=...)`` records wall-time
+events when tracing is enabled (``velescli.py --trace-out PATH``) and
+costs one attribute check when it is not. :meth:`Tracer.dump` writes
+Chrome-trace/Perfetto-loadable JSON (``chrome://tracing`` or
+https://ui.perfetto.dev).
+"""
+
+import bisect
+import collections
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+#: default histogram buckets (seconds) — spans sub-ms unit runs up to
+#: multi-second fused XLA dispatches / compilations
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+#: raw observations kept per histogram child for percentile queries
+#: (same window the serving batcher kept before the registry existed)
+RESERVOIR_SIZE = 2048
+
+
+# -- instruments -------------------------------------------------------
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError("counters only go up (inc %r)" % (n,))
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        self.inc(-n)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count",
+                 "_reservoir")
+
+    def __init__(self, buckets):
+        self._lock = threading.Lock()
+        self.buckets = buckets
+        self._counts = [0] * (len(buckets) + 1)   # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        # sliding window over the NEWEST observations; deque(maxlen)
+        # evicts in O(1) on the hot path
+        self._reservoir = collections.deque(maxlen=RESERVOIR_SIZE)
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self._counts[bisect.bisect_left(self.buckets, v)] += 1
+            self._sum += v
+            self._count += 1
+            self._reservoir.append(v)
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def percentile(self, q):
+        """Value at quantile ``q`` of the reservoir window, using the
+        EXACT index convention the serving metrics always used
+        (``sorted[min(n-1, int(n*q))]``) so the JSON view over the
+        registry is bit-identical to the pre-registry dicts. None when
+        nothing has been observed."""
+        with self._lock:
+            lat = sorted(self._reservoir)
+        if not lat:
+            return None
+        return lat[min(len(lat) - 1, int(len(lat) * q))]
+
+    def cumulative_buckets(self):
+        """[(upper_bound, cumulative_count), ...] ending at +Inf."""
+        with self._lock:
+            counts = list(self._counts)
+        out, acc = [], 0
+        for ub, c in zip(self.buckets, counts):
+            acc += c
+            out.append((ub, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return out
+
+
+class _Family:
+    """One named instrument: metadata + the per-label-value children.
+
+    ``labelnames`` is the declared label schema for the ``.labels()``
+    convenience; internally children are keyed by sorted label-item
+    tuples, and :meth:`Registry.absorb_counters` may add children with
+    EXTRA labels (the master's per-slave aggregation) — legal in the
+    exposition format, merely unidiomatic for a client library."""
+
+    def __init__(self, name, kind, help, labelnames, buckets=None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._children = {}
+
+    def _make_child(self):
+        if self.kind == "counter":
+            return _CounterChild()
+        if self.kind == "gauge":
+            return _GaugeChild()
+        return _HistogramChild(self.buckets)
+
+    def child(self, items=()):
+        items = tuple(sorted(items))
+        with self._lock:
+            c = self._children.get(items)
+            if c is None:
+                c = self._children[items] = self._make_child()
+            return c
+
+    def labels(self, *values, **kv):
+        if values and kv:
+            raise ValueError("pass label values either positionally "
+                             "or by name, not both")
+        if kv:
+            if set(kv) != set(self.labelnames):
+                raise ValueError(
+                    "%s expects labels %r, got %r"
+                    % (self.name, self.labelnames, tuple(kv)))
+            items = tuple((k, str(v)) for k, v in kv.items())
+        else:
+            if len(values) != len(self.labelnames):
+                raise ValueError(
+                    "%s expects %d label value(s) %r, got %d"
+                    % (self.name, len(self.labelnames),
+                       self.labelnames, len(values)))
+            items = tuple(zip(self.labelnames,
+                              (str(v) for v in values)))
+        return self.child(items)
+
+    def children(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+    # label-less families act as their own child ----------------------
+
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(
+                "%s has labels %r — use .labels(...)"
+                % (self.name, self.labelnames))
+        return self.child(())
+
+    def inc(self, n=1):
+        self._default().inc(n)
+
+    def set(self, v):
+        self._default().set(v)
+
+    def dec(self, n=1):
+        self._default().dec(n)
+
+    def observe(self, v):
+        self._default().observe(v)
+
+    @property
+    def value(self):
+        return self._default().value
+
+    @property
+    def count(self):
+        return self._default().count
+
+    @property
+    def sum(self):
+        return self._default().sum
+
+    def percentile(self, q):
+        return self._default().percentile(q)
+
+
+def _escape_label(value):
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_labels(items, extra=()):
+    pairs = list(items) + list(extra)
+    if not pairs:
+        return ""
+    return "{%s}" % ",".join(
+        '%s="%s"' % (k, _escape_label(str(v))) for k, v in pairs)
+
+
+def _fmt_value(v):
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class Registry:
+    """Thread-safe family container + Prometheus renderer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families = {}
+
+    def _family(self, name, kind, help, labels, buckets=None):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(
+                    name, kind, help, labels, buckets=buckets)
+            elif fam.kind != kind:
+                raise ValueError(
+                    "instrument %r already registered as %s, not %s"
+                    % (name, fam.kind, kind))
+            else:
+                # adopt a label schema (and help) the first declared
+                # use provides: absorb_counters may have registered
+                # the family schema-less before the local instrumented
+                # path declared it, and .labels() must keep working
+                if not fam.labelnames and labels:
+                    fam.labelnames = tuple(labels)
+                if not fam.help and help:
+                    fam.help = help
+            return fam
+
+    def counter(self, name, help="", labels=()):
+        return self._family(name, "counter", help, labels)
+
+    def gauge(self, name, help="", labels=()):
+        return self._family(name, "gauge", help, labels)
+
+    def histogram(self, name, help="", labels=(),
+                  buckets=DEFAULT_BUCKETS):
+        return self._family(name, "histogram", help, labels,
+                            buckets=tuple(buckets))
+
+    def families(self):
+        with self._lock:
+            return [self._families[k]
+                    for k in sorted(self._families)]
+
+    # -- queries -------------------------------------------------------
+
+    def counter_total(self, name, **match):
+        """Sum of a counter family's children whose labels contain
+        every ``match`` item; 0.0 when the family does not exist (a
+        scrape-side convenience, e.g. bench rows)."""
+        with self._lock:
+            fam = self._families.get(name)
+        if fam is None:
+            return 0.0
+        want = {(k, str(v)) for k, v in match.items()}
+        total = 0.0
+        for items, child in fam.children():
+            if want <= set(items):
+                total += child.value
+        return total
+
+    def counter_state(self, exclude_prefixes=(),
+                      exclude_label_keys=()):
+        """{(name, label_items): value} for every counter child —
+        the wire-shippable absolute state a slave diffs against its
+        last push (see ``SlaveClient``). ``exclude_label_keys`` skips
+        children carrying those labels: a co-located master+slave pair
+        shares one registry, and already-absorbed ``slave="N"`` series
+        must never be pushed back (they would re-absorb forever)."""
+        out = {}
+        skip = set(exclude_label_keys)
+        for fam in self.families():
+            if fam.kind != "counter":
+                continue
+            if any(fam.name.startswith(p) for p in exclude_prefixes):
+                continue
+            for items, child in fam.children():
+                if skip and any(k in skip for k, _ in items):
+                    continue
+                out[(fam.name, items)] = child.value
+        return out
+
+    def absorb_counters(self, deltas, extra_labels=()):
+        """Merge counter deltas pushed by a peer (the master
+        aggregating slave counters carried on update messages). Each
+        child lands under its original name + labels with
+        ``extra_labels`` appended (e.g. ``("slave", "3")``), so one
+        scrape shows the whole cluster without colliding with this
+        process's own series."""
+        extra = tuple(extra_labels)
+        for (name, items), v in deltas.items():
+            if v <= 0:
+                continue
+            fam = self.counter(name)
+            fam.child(tuple(items) + extra).inc(v)
+
+    # -- exposition ----------------------------------------------------
+
+    def render_prometheus(self):
+        """The registry in Prometheus text exposition format 0.0.4."""
+        lines = []
+        for fam in self.families():
+            lines.append("# HELP %s %s"
+                         % (fam.name,
+                            (fam.help or fam.name).replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (fam.name, fam.kind))
+            for items, child in fam.children():
+                if fam.kind in ("counter", "gauge"):
+                    lines.append("%s%s %s" % (
+                        fam.name, _fmt_labels(items),
+                        _fmt_value(child.value)))
+                    continue
+                for ub, acc in child.cumulative_buckets():
+                    lines.append("%s_bucket%s %d" % (
+                        fam.name,
+                        _fmt_labels(items, (("le", _fmt_value(ub)),)),
+                        acc))
+                lines.append("%s_sum%s %s" % (
+                    fam.name, _fmt_labels(items),
+                    repr(float(child.sum))))
+                lines.append("%s_count%s %d" % (
+                    fam.name, _fmt_labels(items), child.count))
+        return "\n".join(lines) + "\n"
+
+    #: content type a /metrics endpoint should reply with
+    CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+# -- active-registry plumbing ------------------------------------------
+
+_swap_lock = threading.Lock()
+_active = Registry()
+_generation = 0
+
+
+def get_registry() -> Registry:
+    return _active
+
+
+def set_registry(registry: Registry) -> Registry:
+    """Swap the active registry (-> the previous one). Bumps the
+    generation so every :class:`LazyChild` re-resolves."""
+    global _active, _generation
+    with _swap_lock:
+        previous = _active
+        _active = registry
+        _generation += 1
+    return previous
+
+
+def generation() -> int:
+    return _generation
+
+
+@contextmanager
+def scoped(registry: Registry = None):
+    """``with scoped():`` — run under a fresh (or given) registry,
+    restoring the previous one on exit. The per-test isolation hook
+    (autouse fixture in ``tests/conftest.py``)."""
+    registry = registry if registry is not None else Registry()
+    previous = set_registry(registry)
+    try:
+        yield registry
+    finally:
+        set_registry(previous)
+
+
+def counter(name, help="", labels=()):
+    return _active.counter(name, help=help, labels=labels)
+
+
+def gauge(name, help="", labels=()):
+    return _active.gauge(name, help=help, labels=labels)
+
+
+def histogram(name, help="", labels=(), buckets=DEFAULT_BUCKETS):
+    return _active.histogram(name, help=help, labels=labels,
+                             buckets=buckets)
+
+
+class LazyChild:
+    """A call-site instrument handle for hot paths: ``factory`` is
+    invoked on first use and again only when the active registry has
+    been swapped (test isolation), so the steady-state cost of
+    ``handle.get().observe(dt)`` is one int compare + the child op."""
+
+    __slots__ = ("_factory", "_gen", "_child")
+
+    def __init__(self, factory):
+        self._factory = factory
+        self._gen = -1
+        self._child = None
+
+    def get(self):
+        g = _generation
+        if g != self._gen:
+            self._child = self._factory()
+            self._gen = g
+        return self._child
+
+
+# -- span tracer -------------------------------------------------------
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_args", "_start")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.add_complete(
+            self._name, self._start,
+            time.perf_counter() - self._start, **self._args)
+        return False
+
+
+def _jsonable(v):
+    return v if isinstance(v, (int, float, str, bool, type(None))) \
+        else str(v)
+
+
+class Tracer:
+    """Wall-time span recorder dumping Chrome-trace JSON.
+
+    Disabled by default: ``span()`` then returns a shared no-op
+    context manager and ``add_complete`` is guarded by callers with
+    ``if tracer.enabled`` (one attribute check on the hot path)."""
+
+    #: event-buffer cap (~200MB of dicts; multi-GB traces don't load
+    #: in chrome://tracing anyway). Oldest events are dropped first —
+    #: for a crash postmortem the tail is what matters — and the drop
+    #: count is recorded in the dump's otherData.
+    max_events = 1_000_000
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._events = collections.deque()
+        self._dropped = 0
+        self._t0 = 0.0
+
+    def start(self):
+        with self._lock:
+            self._events = collections.deque()
+            self._dropped = 0
+            self._t0 = time.perf_counter()
+            self.enabled = True
+
+    def stop(self):
+        self.enabled = False
+
+    def clear(self):
+        with self._lock:
+            self._events = collections.deque()
+            self._dropped = 0
+
+    def span(self, name, **args):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def add_complete(self, name, start, duration, **args):
+        """Record one complete ('ph: X') event; ``start`` is a
+        ``time.perf_counter()`` reading, ``duration`` seconds."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": (start - self._t0) * 1e6,       # Chrome wants µs
+            "dur": duration * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+        }
+        if args:
+            ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self._events.popleft()
+                self._dropped += 1
+            self._events.append(ev)
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def dump(self, path):
+        """Write the recorded events as Chrome-trace JSON (loadable by
+        chrome://tracing and Perfetto); -> ``path``."""
+        doc = {"traceEvents": self.events(),
+               "displayTimeUnit": "ms"}
+        if self._dropped:
+            doc["otherData"] = {"dropped_events": str(self._dropped)}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path
+
+
+tracer = Tracer()
+
+
+def span(name, **args):
+    """``with telemetry.span("conv.forward", unit=u):`` — module-level
+    convenience over the process tracer."""
+    return tracer.span(name, **args)

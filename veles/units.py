@@ -23,6 +23,7 @@ Per-unit wall time is accumulated for the profiling report (SURVEY.md
 import time
 from collections import OrderedDict
 
+from veles import telemetry
 from veles.logger import Logger
 from veles.mutable import Bool, LinkableAttribute
 
@@ -40,6 +41,14 @@ class Unit(Logger):
         self._initialized = False
         self.run_calls = 0
         self.run_time = 0.0
+        #: per-unit step-time histogram (the registry-backed upgrade
+        #: of the bare run_time float; resolved lazily so the unit
+        #: name is final and test-scoped registries are honoured)
+        self._run_seconds = telemetry.LazyChild(
+            lambda: telemetry.histogram(
+                "veles_unit_run_seconds",
+                "Wall time of one Unit.run call",
+                ("unit",)).labels(self.name))
         if workflow is not None:
             workflow.add_unit(self)
 
@@ -124,8 +133,14 @@ class Unit(Logger):
         if not bool(self.gate_skip):
             start = time.perf_counter()
             self.run()
-            self.run_time += time.perf_counter() - start
+            dt = time.perf_counter() - start
+            self.run_time += dt
             self.run_calls += 1
+            self._run_seconds.get().observe(dt)
+            if telemetry.tracer.enabled:
+                telemetry.tracer.add_complete(
+                    "%s.run" % self.name, start, dt,
+                    unit=type(self).__name__)
         out = []
         for dst in self.links_to:
             if bool(dst.gate_block):

@@ -7,6 +7,11 @@ POSTed status JSON to, plus a JS frontend. The rebuild is a stdlib
 
 * ``GET /``            — self-refreshing HTML dashboard
 * ``GET /status.json`` — machine-readable run status
+* ``GET /metrics``     — Prometheus text exposition of the process
+                         telemetry registry (unit step-time
+                         histograms, compile/dispatch times, cluster
+                         fault counters incl. aggregated slave-pushed
+                         series — ``veles/telemetry.py``)
 * ``POST /update``     — remote launchers push their status dicts
                          (same-host launchers register a callable)
 
@@ -20,6 +25,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from veles import telemetry
 from veles.logger import Logger
 
 _PAGE = """<!DOCTYPE html>
@@ -71,6 +77,11 @@ class WebStatus(Logger):
                     body = json.dumps(status.snapshot(),
                                       indent=1).encode()
                     self._reply(200, body, "application/json")
+                elif self.path.startswith("/metrics"):
+                    reg = telemetry.get_registry()
+                    self._reply(200,
+                                reg.render_prometheus().encode(),
+                                reg.CONTENT_TYPE)
                 elif self.path == "/":
                     self._reply(200, status.render_page().encode(),
                                 "text/html")

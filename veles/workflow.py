@@ -17,6 +17,7 @@ import sys
 import time
 from collections import OrderedDict, deque
 
+from veles import telemetry
 from veles.units import Unit, TrivialUnit, Container
 
 
@@ -136,6 +137,7 @@ class Workflow(Unit, Container):
         self._stopped = False
         self.end_point.reached = False
         self.run_number += 1
+        run_start = time.perf_counter()
         # Clear stale fired-link flags from a previous stopped run: a
         # leftover True on a fan-in unit would let it fire early.
         for unit in self._units:
@@ -151,6 +153,11 @@ class Workflow(Unit, Container):
                 continue
             if unit._ready():
                 worklist.extend(unit._execute())
+        if telemetry.tracer.enabled:
+            telemetry.tracer.add_complete(
+                "workflow.run", run_start,
+                time.perf_counter() - run_start, workflow=self.name,
+                run_number=self.run_number)
 
     def stop(self):
         self._stopped = True

@@ -21,9 +21,26 @@ import time
 
 import numpy
 
+from veles import telemetry
 from veles.accelerated_units import StepCompiler
 from veles.loader.base import CLASS_TRAIN
 from veles.units import Unit
+
+
+def _record_dispatch(kind, warm, start, dt, **args):
+    """One fused-dispatch observation: wall time (metric fetch is the
+    sync point, so this includes real device execution) split by
+    program kind and warmth — a cold dispatch includes XLA
+    compilation, which is where recompile time shows up."""
+    telemetry.histogram(
+        "veles_xla_dispatch_seconds",
+        "Wall time of one fused dispatch incl. metric fetch "
+        "(warm=\"0\" includes XLA compilation)",
+        ("kind", "warm")).labels(kind, "1" if warm else "0").observe(dt)
+    if telemetry.tracer.enabled:
+        telemetry.tracer.add_complete(
+            "xla.dispatch.%s" % kind, start, dt,
+            warm=bool(warm), **args)
 
 
 class XLAStep(Unit):
@@ -383,7 +400,9 @@ class XLAStep(Unit):
         self.params, self.state, outs = fn(*args)
         host_outs = _fetch_tree(outs)
         dt = time.perf_counter() - t0
-        if n_epochs in self._seen_chunk_lengths:
+        warm = n_epochs in self._seen_chunk_lengths
+        _record_dispatch("epoch", warm, t0, dt, epochs=n_epochs)
+        if warm:
             # a clean (compile-free) run of this program: usable for
             # sizing the next chunk
             self._last_epoch_seconds = dt / n_epochs
@@ -467,6 +486,7 @@ class XLAStep(Unit):
         compute instead of serializing with it."""
         import concurrent.futures
         import jax
+        t_epoch0 = time.perf_counter()
         loader = self.loader
         if self._stage_pool is None:
             self._stage_pool = concurrent.futures.ThreadPoolExecutor(
@@ -526,6 +546,20 @@ class XLAStep(Unit):
         self._chunk_epoch0 = loader.epoch_number
         self._chunk_len = 1
         self._dispatched_epoch = loader.epoch_number
+        # warmth is per window-shape signature, not first-call-only:
+        # a new span layout (window count/lengths change with dataset
+        # or cap retunes) re-traces under jit and must land in the
+        # warm="0" (includes-compilation) histogram series
+        sig = tuple(sorted({(cls, len(rows))
+                            for cls, _, rows in spans}))
+        seen = getattr(self, "_stream_sigs", None)
+        if seen is None:
+            seen = self._stream_sigs = set()
+        warm = sig in seen
+        seen.add(sig)
+        _record_dispatch("stream", warm, t_epoch0,
+                         time.perf_counter() - t_epoch0,
+                         windows=len(spans))
 
     def _run_per_step(self):
         import jax
