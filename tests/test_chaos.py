@@ -389,6 +389,50 @@ def test_backoff_is_capped_with_jitter():
         d = client._backoff(attempt)
         assert 0.0 < d <= 2.0 * 1.25
     assert client._backoff(1) <= 0.05 * 1.25
+    # retry-forever mode (max_retries=None) runs attempt into the
+    # thousands: the exponent must be clamped, not overflow float
+    for attempt in (1030, 10 ** 6):
+        assert 0.0 < client._backoff(attempt) <= 2.0 * 1.25
+
+
+def test_slave_request_stop_exits_retry_forever_loop():
+    """Preemption relay: request_stop() must break run_forever even
+    with max_retries=None and nothing listening (the slave is deep in
+    reconnect backoff when SIGTERM arrives)."""
+    wf = make_wf("StopWf")
+    wf.is_slave = True
+    client = SlaveClient(wf, "127.0.0.1:1", io_timeout=0.5,
+                         retry_base=0.05, retry_max=5.0,
+                         max_retries=None)
+    t = threading.Thread(target=client.run_forever, daemon=True)
+    t.start()
+    time.sleep(0.3)               # let it enter the backoff loop
+    client.request_stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_completed_master_drains_byes_to_stragglers():
+    """A run that completes while a slave is disconnected must not
+    strand it: the master keeps its listener up for drain_timeout
+    answering ("bye",), so a retry-forever slave reconnecting just
+    after done still hears the goodbye instead of retrying a dead
+    address forever."""
+    wf = make_wf("DrainMaster")
+    server = MasterServer(wf, "127.0.0.1:0", max_epochs=3,
+                          slave_timeout=5.0, drain_timeout=3.0)
+    server.start_background()
+    server.done.set()
+    time.sleep(0.15)              # serve loop enters the drain window
+    swf = make_wf("DrainSlave")
+    swf.is_slave = True
+    client = SlaveClient(swf, "127.0.0.1:%d" % server.bound_address[1],
+                         io_timeout=1.0, retry_base=0.02,
+                         retry_max=0.2, max_retries=None)
+    t = threading.Thread(target=client.run_forever, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
 
 
 def test_client_gives_up_after_max_retries():
@@ -454,6 +498,304 @@ def test_chaos_proxy_counts_and_passes_frames():
     assert stats["connections"] == 1
     assert stats[C2S][PASS] >= 2 and stats[S2C][PASS] >= 2
     server.done.set()
+
+
+# -- master restart recovery (ISSUE 4 acceptance) ----------------------
+
+
+def test_persist_degrades_never_dies(tmp_path, monkeypatch):
+    """The 'persistence must degrade, never kill the cluster'
+    contract covers STATE BUILD failures too: an exception out of
+    checkpoint_state (bad slave-pushed telemetry entry, transient
+    device error) must be swallowed into a warning + None, or it
+    silently kills the persist thread / crashes the shutdown path."""
+    from veles.snapshotter import FileSnapshotStore
+    wf = make_wf("PersistWf")
+    server = MasterServer(
+        wf, "127.0.0.1:0", max_epochs=3,
+        checkpoint_store=FileSnapshotStore(str(tmp_path)),
+        checkpoint_every=0.05)
+    def boom():
+        raise RuntimeError("boom")
+    monkeypatch.setattr(server, "checkpoint_state", boom)
+    assert server.persist_state("test") is None
+    assert server.persist_count == 0
+    server.done.set()
+
+
+def test_master_restart_recovery(tmp_path):
+    """Acceptance: kill the master mid-run (SIGKILL semantics: no
+    goodbye, no final persist), restart it from the store with the
+    auto-resume path — slaves reconnect UNAIDED (re-hello against the
+    fresh lease table, re-sync via the job payloads) and the final
+    weights match the fault-free sequential run within the usual
+    tolerance: every minibatch merged exactly once relative to the
+    restored state."""
+    from veles.snapshotter import FileSnapshotStore, resolve_auto
+    w_ref = sequential_reference(max_epochs=3)
+    store = FileSnapshotStore(str(tmp_path))
+
+    def spawn_master(resume):
+        wf = make_wf("RestartMaster", max_epochs=None)
+        wf.loader.shuffle_enabled = False
+        wf.loader._start_epoch(first=True)
+        wf.decision.max_epochs = 3
+        resume_state = None
+        if resume:
+            resolved = resolve_auto(store)
+            assert resolved, "no persisted master state to resume"
+            tree, name, _ = resolved
+            assert "master" in tree, tree.keys()
+            wf.restore_state(tree["workflow"])
+            resume_state = tree["master"]
+        server = MasterServer(wf, "127.0.0.1:0", max_epochs=3,
+                              slave_timeout=5.0,
+                              checkpoint_store=store,
+                              checkpoint_every=0.02,
+                              resume_state=resume_state)
+        if resume:
+            # the journal actually landed (falsifiable: a restore that
+            # silently fell back to construction defaults would not
+            # track the persisted counters — which may legitimately
+            # still be at 1/0 if the newest persist predates serving,
+            # so "made progress" is NOT assertable here)
+            assert server.epoch == resume_state["epoch"]
+            assert server._next_job == resume_state["next_job"]
+        server.start_background()
+        return wf, server
+
+    wf1, server1 = spawn_master(resume=False)
+
+    def pace(evt):
+        # pace the cluster: ~20ms per served job, so the synthetic
+        # workload cannot race from start to done before the test
+        # thread (GIL-starved by the in-process cluster) gets to kill
+        # the master mid-run
+        if evt.direction == S2C and evt.kind == "job":
+            return DELAY
+        return None
+
+    with ChaosProxy(("127.0.0.1", server1.bound_address[1]),
+                    plan=pace, delay_s=0.02) as proxy:
+        clients, errors = [], []
+
+        def run_slave(idx):
+            wf = make_wf("RestartSlave%d" % idx)
+            wf.is_slave = True
+            client = SlaveClient(
+                wf, proxy.address, name="restart-%d" % idx,
+                io_timeout=1.0, retry_base=0.02, retry_max=0.25,
+                max_retries=None)     # a preemptible master's setting
+            clients.append(client)
+            try:
+                client.run_forever()
+            except ConnectionError as exc:
+                errors.append(str(exc))
+
+        # daemons: these clients retry FOREVER (max_retries=None), so
+        # any assertion failing mid-test must not leave pytest waiting
+        # on a spinning non-daemon thread for the rest of time
+        threads = [threading.Thread(target=run_slave, args=(i,),
+                                    daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+
+        # let the cluster make SOME progress and persist at least
+        # once, then kill EARLY (most of the run still ahead) so the
+        # recovery is substantial, not a formality
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if server1.persist_count >= 1 \
+                    and sum(c.jobs_done for c in clients) >= 4:
+                break
+            time.sleep(0.005)
+        assert server1.persist_count >= 1, "master never persisted"
+        assert not server1.done.is_set(), \
+            "run finished before the kill — nothing was recovered"
+
+        # SIGKILL: stop serving with NO final persist, sever sockets
+        server1.kill()
+        proxy.kill_all()
+
+        wf2, server2 = spawn_master(resume=True)
+        proxy.target = ("127.0.0.1", server2.bound_address[1])
+
+        assert server2.done.wait(timeout=120), server2.status()
+        # slaves caught mid-reconnect when the run completes would
+        # retry forever (max_retries=None): cap them so threads exit
+        for c in clients:
+            c.max_retries = 10
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+    # at least one slave re-helloed the restarted master UNAIDED and
+    # drove the recovered run to completion (whether the second one
+    # makes it back before the work runs out is a scheduling race on
+    # this fast synthetic workload, not a robustness property)
+    assert server2.faults["joins"] >= 1, server2.status()
+
+    w_master = numpy.asarray(
+        wf2.forwards[0].weights.map_read().mem)
+    assert numpy.isfinite(w_master).all()
+    # weight parity with the fault-free sequential run: the replayed
+    # post-persist minibatches run at the restored weights, so a tiny
+    # tail of elements drifts marginally past the usual 2e-2 chaos
+    # tolerance (measured: <0.004 % of elements, max ~0.023 over 30+
+    # runs). Keep 2e-2 as the BULK criterion and cap the tail hard —
+    # an accounting bug (lost epoch, double merge) diverges broadly
+    # and blows both.
+    diff = numpy.abs(w_master - w_ref)
+    ctx = str({"status": server2.status(), "errors": errors,
+               "max": float(diff.max()),
+               "frac>2e-2": float((diff > 0.02).mean())})
+    assert diff.max() < 0.05, ctx
+    assert (diff > 0.02).mean() < 1e-3, ctx
+
+
+def test_master_resume_state_fences_old_leases():
+    """A restored master must fence every pre-restart identity: the
+    lease table starts empty even though slave/job counters continue,
+    so a zombie frame can never merge into the recovered weights."""
+    wf1 = make_wf("FencePersist", max_epochs=None)
+    wf1.decision.max_epochs = 2
+    server1 = MasterServer(wf1, "127.0.0.1:0", max_epochs=2)
+    _, sid, lease = server1.handle(("hello", "old-slave"))
+    resp = server1.handle(("job", sid, lease))
+    assert resp[0] == "job"
+    state = server1.checkpoint_state()
+
+    wf2 = make_wf("FenceRestored", max_epochs=None)
+    wf2.decision.max_epochs = 2
+    wf2.restore_state(state["workflow"])
+    server2 = MasterServer(wf2, "127.0.0.1:0", max_epochs=2,
+                           resume_state=state["master"])
+    # the in-flight job was folded back into pending on persist
+    assert wf2.loader._pending_jobs[0] == resp[1][wf1.loader.name]
+    # the old lease is dead on arrival
+    assert server2.handle(("job", sid, lease)) == ("stale",)
+    assert server2.handle(
+        ("update", sid, lease, resp[2], resp[3], {})) == ("stale",)
+    # and a fresh hello mints an id the old incarnation never used
+    _, sid2, _ = server2.handle(("hello", "new-slave"))
+    assert sid2 > sid
+
+
+def test_master_resume_empty_queue_does_not_replay_epoch():
+    """A persist can land in the window where an epoch is FULLY merged
+    (pending and in-flight both empty) but the counter not yet
+    advanced (that happens lazily on the next job poll). A restore
+    from that state must leave the queue empty — refilling it at the
+    stale counter would replay a whole already-merged epoch into the
+    restored weights."""
+    wf1 = make_wf("EmptyQPersist", max_epochs=None)
+    wf1.decision.max_epochs = 3
+    server1 = MasterServer(wf1, "127.0.0.1:0", max_epochs=3)
+    _, sid, lease = server1.handle(("hello", "sl"))
+    while wf1.loader._pending_jobs:
+        resp = server1.handle(("job", sid, lease))
+        assert resp[0] == "job", resp
+        # the payload names the loader, so the in-flight entry clears:
+        # a fully MERGED epoch, not just a fully served one
+        server1.handle(("update", sid, lease, resp[2], resp[3],
+                        {wf1.loader.name: None}))
+    state = server1.checkpoint_state()
+    assert not state["master"]["pending"]
+    assert state["master"]["epoch"] == 0
+
+    wf2 = make_wf("EmptyQRestored", max_epochs=None)
+    wf2.decision.max_epochs = 3
+    wf2.restore_state(state["workflow"])
+    server2 = MasterServer(wf2, "127.0.0.1:0", max_epochs=3,
+                           resume_state=state["master"])
+    assert server2.epoch == 0
+    assert not wf2.loader._pending_jobs   # no refill at the stale counter
+    _, sid2, lease2 = server2.handle(("hello", "sl2"))
+    assert server2.handle(("job", sid2, lease2)) == ("wait",)
+    assert server2.epoch == 1             # advanced, not replayed
+    resp = server2.handle(("job", sid2, lease2))
+    assert resp[0] == "job" and resp[3] == 1
+
+
+@pytest.mark.slow
+def test_master_sigkill_soak_subprocess(tmp_path):
+    """Soak: the full CLI stack — master and slaves as real
+    processes, the master SIGKILLed and restarted TWICE with
+    ``--snapshot auto`` on the same port; slaves (--slave-retries 0 =
+    unbounded) ride through both restarts and the run completes."""
+    import os
+    import subprocess
+    import sys
+    from tests.test_service import REPO
+
+    port = _dead_port()
+    snapdir = str(tmp_path / "snaps")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    overrides = ["root.mnist.decision.max_epochs=6",
+                 "root.mnist.loader.n_train=500",
+                 "root.mnist.loader.n_valid=100",
+                 "root.mnist.loader.minibatch_size=50"]
+    base = [sys.executable, "-m", "veles",
+            os.path.join(REPO, "veles/znicz_tpu/models/mnist.py"),
+            "--seed", "11", "-d", "numpy", "--no-stats"] + overrides
+    master_cmd = base + ["--listen-address", "127.0.0.1:%d" % port,
+                         "--snapshots", snapdir,
+                         "--checkpoint-every", "0.2",
+                         "--slave-timeout", "5"]
+
+    def master_files():
+        try:
+            return {n for n in os.listdir(snapdir) if "_master-" in n}
+        except OSError:
+            return set()
+
+    def wait_new_master_file(before, proc, timeout=120):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if master_files() - before:
+                return True
+            if proc.poll() is not None:
+                return False        # master finished on its own
+            time.sleep(0.05)
+        return False
+
+    procs = []
+    try:
+        master = subprocess.Popen(master_cmd, cwd=REPO, env=env,
+                                  stdout=subprocess.DEVNULL,
+                                  stderr=subprocess.DEVNULL)
+        procs.append(master)
+        slaves = [subprocess.Popen(
+            base + ["--master-address", "127.0.0.1:%d" % port,
+                    "--slave-retries", "0"],
+            cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL) for _ in range(2)]
+        procs += slaves
+
+        for round_ in range(2):
+            before = master_files()
+            if not wait_new_master_file(before, master):
+                # the run may legitimately complete before a second
+                # kill window opens; the restart already proved itself
+                assert round_ > 0 and master.poll() is not None, \
+                    "no master persist before kill %d" % round_
+                break
+            time.sleep(0.5)       # accumulate some post-persist work
+            master.kill()         # SIGKILL: no handler, no goodbye
+            master.wait(timeout=30)
+            master = subprocess.Popen(
+                master_cmd + ["--snapshot", "auto"], cwd=REPO,
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            procs.append(master)
+
+        assert master.wait(timeout=600) == 0
+        for slave in slaves:
+            assert slave.wait(timeout=120) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
 
 # -- snapshot store degradation ----------------------------------------

@@ -1,0 +1,469 @@
+"""Durable training (ISSUE 4): manifest-verified checkpoints,
+interval + shutdown checkpointing, ``--snapshot auto`` fallback past
+corruption, retention rebuild after restart, the ``checkpoints`` CLI
+audit, and SIGTERM preemption end to end."""
+
+import gzip
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy
+import pytest
+
+import veles.snapshotter as S
+from veles import telemetry
+from veles.chaos import corrupt_store_entry, flip_bit, truncate_blob
+from tests.test_service import REPO, make_wf
+
+
+# -- manifest integrity ------------------------------------------------
+
+
+def test_manifest_roundtrip():
+    tree = {"params": {"u": {"w": numpy.arange(12.0).reshape(3, 4)}},
+            "meta": {"workflow": "m", "epoch": 3}}
+    raw = S.dump_checkpoint(tree, slot="current", extra_meta={"x": 1})
+    flat, manifest = S.parse_checkpoint(raw, "m.ckpt.npz")
+    assert manifest["schema"] == S.SCHEMA_VERSION
+    assert manifest["slot"] == "current" and manifest["x"] == 1
+    assert manifest["wall_time"] <= time.time()
+    assert set(manifest["arrays"]) == set(flat)
+    back = S._unflatten_tree(flat)
+    numpy.testing.assert_array_equal(back["params"]["u"]["w"],
+                                     tree["params"]["u"]["w"])
+    assert back["meta"]["epoch"] == 3
+
+
+def test_manifest_catches_bitflip_in_payload():
+    """A single flipped bit in an (uncompressed) array region must
+    fail the per-array sha256 — this is the fault class container
+    CRCs don't reliably catch once the blob is on a dumb store."""
+    tree = {"params": {"u": {"w": numpy.zeros((64, 64))}}}
+    raw = S.dump_checkpoint(tree)
+    seen = 0
+    for seed in range(4):
+        try:
+            S.parse_checkpoint(flip_bit(raw, seed=seed))
+        except S.CorruptCheckpointError:
+            seen += 1
+    assert seen == 4
+
+
+def test_parse_rejects_truncated_gzip(tmp_path):
+    store = S.FileSnapshotStore(str(tmp_path))
+    tree = {"params": {"u": {"w": numpy.ones(128)}}}
+    S.write_checkpoint(store, "t_x.ckpt.npz.gz", tree)
+    raw = store.get("t_x.ckpt.npz.gz")
+    for frac in (0.1, 0.5, 0.9):
+        with pytest.raises(S.CorruptCheckpointError):
+            S.parse_checkpoint(truncate_blob(raw, frac),
+                               "t_x.ckpt.npz.gz")
+    # load_snapshot surfaces the same fault class for explicit paths
+    store.put("t_x.ckpt.npz.gz", truncate_blob(raw))
+    with pytest.raises(S.CorruptCheckpointError):
+        S.load_snapshot(os.path.join(str(tmp_path),
+                                     "t_x.ckpt.npz.gz"))
+
+
+def test_file_store_commit_is_atomic(tmp_path):
+    """The write-then-rename (now fsynced) leaves either the complete
+    blob or nothing — never a .tmp turd a resume could see."""
+    store = S.FileSnapshotStore(str(tmp_path))
+    uri = store.put("a_x.ckpt.npz", b"payload")
+    assert open(uri, "rb").read() == b"payload"
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.endswith(".tmp")]
+
+    class Boom(Exception):
+        pass
+
+    with pytest.raises(Boom):
+        with store.stream("b_x.ckpt.npz"):
+            raise Boom()
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "b_x.ckpt.npz"))
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.endswith(".tmp")]
+
+
+# -- scan / auto-resume ------------------------------------------------
+
+
+def _mini_tree(tag):
+    return {"params": {"u": {"w": numpy.full(8, float(tag))}},
+            "meta": {"tag": tag}}
+
+
+def test_scan_orders_and_classifies(tmp_path):
+    store = S.FileSnapshotStore(str(tmp_path))
+    S.write_checkpoint(store, "wf_=0.5.ckpt.npz.gz", _mini_tree(1))
+    S.write_checkpoint(store, "wf_current-00000001.ckpt.npz.gz",
+                       _mini_tree(2))
+    # legacy: a pre-manifest blob written the old way
+    buf = io.BytesIO()
+    numpy.savez(buf, **S._flatten_tree(_mini_tree(0)))
+    store.put("wf_legacy.ckpt.npz.gz", gzip.compress(buf.getvalue()))
+    # corrupt: bit-flipped newest
+    S.write_checkpoint(store, "wf_current-00000002.ckpt.npz.gz",
+                       _mini_tree(3))
+    corrupt_store_entry(store, "wf_current-00000002.ckpt.npz.gz",
+                        "truncate")
+
+    infos = S.scan_checkpoints(str(tmp_path))
+    by_status = {}
+    for i in infos:
+        by_status.setdefault(i.status, []).append(i.name)
+    assert len(by_status["valid"]) == 2
+    assert by_status["legacy"] == ["wf_legacy.ckpt.npz.gz"]
+    assert by_status["corrupt"] == ["wf_current-00000002.ckpt.npz.gz"]
+    # newest valid leads
+    assert infos[0].name == "wf_current-00000001.ckpt.npz.gz"
+
+
+def test_auto_resume_falls_back_past_corruption(tmp_path):
+    """The acceptance fault: the NEWEST checkpoint is corrupt (both a
+    truncated gzip and a bit-flipped payload) — auto-resume must pick
+    the previous valid one and count every rejection."""
+    store = S.FileSnapshotStore(str(tmp_path))
+    S.write_checkpoint(store, "wf_current-00000001.ckpt.npz.gz",
+                       _mini_tree(1))
+    S.write_checkpoint(store, "wf_current-00000002.ckpt.npz.gz",
+                       _mini_tree(2))
+    S.write_checkpoint(store, "wf_current-00000003.ckpt.npz.gz",
+                       _mini_tree(3))
+    corrupt_store_entry(store, "wf_current-00000003.ckpt.npz.gz",
+                        "truncate")
+    corrupt_store_entry(store, "wf_current-00000002.ckpt.npz.gz",
+                        "bitflip", seed=7)
+
+    before = telemetry.get_registry().counter_total(
+        "veles_checkpoint_verify_failures_total")
+    tree, name, skipped = S.resolve_auto(str(tmp_path))
+    assert name == "wf_current-00000001.ckpt.npz.gz"
+    assert tree["meta"]["tag"] == 1
+    assert skipped == 2
+    after = telemetry.get_registry().counter_total(
+        "veles_checkpoint_verify_failures_total")
+    assert after - before == 2
+
+    # nothing valid at all -> None (fresh start), never an exception
+    corrupt_store_entry(store, "wf_current-00000001.ckpt.npz.gz",
+                        "truncate")
+    assert S.resolve_auto(str(tmp_path)) is None
+
+
+def test_auto_resume_ignores_legacy(tmp_path):
+    store = S.FileSnapshotStore(str(tmp_path))
+    buf = io.BytesIO()
+    numpy.savez(buf, **S._flatten_tree(_mini_tree(9)))
+    store.put("wf_old.ckpt.npz.gz", gzip.compress(buf.getvalue()))
+    assert S.resolve_auto(str(tmp_path)) is None
+
+
+def test_auto_resume_filters_by_workflow_prefix(tmp_path):
+    """On a SHARED snapshot directory, --snapshot auto must only
+    consider THIS workflow's checkpoints: workflow A resuming "the
+    newest blob in the store" must never graft workflow B's newer
+    weights onto itself."""
+    store = S.FileSnapshotStore(str(tmp_path))
+    S.write_checkpoint(store, "wfA_=0.5.ckpt.npz.gz", _mini_tree(1))
+    time.sleep(0.02)
+    S.write_checkpoint(store, "wfB_=0.4.ckpt.npz.gz", _mini_tree(2))
+    tree, name, _ = S.resolve_auto(str(tmp_path), prefixes={"wfA"})
+    assert name.startswith("wfA_")
+    assert tree["meta"]["tag"] == 1
+    # unfiltered call keeps the old "newest wins" behaviour
+    _, name, _ = S.resolve_auto(str(tmp_path))
+    assert name.startswith("wfB_")
+    # a prefix set matching nothing = no verifiable checkpoint
+    assert S.resolve_auto(str(tmp_path), prefixes={"wfC"}) is None
+    # a workflow whose name merely EXTENDS ours is still foreign: the
+    # filter matches "<prefix>_<own stamp>", not a bare startswith
+    time.sleep(0.02)
+    S.write_checkpoint(store, "wfA_big_current-00000001.ckpt.npz.gz",
+                       _mini_tree(3))
+    tree, name, _ = S.resolve_auto(str(tmp_path), prefixes={"wfA"})
+    assert name.startswith("wfA_=")
+    assert tree["meta"]["tag"] == 1
+    _, name, _ = S.resolve_auto(str(tmp_path), prefixes={"wfA_big"})
+    assert name == "wfA_big_current-00000001.ckpt.npz.gz"
+
+
+def test_read_side_never_creates_a_missing_store(tmp_path):
+    """A typo'd resume/audit path must raise, not be silently created
+    and read as "empty store, start fresh" — the loud-failure contract
+    of resolve_auto's docstring, enforced end to end."""
+    missing = str(tmp_path / "no" / "such" / "dir")
+    with pytest.raises(FileNotFoundError):
+        S.resolve_auto(missing)
+    with pytest.raises(FileNotFoundError):
+        S.scan_checkpoints(missing)
+    assert not os.path.exists(missing)
+    from veles.__main__ import checkpoints_main
+    assert checkpoints_main([missing]) == 2
+    assert not os.path.exists(missing)
+    # the WRITE side (a snapshotter materializing its directory)
+    # still creates: first run of a fresh job must not need a mkdir
+    S.store_for_base(missing).put("wf_x.ckpt.npz", b"d")
+    assert os.path.exists(missing)
+
+
+# -- interval checkpointing + retention --------------------------------
+
+
+def test_interval_checkpoints_during_run(tmp_path):
+    """End to end: a snapshotter configured with a (tiny) wall-clock
+    interval writes rolling ``current`` checkpoints at unit boundaries
+    DURING the run, alongside the improvement-gated best ones, each
+    slot pruned to its own retention."""
+    import veles.prng as prng
+    from veles.config import root
+    from veles.znicz_tpu.models import mnist
+    from veles.znicz_tpu.standard_workflow import StandardWorkflow
+    prng.seed_all(555)
+    root.mnist.loader.minibatch_size = 50
+    root.mnist.loader.n_train = 500
+    root.mnist.loader.n_valid = 100
+    root.mnist.decision.max_epochs = 2
+    wf = StandardWorkflow(
+        None, name="IntervalWf", layers=root.mnist.layers,
+        loader_factory=lambda w: mnist.MnistLoader(
+            w, name="loader", minibatch_size=50),
+        decision_config=root.mnist.decision.to_dict(),
+        snapshotter_config={"directory": str(tmp_path),
+                            "interval": 1e-6, "keep_interval": 2})
+    wf.initialize(device="numpy")
+    wf.run()
+    names = S.FileSnapshotStore(str(tmp_path)).list()
+    current = [n for n in names if "_current-" in n]
+    best = [n for n in names if "_current-" not in n]
+    assert current, names
+    assert len(current) <= 2            # keep_interval retention
+    assert best, names                  # improvement gate still fires
+    # the rolling slot is resumable
+    tree, name, _ = S.resolve_auto(str(tmp_path))
+    assert "_current-" in name or "=" in name
+    wf2 = make_wf("IntervalResume", max_epochs=3)
+    wf2.restore_state(tree)
+    wf2.run()
+    assert wf2.decision.epoch_number == 3
+
+
+def test_interval_failure_waits_full_interval_to_retry(tmp_path):
+    """A transient store outage must not burn the 3-strike failure
+    budget in back-to-back unit boundaries: the wall-clock gate
+    re-arms BEFORE the attempt, so a failed interval write retries
+    one interval later, not at the very next run()."""
+    wf = make_wf("RetryWf", snapdir=str(tmp_path))
+    snap = wf.snapshotter
+    snap.interval = 3600.0            # no second attempt inside test
+    snap._last_write -= 7200.0        # gate open NOW
+    def broken_stream(name):
+        raise OSError("store down")
+    snap.store.stream = broken_stream
+    assert not bool(getattr(wf.decision, "improved", False))
+    for _ in range(5):                # 5 unit boundaries, 1 outage
+        snap.run()
+    assert snap._store_failures == 1, snap._store_failures
+
+
+def test_retention_rebuilt_from_store_after_restart(tmp_path):
+    """Satellite: ``_written`` used to be in-memory only, so a resumed
+    process never pruned its predecessor's snapshots. A fresh
+    snapshotter over the same store must adopt and keep pruning."""
+    wf = make_wf("RetA", snapdir=str(tmp_path))
+    snap = wf.snapshotter
+    for i in range(3):
+        wf.decision.best_metric = 0.5 - 0.1 * i
+        snap.export_snapshot()
+        snap.export_snapshot(slot="current")
+    store = S.FileSnapshotStore(str(tmp_path))
+    assert len([n for n in store.list() if "_current-" in n]) == 2
+
+    # "restart": a fresh workflow + snapshotter over the same store
+    wf2 = make_wf("RetA", snapdir=str(tmp_path))
+    snap2 = wf2.snapshotter
+    assert snap2._written, "retention forgot pre-restart snapshots"
+    for i in range(3):
+        wf2.decision.best_metric = 0.1 - 0.01 * i
+        snap2.export_snapshot()
+        snap2.export_snapshot(slot="current")
+    names = store.list()
+    best = [n for n in names if "_current-" not in n]
+    current = [n for n in names if "_current-" in n]
+    assert len(best) <= snap2.keep, names
+    assert len(current) <= snap2.keep_interval, names
+    # the rolling sequence continued rather than restarting at 1
+    assert any("_current-0000000%d." % i in n
+               for n in current for i in (5, 6)), names
+
+
+def test_checkpoint_telemetry_recorded(tmp_path):
+    store = S.FileSnapshotStore(str(tmp_path))
+    S.write_checkpoint(store, "wf_x.ckpt.npz.gz", _mini_tree(1),
+                       slot="best")
+    S.write_checkpoint(store, "wf_current-00000001.ckpt.npz.gz",
+                       _mini_tree(2), slot="current")
+    reg = telemetry.get_registry()
+    assert reg.counter_total("veles_checkpoint_writes_total",
+                             slot="best") == 1
+    assert reg.counter_total("veles_checkpoint_writes_total",
+                             slot="current") == 1
+    assert reg.counter_total("veles_checkpoint_bytes_total") > 0
+    hist = reg.histogram("veles_checkpoint_write_seconds",
+                         labels=("slot",)).labels("best")
+    assert hist.count == 1
+    age = reg.gauge("veles_checkpoint_last_success_age_seconds").value
+    assert 0.0 <= age < 60.0
+    # and it renders as a scrape-able exposition
+    text = reg.render_prometheus()
+    assert "veles_checkpoint_writes_total" in text
+    assert "veles_checkpoint_last_success_age_seconds" in text
+
+
+# -- rollback round-trip (satellite) -----------------------------------
+
+
+def test_rollback_state_survives_checkpoint_resume(tmp_path):
+    """NNRollback history (rollback count, best loss) and the lr cuts
+    it applied must survive a FULL checkpoint+resume cycle into a
+    fresh process-like workflow — not just a same-process restore."""
+    wf = make_wf("RbSrc", snapdir=str(tmp_path))
+    rb = wf.link_rollback()
+    rb.rollback_count = 2
+    rb._best_loss = 0.321
+    for gd in wf.gds:
+        gd.lr_scale = 0.25
+    path = wf.snapshotter.export_snapshot()
+    assert path
+
+    wf2 = make_wf("RbDst", max_epochs=3)
+    rb2 = wf2.link_rollback()
+    wf2.restore_state(S.load_snapshot(path))
+    assert rb2.rollback_count == 2
+    assert abs(rb2._best_loss - 0.321) < 1e-12
+    assert all(gd.lr_scale == 0.25 for gd in wf2.gds)
+    state = rb2.get_state()
+    assert state == {"rollback_count": 2, "best_loss": 0.321}
+    wf2.run()                     # and the resumed run still trains
+    assert wf2.decision.epoch_number == 3
+
+
+# -- generic workflow checkpoint fallback ------------------------------
+
+
+def test_plain_workflow_checkpoint_state():
+    from veles.units import Unit
+    from veles.workflow import Workflow
+
+    class Counting(Unit):
+        def __init__(self, workflow, **kw):
+            super().__init__(workflow, **kw)
+            self.count = 0
+
+        def run(self):
+            self.count += 1
+
+        def get_state(self):
+            return {"count": self.count}
+
+        def set_state(self, state):
+            self.count = int(state["count"])
+
+    wf = Workflow(None, name="PlainWf")
+    unit = Counting(wf, name="counting")
+    unit.count = 7
+    tree = wf.checkpoint_state()
+    assert tree["units"]["counting"] == {"count": 7}
+
+    wf2 = Workflow(None, name="PlainWf2")
+    unit2 = Counting(wf2, name="counting")
+    wf2.restore_state(tree)
+    assert unit2.count == 7
+    # unknown units in the tree are skipped, not fatal
+    tree["units"]["ghost"] = {"count": 1}
+    wf2.restore_state(tree)
+
+
+# -- checkpoints CLI audit (satellite) ---------------------------------
+
+
+def test_checkpoints_cli_audit(tmp_path, capsys):
+    from veles.__main__ import checkpoints_main
+    store = S.FileSnapshotStore(str(tmp_path))
+    S.write_checkpoint(store, "wf_=0.2.ckpt.npz.gz", _mini_tree(1))
+    buf = io.BytesIO()
+    numpy.savez(buf, **S._flatten_tree(_mini_tree(0)))
+    store.put("wf_old.ckpt.npz.gz", gzip.compress(buf.getvalue()))
+    assert checkpoints_main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "valid" in out and "legacy" in out
+
+    S.write_checkpoint(store, "wf_current-00000009.ckpt.npz.gz",
+                       _mini_tree(2))
+    corrupt_store_entry(store, "wf_current-00000009.ckpt.npz.gz",
+                        "truncate")
+    assert checkpoints_main(["--json", str(tmp_path)]) == 1
+    rows = json.loads(capsys.readouterr().out)
+    assert {r["status"] for r in rows} == {"valid", "legacy",
+                                           "corrupt"}
+    corrupt = [r for r in rows if r["status"] == "corrupt"][0]
+    assert corrupt["error"]
+
+
+# -- SIGTERM preemption end to end -------------------------------------
+
+
+def test_sigterm_preemption_and_auto_resume(tmp_path):
+    """Drive the real CLI: SIGTERM mid-run stops at a unit boundary,
+    writes a final checkpoint, exits EXIT_PREEMPTED; a second run with
+    ``--snapshot auto`` resumes from the store and completes."""
+    from veles.launcher import EXIT_PREEMPTED
+    snapdir = tmp_path / "snaps"
+    result = tmp_path / "result.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    base = [sys.executable, "-m", "veles",
+            os.path.join(REPO, "veles/znicz_tpu/models/mnist.py"),
+            "--seed", "7", "-d", "numpy", "--no-stats",
+            "--snapshots", str(snapdir),
+            "root.mnist.loader.n_train=2000",
+            "root.mnist.loader.n_valid=200",
+            "root.mnist.loader.minibatch_size=50"]
+    proc = subprocess.Popen(
+        base + ["--checkpoint-every", "0.2",
+                "root.mnist.decision.max_epochs=500"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if snapdir.is_dir() and any(
+                    "_current-" in n for n in os.listdir(str(snapdir))):
+                break
+            if proc.poll() is not None:
+                pytest.fail("run ended before any interval checkpoint:"
+                            " %s" % proc.stderr.read()[-2000:])
+            time.sleep(0.05)
+        else:
+            pytest.fail("no interval checkpoint appeared")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        proc.kill()
+    assert rc == EXIT_PREEMPTED, proc.stderr.read()[-2000:]
+    infos = S.scan_checkpoints(str(snapdir))
+    assert any(i.status == "valid" for i in infos), infos
+
+    out = subprocess.run(
+        base + ["--snapshot", "auto", "--result-file", str(result),
+                "root.mnist.decision.max_epochs=1"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    data = json.loads(result.read_text())
+    assert data["history"], data

@@ -15,12 +15,17 @@ Re-design of ``velescli.py`` = ``veles/__main__.py`` [U] (SURVEY.md
   ``--workflow-graph`` dumps graphviz, ``--result-file`` writes the
   run's metric history as JSON.
 
-One subcommand lives OUTSIDE the workflow shape:
+Two subcommands live OUTSIDE the workflow shape:
 
     python -m veles serve --model NAME=ARCHIVE_DIR [...]
 
 starts the batched online-inference frontend (``veles/serving/``) over
-``export_inference`` artifacts — see ``velescli.py serve --help``.
+``export_inference`` artifacts — see ``velescli.py serve --help``;
+
+    python -m veles checkpoints <dir-or-url>
+
+audits a snapshot store (manifest verification: valid / corrupt /
+legacy per blob) before an operator trusts it with ``--snapshot auto``.
 """
 
 import argparse
@@ -48,11 +53,29 @@ def build_argparser():
     p.add_argument("--seed", type=int, default=None,
                    help="master seed for every PRNG")
     p.add_argument("--snapshot", default=None,
-                   help="checkpoint file to resume from")
+                   help="checkpoint to resume from: a file/URI, "
+                        "'auto' (newest manifest-verified checkpoint "
+                        "in the --snapshots store, falling back past "
+                        "corrupt ones), or 'auto:TARGET' to scan an "
+                        "explicit directory/URL")
     p.add_argument("--snapshots", default=None, metavar="DIR",
                    help="write improved-gated checkpoints to DIR "
                         "(links a Snapshotter when the workflow has "
                         "none)")
+    p.add_argument("--checkpoint-every", type=float, default=None,
+                   metavar="SECS",
+                   help="also write rolling 'current' checkpoints at "
+                        "the first unit boundary after every SECS "
+                        "seconds (preemption bound); in master mode, "
+                        "persist the master's aggregated state + job "
+                        "journal at this cadence")
+    p.add_argument("--slave-retries", type=int, default=None,
+                   metavar="N",
+                   help="slave mode: give up after N consecutive "
+                        "failed reconnect attempts (0 = retry "
+                        "forever; default 8). Use 0 when the master "
+                        "is preemptible — its restart takes longer "
+                        "than the default budget")
     p.add_argument("--listen-address", default=None,
                    help="host:port -> run as distribution master")
     p.add_argument("--master-address", default=None,
@@ -204,6 +227,10 @@ class Main:
 
     def _launch(self, **kwargs):
         args = self.args
+        slave_options = {}
+        if args.slave_retries is not None:
+            slave_options["max_retries"] = \
+                None if args.slave_retries == 0 else args.slave_retries
         self.launcher = Launcher(
             device=args.device, snapshot=args.snapshot,
             stats=not args.no_stats,
@@ -212,7 +239,9 @@ class Main:
             graphics_dir=args.graphics_dir,
             web_status_port=args.web_status,
             profile_dir=args.profile_dir,
-            slave_timeout=args.slave_timeout)
+            slave_timeout=args.slave_timeout,
+            slave_options=slave_options,
+            checkpoint_every=args.checkpoint_every)
         if args.graphics_dir and not getattr(
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):
@@ -220,7 +249,9 @@ class Main:
         if args.snapshots and getattr(
                 self.workflow, "snapshotter", None) is None \
                 and hasattr(self.workflow, "link_snapshotter"):
-            self.workflow.link_snapshotter(directory=args.snapshots)
+            self.workflow.link_snapshotter(
+                directory=args.snapshots,
+                interval=args.checkpoint_every)
         self.launcher.initialize(self.workflow, **kwargs)
         if args.dump_unit_sizes:
             self.workflow.print_unit_sizes(sys.stderr)
@@ -462,6 +493,65 @@ def daemonize(log_file=None):
     return True
 
 
+def checkpoints_main(argv):
+    """``velescli checkpoints <store>``: audit a snapshot store before
+    resuming — every blob with its manifest verdict (valid / corrupt /
+    legacy), age, slot and schema. Exit code 1 when any checkpoint is
+    corrupt (scriptable pre-resume gate), 0 otherwise."""
+    import time as _time
+    from veles.snapshotter import scan_checkpoints
+    p = argparse.ArgumentParser(
+        prog="velescli checkpoints",
+        description="List checkpoints in a store with their manifest "
+                    "verification status")
+    p.add_argument("store",
+                   help="snapshot directory or http(s) base URL")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    args = p.parse_args(argv)
+    from http.client import HTTPException
+    try:
+        infos = scan_checkpoints(args.store)
+    except (OSError, HTTPException, ValueError) as exc:
+        # missing directory, unreachable/garbled HTTP endpoint
+        # (ValueError covers json/unicode decode errors from a
+        # non-store answering the listing): a DOWN store must exit
+        # distinctly (2) — never 1, which the gate contract reserves
+        # for "store holds a corrupt checkpoint", and never a
+        # traceback
+        print("error: %s: %s" % (type(exc).__name__, exc),
+              file=sys.stderr)
+        return 2
+    rows = []
+    for info in infos:
+        m = info.manifest or {}
+        age = None
+        if info.wall_time:
+            age = round(_time.time() - info.wall_time, 1)
+        rows.append({"name": info.name, "status": info.status,
+                     "slot": m.get("slot"), "schema": m.get("schema"),
+                     "age_s": age, "error": info.error})
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print("%-8s %-9s %-7s %12s  %s"
+              % ("STATUS", "SLOT", "SCHEMA", "AGE(s)", "NAME"))
+        for r in rows:
+            print("%-8s %-9s %-7s %12s  %s"
+                  % (r["status"], r["slot"] or "-",
+                     r["schema"] if r["schema"] is not None else "-",
+                     r["age_s"] if r["age_s"] is not None else "-",
+                     r["name"]))
+            if r["error"]:
+                print("         !! %s" % r["error"])
+        print("%d checkpoint(s): %d valid, %d legacy, %d corrupt"
+              % (len(rows),
+                 sum(r["status"] == "valid" for r in rows),
+                 sum(r["status"] == "legacy" for r in rows),
+                 sum(r["status"] == "corrupt" for r in rows)))
+    return 1 if any(r["status"] == "corrupt" for r in rows) else 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
@@ -470,6 +560,10 @@ def main(argv=None):
         # batched HTTP frontend
         from veles.serving.frontend import serve_main
         return serve_main(argv[1:])
+    if argv and argv[0] == "checkpoints":
+        # store audit: list checkpoints + manifest status so an
+        # operator can vet a store before --snapshot auto trusts it
+        return checkpoints_main(argv[1:])
     m = Main(argv)
     if getattr(m.args, "background", False):
         if not daemonize(m.args.log_file):

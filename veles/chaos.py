@@ -35,6 +35,47 @@ import time
 from veles.logger import Logger
 from veles.server import _recv_exact
 
+
+# -- checkpoint/blob corruption (the disk-side fault models) -----------
+
+
+def truncate_blob(blob, frac=0.5):
+    """The mid-write host death: keep the leading ``frac`` of the
+    bytes (at least 1). A gzip/npz cut anywhere in the middle must
+    read back as :class:`~veles.snapshotter.CorruptCheckpointError`,
+    never as a shorter-but-plausible checkpoint."""
+    return bytes(blob[:max(1, int(len(blob) * frac))])
+
+
+def flip_bit(blob, index=None, bit=0, seed=0):
+    """The bit-rot fault: flip ONE bit, deterministically (seeded
+    offset by default, exact ``index`` when given), so manifest
+    verification — not compression luck — is what catches it."""
+    data = bytearray(blob)
+    if index is None:
+        # stay away from the very start: corrupting the magic bytes
+        # tests the container parser, not the sha256 manifest
+        index = random.Random(seed).randrange(len(data) // 4,
+                                              len(data))
+    data[index] ^= 1 << (bit & 7)
+    return bytes(data)
+
+
+def corrupt_store_entry(store, name, mode="truncate", **kwargs):
+    """Damage a stored checkpoint IN PLACE through the store's own
+    put/get (works for any SnapshotStore backend): ``mode`` is
+    ``truncate`` or ``bitflip``."""
+    raw = store.get(name)
+    if mode == "truncate":
+        damaged = truncate_blob(raw, **kwargs)
+    elif mode == "bitflip":
+        damaged = flip_bit(raw, **kwargs)
+    else:
+        raise ValueError("mode must be truncate|bitflip, not %r"
+                         % (mode,))
+    store.put(name, damaged)
+    return damaged
+
 PASS = "pass"
 DROP = "drop"
 DUP = "dup"

@@ -88,12 +88,19 @@ class SlaveClient(Logger):
         self.io_timeout = float(io_timeout)
         #: reconnect policy: sleep retry_base·2^k (capped at
         #: retry_max, +0..25 % jitter so a restarted master isn't
-        #: stampeded) for up to max_retries consecutive failures
+        #: stampeded) for up to max_retries consecutive failures.
+        #: ``None`` retries FOREVER — the right setting under a
+        #: preemptible master (k8s reschedule takes minutes; a slave
+        #: that gives up turns every master restart into lost capacity)
         self.retry_base = float(retry_base)
         self.retry_max = float(retry_max)
-        self.max_retries = int(max_retries)
+        self.max_retries = None if max_retries is None \
+            else int(max_retries)
         #: heartbeat period while the master says ("wait",)
         self.ping_interval = float(ping_interval)
+        #: preemption stop: request_stop() makes run_forever return
+        #: after the in-flight job instead of requesting another
+        self._stop = threading.Event()
         #: robustness counters (mirrors MasterServer.faults)
         self.reconnects = 0
         self.stale_resyncs = 0
@@ -287,17 +294,23 @@ class SlaveClient(Logger):
             self.sock = None
 
     def _backoff(self, attempt):
+        # clamp the exponent: with max_retries=None attempt grows
+        # without bound, and 2**1030 no longer converts to float —
+        # retry_max caps the delay long before 2**32 anyway
         delay = min(self.retry_max,
-                    self.retry_base * (2 ** max(0, attempt - 1)))
+                    self.retry_base * (2.0 ** min(32, max(0, attempt - 1))))
         return delay * (1.0 + 0.25 * random.random())
 
     def run_forever(self):
         """Pump jobs until the master says ``bye``, surviving master
         restarts, revoked leases and connection hiccups: reconnect +
         re-hello with exponential backoff, giving up only after
-        ``max_retries`` consecutive failures without progress."""
+        ``max_retries`` consecutive failures without progress.
+        :meth:`request_stop` (the Launcher's SIGTERM relay) breaks the
+        loop at the next job boundary — a preempted slave exits
+        cleanly instead of pulling jobs for the whole grace period."""
         attempt = 0
-        while True:
+        while not self._stop.is_set():
             try:
                 if self.sock is None:
                     self.connect()
@@ -315,15 +328,17 @@ class SlaveClient(Logger):
                 # the same consecutive-failure budget guarding against
                 # a master that fences or drops us forever.
                 attempt += 1
-                if attempt > self.max_retries:
+                if self.max_retries is not None \
+                        and attempt > self.max_retries:
                     self._close_sock()
                     raise ConnectionError(
                         "giving up on master %s:%d after %d failed "
                         "attempts (last: %s)"
                         % (self.address + (attempt - 1, exc)))
                 self.warning(
-                    "%s: %s; re-sync %d/%d", type(exc).__name__, exc,
-                    attempt, self.max_retries)
+                    "%s: %s; re-sync %d/%s", type(exc).__name__, exc,
+                    attempt, "inf" if self.max_retries is None
+                    else self.max_retries)
                 self._resync(attempt)
         self._close_sock()
         self.info("slave done after %d jobs (%d reconnects, %d stale "
@@ -331,9 +346,18 @@ class SlaveClient(Logger):
                   self.stale_resyncs)
         return self.jobs_done
 
+    def request_stop(self):
+        """Preemption (Launcher SIGTERM): finish the in-flight job,
+        then return from run_forever instead of requesting another —
+        the master requeues anything unmerged when the connection
+        drops. Signal-safe: one Event.set, no locks, no I/O."""
+        self._stop.set()
+
     def _resync(self, attempt):
         self._close_sock()
         self.slave_id = self.lease_id = None
         self.reconnects += 1
         self._tele["reconnects"].get().inc()
-        time.sleep(self._backoff(attempt))
+        # interruptible backoff: a preempted slave must exit now, not
+        # after its reconnect sleep runs out
+        self._stop.wait(self._backoff(attempt))

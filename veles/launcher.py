@@ -22,6 +22,12 @@ import sys
 
 from veles.logger import Logger
 
+#: process exit code after a SIGTERM-driven preemption shutdown (the
+#: k8s/TPU-maintenance kill path): distinct from both success and
+#: crash so a supervisor can tell "reschedule me, I checkpointed" from
+#: "I failed". 75 = BSD EX_TEMPFAIL ("temporary failure, retry").
+EXIT_PREEMPTED = 75
+
 
 class Launcher(Logger):
     """Drives one workflow run."""
@@ -30,7 +36,7 @@ class Launcher(Logger):
                  listen_address=None, master_address=None,
                  graphics_dir=None, web_status_port=None,
                  profile_dir=None, slave_timeout=None,
-                 slave_options=None):
+                 slave_options=None, checkpoint_every=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
@@ -44,8 +50,19 @@ class Launcher(Logger):
         #: slave mode: SlaveClient fault-tolerance kwargs
         #: (io_timeout, retry_base, retry_max, max_retries, ...)
         self.slave_options = dict(slave_options or {})
+        #: wall-clock checkpoint cadence (seconds): wires the
+        #: snapshotter's rolling ``current`` slot in standalone mode
+        #: and the master's state-persist loop in master mode
+        self.checkpoint_every = checkpoint_every
         self.workflow = None
         self.interrupted = False
+        #: True once SIGTERM asked for a preemption shutdown: the run
+        #: stops at the next unit boundary, a final checkpoint is
+        #: written, and run() exits the process with EXIT_PREEMPTED
+        self.preempted = False
+        self.master_server = None
+        self.slave_client = None
+        self._master_resume = None
         #: directory for a jax.profiler trace of the run (XLA op/HLO
         #: timeline, viewable in TensorBoard/Perfetto) — the kernel-
         #: level complement to the per-unit wall times (SURVEY.md §5.1
@@ -76,11 +93,26 @@ class Launcher(Logger):
         # master [U])
         device = "numpy" if self.mode == "master" else self.device_spec
         workflow.initialize(device=device, **kwargs)
+        snap = getattr(workflow, "snapshotter", None)
+        if snap is not None and self.checkpoint_every \
+                and not snap.interval:
+            snap.interval = float(self.checkpoint_every)
+            # the improvement-only graph gate would keep run() from
+            # ever seeing the wall clock: open it, the unit gates
+            # internally (see SnapshotterBase.run)
+            from veles.mutable import Bool
+            snap.gate_skip = Bool(False)
+        elif snap is None and self.checkpoint_every \
+                and self.mode == "standalone":
+            # a silently-unwired cadence is the worst failure mode: the
+            # operator believes the job is preemption-safe until the
+            # SIGKILL hours later proves otherwise
+            self.warning(
+                "--checkpoint-every %.6g has no snapshotter to drive "
+                "(pass --snapshots DIR or link one) — NO interval "
+                "checkpoints will be written", self.checkpoint_every)
         if self.snapshot:
-            from veles.snapshotter import load_snapshot
-            state = load_snapshot(self.snapshot)
-            workflow.restore_state(state)
-            self.info("resumed from %s", self.snapshot)
+            self._restore_snapshot(workflow)
         if self.graphics_dir and self.mode != "slave":
             # master/standalone only, like the reference (plots render
             # in a separate process so they never block the run)
@@ -94,9 +126,71 @@ class Launcher(Logger):
                 workflow.name, workflow_status(workflow, self.mode))
         return workflow
 
+    # -- resume --------------------------------------------------------
+
+    def _checkpoint_base(self):
+        """Where this run's checkpoints live: an explicit
+        ``auto:<target>`` wins, else the workflow snapshotter's store."""
+        if self.snapshot and self.snapshot.startswith("auto:"):
+            from veles.snapshotter import store_for_base
+            # read-side semantics: auto:TARGET means "resume from
+            # here", so a mistyped path must raise, not be created
+            # empty and read as a fresh start
+            return store_for_base(self.snapshot[len("auto:"):],
+                                  create=False)
+        snap = getattr(self.workflow, "snapshotter", None)
+        return snap.store if snap is not None else None
+
+    def _restore_snapshot(self, workflow):
+        from veles.snapshotter import load_snapshot, resolve_auto
+        target = self.snapshot
+        if target == "auto" or target.startswith("auto:"):
+            base = self._checkpoint_base()
+            if base is None:
+                raise ValueError(
+                    "--snapshot auto needs a checkpoint location: "
+                    "pass --snapshots DIR (or --snapshot auto:TARGET) "
+                    "or configure a snapshotter")
+            # identity filter: a shared --snapshots directory can
+            # hold several workflows' checkpoints — only THIS run's
+            # prefixes (snapshotter prefix + workflow name, which is
+            # also the master persist slot's prefix) are candidates
+            snap = getattr(workflow, "snapshotter", None)
+            prefixes = {workflow.name}
+            if snap is not None:
+                prefixes.add(snap.prefix)
+            resolved = resolve_auto(base, logger=self,
+                                    prefixes=prefixes)
+            if resolved is None:
+                self.info("--snapshot auto: no verifiable checkpoint "
+                          "in the store — starting fresh")
+                return
+            state, name, corrupt = resolved
+            if corrupt:
+                # a corrupt blob's age is unreadable, so whether it
+                # OUTRANKED the chosen one is unknowable — report
+                # presence, don't claim a fallback happened
+                self.warning("--snapshot auto: store holds %d corrupt "
+                             "checkpoint(s); resuming %s", corrupt,
+                             name)
+            self._apply_state(workflow, state, name)
+        else:
+            self._apply_state(workflow, load_snapshot(target), target)
+
+    def _apply_state(self, workflow, state, origin):
+        if "master" in state and "workflow" in state:
+            # a master-persisted tree: the workflow part restores here,
+            # the job-queue/journal part waits for the MasterServer
+            self._master_resume = state["master"]
+            workflow.restore_state(state["workflow"])
+        else:
+            workflow.restore_state(state)
+        self.info("resumed from %s", origin)
+
     def run(self):
         wf = self.workflow
         previous = signal.getsignal(signal.SIGINT)
+        previous_term = signal.getsignal(signal.SIGTERM)
 
         def on_sigint(sig, frame):
             self.interrupted = True
@@ -104,10 +198,28 @@ class Launcher(Logger):
             wf.stop()
             signal.signal(signal.SIGINT, previous)
 
+        def on_sigterm(sig, frame):
+            # TPU/k8s preemption: stop at the next unit boundary,
+            # checkpoint, exit EXIT_PREEMPTED (handled after the run
+            # loop unwinds — never checkpoint from signal context)
+            self.preempted = True
+            self.warning("SIGTERM: preemption shutdown — stopping at "
+                         "the next unit boundary")
+            wf.stop()
+            if self.master_server is not None:
+                # signal-safe: the serving thread persists the final
+                # journal on its way out
+                self.master_server.request_stop()
+            if self.slave_client is not None:
+                # wf.stop() means nothing to a slave (the client
+                # drives units directly): stop the job pump itself
+                self.slave_client.request_stop()
+
         try:
             signal.signal(signal.SIGINT, on_sigint)
+            signal.signal(signal.SIGTERM, on_sigterm)
         except ValueError:          # not on the main thread
-            previous = None
+            previous = previous_term = None
         import contextlib
         prof = contextlib.nullcontext()
         if self.profile_dir:
@@ -130,6 +242,8 @@ class Launcher(Logger):
         finally:
             if previous is not None:
                 signal.signal(signal.SIGINT, previous)
+            if previous_term is not None:
+                signal.signal(signal.SIGTERM, previous_term)
             if self.graphics is not None:
                 self.graphics.close()
             if self.web_status is not None:
@@ -137,9 +251,25 @@ class Launcher(Logger):
                 # fleet dashboard is a standalone WebStatus that
                 # launchers POST to via /update)
                 self.web_status.close()
+        if self.preempted:
+            self._preemption_exit()
         if self.stats:
             wf.print_stats(sys.stderr)
         return wf
+
+    def _preemption_exit(self):
+        """Final checkpoint + distinct exit code after a SIGTERM stop.
+        The master persists on its serving thread's way out; a SLAVE
+        must never snapshot — its mid-sync replica state written into
+        a shared store would outrank the master's own checkpoints on
+        the next --snapshot auto. Only standalone runs write here."""
+        snap = getattr(self.workflow, "snapshotter", None)
+        if self.mode == "standalone" and snap is not None:
+            path = snap.preempt_snapshot()
+            if path:
+                self.info("preemption checkpoint -> %s", path)
+        self.warning("preempted: exiting with code %d", EXIT_PREEMPTED)
+        raise SystemExit(EXIT_PREEMPTED)
 
     # -- distributed modes --------------------------------------------
 
@@ -147,9 +277,25 @@ class Launcher(Logger):
         from veles.server import MasterServer
         kwargs = {} if self.slave_timeout is None \
             else {"slave_timeout": self.slave_timeout}
+        store = self._checkpoint_base()
+        if store is None and self.checkpoint_every:
+            self.warning(
+                "--checkpoint-every %.6g: no checkpoint store "
+                "resolves (pass --snapshots DIR) — master state will "
+                "NOT be persisted and a restart cannot recover",
+                self.checkpoint_every)
         server = MasterServer(self.workflow, self.listen_address,
+                              checkpoint_store=store,
+                              checkpoint_every=self.checkpoint_every,
+                              resume_state=self._master_resume,
                               **kwargs)
         self.master_server = server
+        if self.preempted:
+            # SIGTERM landed while MasterServer.__init__ was still
+            # rebuilding its persist slot (a slow store makes that
+            # window real): the handler saw master_server=None, so
+            # relay the stop here or serve_forever runs to max_epochs
+            server.request_stop()
         if self.web_status is not None:
             # cluster topology on the dashboard: connected slaves and
             # their job counts straight from the server registry
@@ -161,6 +307,10 @@ class Launcher(Logger):
         client = SlaveClient(self.workflow, self.master_address,
                              **self.slave_options)
         self.slave_client = client
+        if self.preempted:
+            # SIGTERM landed before the client existed: same relay
+            # race as the master branch above
+            client.request_stop()
         client.run_forever()
 
 

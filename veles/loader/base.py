@@ -348,15 +348,22 @@ class Loader(Unit, IDistributable):
         self._inflight.setdefault(slave, []).append(job)
         return job
 
+    def _ensure_dist_prng(self):
+        """The master-side shuffle stream, created on first use — ONE
+        place owns the derivation, so epoch start and master-restart
+        restore (server.py) can never drift apart."""
+        if not hasattr(self, "_dist_prng"):
+            from veles.prng import RandomGenerator
+            self._dist_prng = RandomGenerator(
+                "%s.dist" % self.name, self.prng.state_seed + 0x9E3779B9)
+        return self._dist_prng
+
     def master_start_epoch(self):
         """Master side: (re)fill the job queue for one epoch. Uses a
         dedicated generator derived from the loader seed, so master-mode
         shuffles never desynchronize the local serving PRNG (fixed-seed
         reproducibility contract)."""
-        if not hasattr(self, "_dist_prng"):
-            from veles.prng import RandomGenerator
-            self._dist_prng = RandomGenerator(
-                "%s.dist" % self.name, self.prng.state_seed + 0x9E3779B9)
+        self._ensure_dist_prng()
         mb = self.max_minibatch_size
         for cls in (CLASS_TEST, CLASS_VALID, CLASS_TRAIN):
             if self.class_lengths[cls] == 0:

@@ -41,6 +41,7 @@ Fault tolerance (the elastic story under IMPOLITE failure):
 
 import hashlib
 import hmac
+import json
 import os
 import pickle
 import secrets
@@ -196,12 +197,24 @@ def framed_server(address, handle_request, done_event, on_drop,
 #: overrides this with hours.
 DEFAULT_SLAVE_TIMEOUT = 60.0
 
+#: how long a COMPLETED master keeps its listener up answering
+#: ``("bye",)`` before tearing it down. A slave mid-compute or
+#: mid-reconnect-backoff when the run finishes misses the in-band
+#: goodbye; with ``max_retries=None`` (the preemptible-master
+#: setting) it would then retry a dead address forever. 5s covers the
+#: default reconnect cycle (retry_max 2.0 × 1.25 jitter) and several
+#: 1s heartbeat periods.
+DEFAULT_DRAIN_TIMEOUT = 5.0
+
 
 class MasterServer(Logger):
     """Owns canonical weights + the job queue; never computes."""
 
     def __init__(self, workflow, address, max_epochs=None,
-                 slave_timeout=DEFAULT_SLAVE_TIMEOUT):
+                 slave_timeout=DEFAULT_SLAVE_TIMEOUT,
+                 checkpoint_store=None, checkpoint_every=None,
+                 resume_state=None,
+                 drain_timeout=DEFAULT_DRAIN_TIMEOUT):
         self.name = "MasterServer"
         self.workflow = workflow
         host, _, port = str(address).rpartition(":")
@@ -213,6 +226,24 @@ class MasterServer(Logger):
         self._next_slave = 1
         self._next_job = 1
         self.epoch = 0
+        #: durability: aggregated workflow state + the job journal are
+        #: periodically persisted through this SnapshotStore, so a
+        #: SIGKILLed master restarted with ``--snapshot auto`` rebuilds
+        #: mid-run instead of being a single point of failure
+        self.checkpoint_store = checkpoint_store
+        self.checkpoint_every = None if not checkpoint_every \
+            else float(checkpoint_every)
+        self.drain_timeout = float(drain_timeout or 0.0)
+        self._persist_lock = threading.Lock()
+        self._persist_event = threading.Event()
+        self._persist_slot = None
+        self.persist_count = 0
+        if checkpoint_store is not None:
+            from veles.snapshotter import RollingSlot
+            self._persist_slot = RollingSlot(
+                checkpoint_store, workflow.name, marker="master",
+                keep=2)
+            self._persist_slot.rebuild(logger=self)
         #: finite by default — ``None``/0 disables the bound and
         #: restores the documented stranded-handler hazard, so only
         #: opt into that knowingly
@@ -246,9 +277,169 @@ class MasterServer(Logger):
                 "master)")
         self.max_epochs = int(max_epochs)
         self.done = threading.Event()
+        #: set when serve_forever should stop — by done (run
+        #: complete) OR abort (preemption/kill: the run is NOT
+        #: complete, slaves must keep retrying for a restarted master
+        #: instead of being told "bye")
+        self._stop_serving = threading.Event()
         self._server = None
         loader = workflow.loader
-        loader.master_start_epoch()
+        if resume_state is not None:
+            self._restore_master_state(resume_state)
+        else:
+            loader.master_start_epoch()
+
+    # -- restart recovery ----------------------------------------------
+
+    def _restore_master_state(self, state):
+        """Rebuild the job queue + journal from a persisted master
+        checkpoint (the ``master`` section of the tree written by
+        :meth:`persist_state`); the workflow part was already restored
+        by the caller (Launcher ``--snapshot auto``). Pre-restart
+        leases are NOT restored: reconnecting slaves re-hello against
+        the fresh lease table and any zombie frame is fenced."""
+        loader = self.workflow.loader
+        self.epoch = int(state.get("epoch", 0))
+        self._next_job = int(state.get("next_job", 1))
+        self._next_slave = int(state.get("next_slave", 1))
+        for kind, count in (state.get("faults") or {}).items():
+            if kind in self.faults:
+                self.faults[kind] = int(count)
+        loader._pending_jobs = [
+            (int(cls), [int(i) for i in idx])
+            for cls, idx in state.get("pending", [])]
+        loader._inflight = {}
+        dist_prng = state.get("dist_prng")
+        if dist_prng:
+            # the master-side shuffle stream must CONTINUE, not
+            # restart, or post-restart epochs repeat pre-restart
+            # minibatch orders (the loader owns the derivation)
+            gen = loader._ensure_dist_prng()
+            gen._gen.bit_generator.state = json.loads(dist_prng)
+        tele = state.get("tele")
+        if tele:
+            # re-adopt the per-token absorb baselines: slaves push
+            # ABSOLUTE counter state, so a master that forgot the
+            # baselines would re-absorb each slave's full history
+            now = time.monotonic()
+            self._tele_states = {
+                token: ({(name, tuple(tuple(i) for i in items)): v
+                         for name, items, v in entries}, now)
+                for token, entries in json.loads(tele)}
+        if self.epoch >= self.max_epochs:
+            self.done.set()
+            self._stop_serving.set()
+        # an empty restored queue means epoch N was FULLY merged into
+        # the restored weights (checkpoint_state folds in-flight back
+        # into pending, so nothing can be outstanding): leave it empty
+        # — the first job poll goes through _advance_epoch, which
+        # increments the counter before refilling. Refilling here at
+        # the stale counter would replay a whole already-merged epoch.
+        self.info("restored master state: epoch %d, %d pending "
+                  "job(s), %d journal token(s)", self.epoch,
+                  len(loader._pending_jobs), len(self._tele_states))
+
+    def checkpoint_state(self):
+        """The persistable master tree: aggregated workflow state plus
+        the job journal (queue position, epoch, counters, telemetry
+        absorb baselines). In-flight jobs are folded back into pending
+        — they are served-but-unmerged at snapshot time, so a restart
+        re-serves them exactly once relative to the restored weights."""
+        with self.lock:
+            loader = self.workflow.loader
+            pending = []
+            for jobs in loader._inflight.values():
+                pending.extend(jobs)
+            pending.extend(loader._pending_jobs)
+            pending = [(int(cls), [int(i) for i in idx])
+                       for cls, idx in pending]
+            dist_prng = None
+            if hasattr(loader, "_dist_prng"):
+                dist_prng = json.dumps(
+                    loader._dist_prng._gen.bit_generator.state)
+            tele = json.dumps([
+                [token, [[name, list(items), value]
+                         for (name, items), value in state.items()]]
+                for token, (state, _) in self._tele_states.items()])
+            return {
+                "workflow": self.workflow.checkpoint_state(),
+                "master": {
+                    "epoch": self.epoch,
+                    "next_job": self._next_job,
+                    "next_slave": self._next_slave,
+                    "pending": pending,
+                    "faults": dict(self.faults),
+                    "dist_prng": dist_prng,
+                    "tele": tele,
+                },
+            }
+
+    def persist_state(self, reason=""):
+        """Write one master checkpoint through the snapshot store
+        (same machinery, same ``veles_checkpoint_*`` telemetry as the
+        Snapshotter unit; slot label ``master``); -> the URI or None
+        (no store / store failure — persistence must degrade, never
+        kill the cluster)."""
+        store = self.checkpoint_store   # kill() may null it mid-call
+        if store is None:
+            return None
+        from veles.snapshotter import write_checkpoint
+        with self._persist_lock:
+            try:
+                # checkpoint_state() is inside the guard too: a bad
+                # slave-pushed telemetry entry or a transient device
+                # error must degrade this persist, not kill the
+                # persist thread (silently ending all durability) or
+                # crash the shutdown path
+                tree = self.checkpoint_state()
+                name = self._persist_slot.next_name("gz")
+                uri, _ = write_checkpoint(
+                    store, name, tree, slot="master")
+            except Exception as exc:
+                self.warning("master state persist failed (%s): %s",
+                             reason or "periodic", exc)
+                return None
+            self._persist_slot.commit(name, logger=self)
+            self.persist_count += 1
+        self.debug("master state [%s] -> %s",
+                   reason or "periodic", uri)
+        return uri
+
+    def _persist_loop(self):
+        wait_s = self.checkpoint_every or 30.0
+        while True:
+            fired = self._persist_event.wait(wait_s)
+            if self._stop_serving.is_set():
+                return              # serve_forever writes the final one
+            if fired:
+                # clear only a CONFIRMED wakeup: clearing after a
+                # timed-out wait could discard a set() that landed in
+                # between, silently losing that epoch boundary's state
+                self._persist_event.clear()
+                self.persist_state()
+            elif self.checkpoint_every:
+                # explicit cadence: persist on the timer too. Without
+                # one, epoch boundaries only — a timed-out wait would
+                # re-serialize byte-identical state (stalling slaves
+                # under the request lock) every 30s the operator
+                # never asked for
+                self.persist_state()
+
+    def request_stop(self):
+        """Signal-safe preemption stop: just flip the stop event —
+        the serving thread's shutdown path writes the final persist,
+        so no store I/O or lock acquisition happens in signal context.
+        The run is NOT complete, so there is no drain and no ``bye``:
+        slaves see a dead socket and keep retrying for the restarted
+        master."""
+        self._stop_serving.set()
+
+    def kill(self):
+        """Test/chaos hook — die like SIGKILL: stop serving with NO
+        final persist, leaving only what the periodic loop already
+        wrote."""
+        self.checkpoint_store = None
+        self._stop_serving.set()
 
     # -- telemetry -----------------------------------------------------
 
@@ -422,8 +613,13 @@ class MasterServer(Logger):
         self.epoch += 1
         if self.epoch >= self.max_epochs:
             self.done.set()
+            self._stop_serving.set()
             return
         loader.master_start_epoch()
+        # epoch boundaries are the natural consistency points: wake
+        # the persist loop (writing here, under the request lock,
+        # would stall every slave for the store round-trip)
+        self._persist_event.set()
 
     def drop_slave(self, slave_id, clean=False):
         """Revoke ``slave_id``'s lease and requeue its in-flight
@@ -482,7 +678,36 @@ class MasterServer(Logger):
             self.bound_address = server.server_address
             threading.Thread(target=server.serve_forever,
                              args=(poll,), daemon=True).start()
-            self.done.wait()
+            if self.checkpoint_store is not None:
+                threading.Thread(target=self._persist_loop,
+                                 daemon=True,
+                                 name="master-persist").start()
+            # poll BOTH events: done may be set directly (tests, the
+            # drop-slave paths) without going through _advance_epoch
+            while not self._stop_serving.is_set() \
+                    and not self.done.is_set():
+                self._stop_serving.wait(0.05)
+            self._stop_serving.set()
+            # final persist — the ONLY one on the request_stop
+            # (SIGTERM preemption) path, and for a COMPLETED run it
+            # leaves the store reflecting epoch == max_epochs so a
+            # restart resumes straight to done instead of re-running
+            # the last epoch
+            self.persist_state("shutdown")
+            if self.done.is_set() and self.drain_timeout:
+                # completed runs only (an ABORTED master's slaves must
+                # keep retrying, never hear bye): hold the listener up
+                # so every straggler — mid-compute, mid-backoff — gets
+                # its ("bye",) instead of a dead address to retry
+                # forever under max_retries=None
+                # no early exit on "no slaves registered": the drain
+                # exists for exactly the slave the master CANNOT see —
+                # mid-backoff or not-yet-connected (the straggler
+                # test's contract) — so an empty lease table proves
+                # nothing and the full window must be held
+                deadline = time.monotonic() + self.drain_timeout
+                while time.monotonic() < deadline:
+                    time.sleep(poll)
             server.shutdown()
         return self
 

@@ -14,6 +14,29 @@ path — while keeping the reference's UX:
 * ``--snapshot file`` resume: load states into a freshly built
   workflow and continue.
 
+Durability layer (the reference's whole operational story rests on
+"kill the run anywhere, pick it up from disk" — SURVEY.md §2.7, §5.4):
+
+* **wall-clock gate**: ``interval=SECS`` writes rolling ``current``
+  checkpoints at unit boundaries alongside the improvement-gated
+  ``best`` ones, each slot with its own retention — a preempted job
+  loses at most ``interval`` seconds, not every epoch since the last
+  validation best;
+* **manifest**: every checkpoint embeds schema version, wall time,
+  config hash and a per-array sha256 — :func:`load_snapshot` verifies
+  on read, so a truncated or bit-flipped blob raises
+  :class:`CorruptCheckpointError` instead of resuming garbage;
+* **auto-resume**: :func:`resolve_auto` scans a store (file or HTTP),
+  picks the newest checkpoint whose manifest verifies and falls back
+  to the next-newest on corruption, counting every rejected blob in
+  ``veles_checkpoint_verify_failures_total``;
+* **crash-safe commit**: the file backend fsyncs the blob AND its
+  directory around the write-then-rename, so a host crash can never
+  commit a zero-length "checkpoint";
+* retention state is rebuilt from ``store.list()`` on initialize, so
+  a resumed run keeps pruning pre-restart snapshots instead of
+  growing the store without bound.
+
 Storage is PLUGGABLE (the reference's snapshotter had ODBC/S3-style
 alternate backends, SURVEY.md §2.7): :class:`SnapshotStore` is a tiny
 put/get/list/delete byte-blob contract, with
@@ -25,20 +48,35 @@ resumes straight from the remote store.
 
 import bz2
 import gzip
+import hashlib
 import io
 import json
 import lzma
 import os
+import re
 import threading
 import time
 
 import numpy
 
-from veles import prng
+from veles import prng, telemetry
 from veles.config import root
 from veles.units import Unit
 
 _OPENERS = {"": open, "gz": gzip.open, "bz2": bz2.open, "xz": lzma.open}
+
+#: bump when the checkpoint tree layout changes incompatibly
+SCHEMA_VERSION = 1
+
+#: npz entry holding the integrity manifest (JSON as uint8 bytes)
+MANIFEST_KEY = "__manifest__"
+
+
+class CorruptCheckpointError(Exception):
+    """The checkpoint failed verification: unreadable compression/npz
+    container, a manifest whose per-array digests don't match the
+    payload, or a missing/extra array. A resume must treat the blob as
+    absent (and fall back), never load it."""
 
 
 class _BufferedStream:
@@ -73,16 +111,44 @@ class _FileStream:
         return self._f
 
     def __exit__(self, et, ev, tb):
-        self._f.close()
-        if et is None:
-            os.replace(self.path + ".tmp", self.path)
-            self.uri = self.path
-        else:
+        committed = False
+        try:
             try:
-                os.remove(self.path + ".tmp")
-            except OSError:
-                pass
+                if et is None:
+                    # fsync BEFORE the rename: os.replace is atomic
+                    # against concurrent readers but not against power
+                    # loss — an unsynced rename can commit a zero-
+                    # length "checkpoint" that a resume would trust
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+            finally:
+                self._f.close()
+            if et is None:
+                os.replace(self.path + ".tmp", self.path)
+                self._fsync_dir()
+                self.uri = self.path
+                committed = True
+        finally:
+            if not committed:
+                try:
+                    os.remove(self.path + ".tmp")
+                except OSError:
+                    pass
         return False
+
+    def _fsync_dir(self):
+        # the rename itself lives in the directory entry; sync it too
+        # (best-effort: not every filesystem supports O_RDONLY dirs)
+        try:
+            fd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
 
 
 class SnapshotStore:
@@ -132,15 +198,24 @@ class FileSnapshotStore(SnapshotStore):
         return _FileStream(self, name)
 
     def get(self, name):
-        path = os.path.join(self.directory, name)
-        if not os.path.exists(path):
+        # open directly: an exists()-then-open pair would turn a blob
+        # pruned by a concurrent writer's retention into a "store
+        # down" FileNotFoundError instead of the KeyError the
+        # resume/audit paths treat as raced retention
+        try:
+            with open(os.path.join(self.directory, name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
             raise KeyError(name)
-        with open(path, "rb") as f:
-            return f.read()
 
     def list(self):
+        # exclude in-progress/orphaned .tmp writes: a SIGKILL mid-
+        # write leaves one behind, and surfacing it would make every
+        # audit report a phantom "corrupt" checkpoint and let a
+        # retention rebuild adopt (then delete) another writer's
+        # in-flight blob
         return sorted(n for n in os.listdir(self.directory)
-                      if ".ckpt." in n)
+                      if ".ckpt." in n and not n.endswith(".tmp"))
 
     def delete(self, name):
         try:
@@ -316,7 +391,7 @@ class HTTPSnapshotStore(SnapshotStore):
                 # base (another run's prefix): never surface foreign
                 # checkpoints as ours
                 continue
-            if ".ckpt." in n:
+            if ".ckpt." in n and not n.endswith(".tmp"):
                 out.append(n)
         if names and not out:
             # an endpoint whose every name got filtered probably
@@ -348,18 +423,436 @@ _STORE_CACHE = {}
 _STORE_CACHE_LOCK = threading.Lock()
 
 
+def _cached_http_store(base):
+    """ONE HTTPSnapshotStore per base URL, so every reader/writer of
+    an endpoint shares its circuit-breaker state."""
+    with _STORE_CACHE_LOCK:
+        store = _STORE_CACHE.get(base)
+        if store is None:
+            store = _STORE_CACHE[base] = HTTPSnapshotStore(base)
+    return store
+
+
 def store_for(target):
     """A store + name resolver for a snapshot TARGET: an http(s) URI
     maps to (a cached HTTPSnapshotStore(base), name); anything else is
     a local path handled by the file machinery."""
     if target.startswith(("http://", "https://")):
         base, _, name = target.rpartition("/")
-        with _STORE_CACHE_LOCK:
-            store = _STORE_CACHE.get(base)
-            if store is None:
-                store = _STORE_CACHE[base] = HTTPSnapshotStore(base)
-        return store, name
+        return _cached_http_store(base), name
     return None, target
+
+
+def store_for_base(target, create=True):
+    """A :class:`SnapshotStore` over a checkpoint LOCATION (not one
+    blob): an ``http(s)://`` base URL (breaker-shared via the same
+    cache as :func:`store_for`) or a local directory.
+
+    ``create=False`` is the READ-side contract (auto-resume, store
+    audit): a missing local directory raises FileNotFoundError instead
+    of being silently created — a typo'd ``--snapshot auto:PATH`` must
+    fail loudly, never read as "empty store, start fresh"."""
+    if isinstance(target, SnapshotStore):
+        return target
+    if target.startswith(("http://", "https://")):
+        return _cached_http_store(target.rstrip("/"))
+    if not create and not os.path.isdir(target):
+        raise FileNotFoundError(
+            "snapshot store directory %r does not exist — resuming or "
+            "auditing a store never creates it (check the path, or "
+            "mkdir it first)" % (target,))
+    return FileSnapshotStore(target)
+
+
+# -- integrity manifest ------------------------------------------------
+
+
+def config_fingerprint():
+    """sha256 over the effective ``root`` config (stable key order) —
+    stamped into every manifest so an operator can tell which config a
+    checkpoint was trained under; a mismatch on resume is WARNED, not
+    fatal (configs legitimately evolve between restarts)."""
+    try:
+        blob = json.dumps(root.to_dict(), sort_keys=True, default=str)
+    except Exception:
+        return None
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _array_digest(arr):
+    arr = numpy.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(repr(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def dump_checkpoint(tree, slot="best", extra_meta=None):
+    """State tree -> UNCOMPRESSED npz bytes with an embedded manifest
+    (schema version, wall time, config hash, per-array sha256)."""
+    flat = _flatten_tree(tree)
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "wall_time": time.time(),
+        "slot": slot,
+        "config_hash": config_fingerprint(),
+        "arrays": {k: _array_digest(v) for k, v in flat.items()},
+    }
+    if extra_meta:
+        manifest.update(extra_meta)
+    flat[MANIFEST_KEY] = numpy.frombuffer(
+        json.dumps(manifest).encode(), dtype=numpy.uint8)
+    blob = io.BytesIO()
+    numpy.savez(blob, **flat)
+    return blob.getvalue()
+
+
+def _verify_flat(flat, manifest, name):
+    digests = manifest.get("arrays")
+    if not isinstance(digests, dict):
+        raise CorruptCheckpointError(
+            "%s: manifest carries no array digests" % name)
+    if set(digests) != set(flat):
+        raise CorruptCheckpointError(
+            "%s: manifest names %d arrays, payload has %d (missing: %s"
+            " / extra: %s)" % (name, len(digests), len(flat),
+                               sorted(set(digests) - set(flat))[:3],
+                               sorted(set(flat) - set(digests))[:3]))
+    for key, digest in digests.items():
+        if _array_digest(flat[key]) != digest:
+            raise CorruptCheckpointError(
+                "%s: array %r fails its sha256 — bit rot or a torn "
+                "write" % (name, key))
+
+
+def parse_checkpoint(raw, name=""):
+    """Compressed checkpoint bytes -> ``(flat_arrays, manifest)``,
+    VERIFIED when a manifest is present (``manifest`` is None for
+    legacy pre-manifest blobs, which cannot be verified). Raises
+    :class:`CorruptCheckpointError` on any unreadable or
+    digest-mismatched payload."""
+    comp = _compression_of(name)
+    try:
+        data = raw if not comp else \
+            _OPENERS[comp](io.BytesIO(raw), "rb").read()
+        npz = numpy.load(io.BytesIO(data), allow_pickle=False)
+        flat = dict(npz)
+    except Exception as exc:
+        # truncated gzip (EOFError), a torn npz (zipfile errors),
+        # anything else mid-container: one fault class for resumes
+        raise CorruptCheckpointError(
+            "%s: unreadable checkpoint (%s: %s)"
+            % (name or "<bytes>", type(exc).__name__, exc)) from exc
+    manifest = None
+    if MANIFEST_KEY in flat:
+        try:
+            manifest = json.loads(bytes(flat.pop(MANIFEST_KEY)).decode())
+        except Exception as exc:
+            raise CorruptCheckpointError(
+                "%s: undecodable manifest (%s)" % (name, exc)) from exc
+        _verify_flat(flat, manifest, name or "<bytes>")
+    return flat, manifest
+
+
+def _compression_of(name):
+    base = os.path.basename(name)
+    for suffix in _OPENERS:
+        if suffix and base.endswith("." + suffix):
+            return suffix
+    return ""
+
+
+# -- checkpoint telemetry ----------------------------------------------
+
+_WRITE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
+                  60.0)
+_last_success = {"t": None}
+
+
+def _age_of_last_success():
+    t = _last_success["t"]
+    return -1.0 if t is None else max(0.0, time.time() - t)
+
+
+def _record_write(slot, nbytes, seconds):
+    telemetry.counter(
+        "veles_checkpoint_writes_total",
+        "Checkpoints committed to the store, by retention slot",
+        ("slot",)).labels(slot).inc()
+    telemetry.counter(
+        "veles_checkpoint_bytes_total",
+        "Bytes committed to the snapshot store").inc(nbytes)
+    telemetry.histogram(
+        "veles_checkpoint_write_seconds",
+        "Wall time of one checkpoint serialize+commit", ("slot",),
+        buckets=_WRITE_BUCKETS).labels(slot).observe(seconds)
+    _last_success["t"] = time.time()
+    telemetry.gauge(
+        "veles_checkpoint_last_success_age_seconds",
+        "Seconds since a checkpoint last committed (-1: never)"
+    ).set_function(_age_of_last_success)
+
+
+def _count_verify_failure():
+    telemetry.counter(
+        "veles_checkpoint_verify_failures_total",
+        "Corrupt checkpoints observed (once per blob per store "
+        "scan)").inc()
+
+
+class _CountingSink:
+    """Write-through wrapper counting the bytes actually handed to
+    the store — i.e. COMPRESSED size, which is what the bytes-written
+    telemetry and capacity dashboards care about."""
+
+    def __init__(self, sink):
+        self._sink = sink
+        self.nbytes = 0
+
+    def write(self, data):
+        self.nbytes += len(data)
+        return self._sink.write(data)
+
+    def flush(self):
+        flush = getattr(self._sink, "flush", None)
+        if flush is not None:
+            flush()
+
+
+def write_checkpoint(store, name, tree, compression="gz", slot="best",
+                     extra_meta=None):
+    """Serialize ``tree`` (manifest embedded) and commit it to
+    ``store`` under ``name`` -> ``(uri, nbytes)``. Telemetry is
+    recorded here so every writer (Snapshotter unit, master persist)
+    shares the same ``veles_checkpoint_*`` series."""
+    t0 = time.perf_counter()
+    data = dump_checkpoint(tree, slot=slot, extra_meta=extra_meta)
+    sp = store.stream(name)
+    with sp as sink:
+        counting = _CountingSink(sink)
+        if compression:
+            with _OPENERS[compression](counting, "wb") as f:
+                f.write(data)
+        else:
+            counting.write(data)
+    _record_write(slot, counting.nbytes, time.perf_counter() - t0)
+    return sp.uri, counting.nbytes
+
+
+#: any rolling-slot name (the snapshotter's ``current`` slot, the
+#: master's ``master`` slot): the improvement-gated "best" retention
+#: must never adopt these as metric-stamped snapshots
+_ROLLING_RE = re.compile(r"_(current|master)-\d+\.ckpt\.")
+
+#: what may follow ``<prefix>_`` in one of OUR checkpoint names: the
+#: improvement stamp, the pre-metric "initial" dump, or a rolling
+#: slot. Anything else under the prefix belongs to a sibling workflow
+#: whose name merely extends ours ("mnist" vs "mnist_big"): a bare
+#: startswith would adopt — and resume — its checkpoints
+_OWN_STAMP_RE = re.compile(
+    r"(?:=[^/]*?|initial|(?:current|master)-\d+)\.ckpt\.")
+
+
+def _under_prefix(name, prefixes):
+    return any(p and name.startswith(p + "_")
+               and _OWN_STAMP_RE.match(name[len(p) + 1:])
+               for p in prefixes)
+
+
+class RollingSlot:
+    """Rolling retention slot over sequence-named checkpoints
+    (``<prefix>_<marker>-NNNNNNNN.ckpt.npz[.gz]``): keeps the last
+    ``keep``, prunes the rest, and — crucially for restarts — can
+    rebuild its state from ``store.list()`` so a resumed process keeps
+    pruning the snapshots its predecessor wrote."""
+
+    def __init__(self, store, prefix, marker="current", keep=2):
+        self.store = store
+        self.prefix = prefix
+        self.marker = marker
+        self.keep = int(keep)
+        self._names = []
+        self._seq = 0
+        self._pattern = re.compile(
+            re.escape(prefix) + "_" + re.escape(marker)
+            + r"-(\d+)\.ckpt\.")
+
+    def rebuild(self, logger=None, names=None):
+        """Re-adopt this slot's names from the store (oldest first by
+        sequence number); -> how many were found. ``names`` lets a
+        caller that already listed the store share one listing."""
+        if names is None:
+            try:
+                names = self.store.list()
+            except Exception as exc:
+                # degrade, but never silently: with the rebuild
+                # skipped the sequence restarts at 0 (new writes
+                # shadow the predecessor's low numbers) and its
+                # high-sequence blobs escape retention until a later
+                # successful rebuild
+                if logger is not None:
+                    logger.warning(
+                        "%s-slot retention rebuild skipped: store "
+                        "list failed (%s)", self.marker, exc)
+                return 0
+        found = sorted((int(m.group(1)), n) for n in names
+                       for m in (self._pattern.match(n),) if m)
+        self._names = [n for _, n in found]
+        self._seq = found[-1][0] if found else 0
+        return len(found)
+
+    def next_name(self, compression="gz"):
+        self._seq += 1
+        return "%s_%s-%08d.ckpt.npz%s" % (
+            self.prefix, self.marker, self._seq,
+            "." + compression if compression else "")
+
+    def commit(self, name, logger=None):
+        """Record a committed write and prune past ``keep``; -> the
+        pruned names (delete failures are non-fatal: retention may
+        race a manual cleanup — but they are WARNED, since a store
+        whose deletes always fail grows one blob per write forever)."""
+        if name in self._names:
+            self._names.remove(name)
+        self._names.append(name)
+        pruned = []
+        while len(self._names) > self.keep:
+            stale = self._names.pop(0)
+            try:
+                self.store.delete(stale)
+            except Exception as exc:
+                if logger is not None:
+                    logger.warning("retention delete of %s failed: %s",
+                                   stale, exc)
+            pruned.append(stale)
+        return pruned
+
+
+# -- store audit / auto-resume -----------------------------------------
+
+
+class CheckpointInfo:
+    """One store entry as seen by :func:`scan_checkpoints`."""
+
+    __slots__ = ("name", "status", "manifest", "error")
+
+    def __init__(self, name, status, manifest=None, error=None):
+        self.name = name
+        self.status = status          # "valid" | "corrupt" | "legacy"
+        self.manifest = manifest
+        self.error = error
+
+    @property
+    def wall_time(self):
+        if self.manifest:
+            try:
+                return float(self.manifest.get("wall_time"))
+            except (TypeError, ValueError):
+                pass
+        return None
+
+    def __repr__(self):
+        return "CheckpointInfo(%r, %s)" % (self.name, self.status)
+
+
+def scan_checkpoints(target):
+    """Audit every checkpoint in a store (directory, http(s) base URL
+    or a :class:`SnapshotStore`): -> ``[CheckpointInfo]`` with
+    manifest-verified ``valid`` entries first (newest wall time
+    leading), then ``legacy`` (pre-manifest, unverifiable), then
+    ``corrupt``. The ``checkpoints`` CLI subcommand and
+    :func:`resolve_auto` are both views over this. Transport failures
+    PROPAGATE (matching resolve_auto's loud-failure contract): a
+    flaky store must never read as "holds corrupt checkpoints" —
+    the audit gate reserves that verdict for real corruption."""
+    store = store_for_base(target, create=False)
+    infos = []
+    for name in store.list():
+        try:
+            raw = store.get(name)
+        except KeyError:
+            continue                  # raced retention
+        try:
+            _, manifest = parse_checkpoint(raw, name)
+        except CorruptCheckpointError as exc:
+            infos.append(CheckpointInfo(name, "corrupt",
+                                        error=str(exc)))
+            continue
+        infos.append(CheckpointInfo(
+            name, "valid" if manifest else "legacy", manifest=manifest))
+    rank = {"valid": 0, "legacy": 1, "corrupt": 2}
+    # name DESC first, then a stable sort by (status, wall time): two
+    # writes inside one clock tick tie on wall_time, and rolling-slot
+    # names are zero-padded so the higher sequence is the newer one
+    infos.sort(key=lambda i: i.name, reverse=True)
+    infos.sort(key=lambda i: (rank[i.status], -(i.wall_time or 0.0)))
+    return infos
+
+
+def resolve_auto(target, logger=None, prefixes=None):
+    """``--snapshot auto``: pick the newest checkpoint in ``target``
+    whose manifest VERIFIES, falling back past corruption (every
+    corrupt blob observed counts once per scan in
+    ``veles_checkpoint_verify_failures_total`` — a corrupt blob's own
+    wall time is unreadable, so "newer than the winner" cannot be
+    decided and the count is per observation, not per fallback).
+    Legacy pre-manifest blobs are never auto-resumed (resume them by
+    explicit path). Each blob is fetched and hashed exactly ONCE — on
+    a remote store the resume-latency window is slaves burning their
+    reconnect budget.
+
+    ``prefixes``: when given, only names that are one of these
+    prefixes followed by our own stamp shapes
+    (``<prefix>_=<metric>/_initial/_current-N/_master-N``) are
+    candidates — on a SHARED snapshot directory, workflow A resuming
+    "newest in the store" must never adopt workflow B's newer
+    checkpoint (wrong weights grafted onto coincident unit names, or
+    a set_state shape crash), including a B named ``A_b`` that a bare
+    prefix match would let through.
+
+    -> ``(state_tree, name, n_corrupt)`` or ``None`` when the store
+    holds no verifiable checkpoint. Transport errors propagate: a
+    DOWN store must fail the resume loudly, never read as "empty
+    store, start fresh"."""
+    store = store_for_base(target, create=False)
+    best = None                     # (wall_time, name, flat, manifest)
+    corrupt = 0
+    for name in store.list():
+        if prefixes and not _under_prefix(name, prefixes):
+            continue                # another workflow's checkpoint
+        try:
+            raw = store.get(name)
+        except KeyError:
+            continue                # raced retention
+        try:
+            flat, manifest = parse_checkpoint(raw, name)
+        except CorruptCheckpointError as exc:
+            corrupt += 1
+            _count_verify_failure()
+            if logger is not None:
+                logger.warning("checkpoint %s rejected: %s",
+                               name, exc)
+            continue
+        if manifest is None:
+            continue                # legacy: explicit-path only
+        try:
+            wall = float(manifest.get("wall_time") or 0.0)
+        except (TypeError, ValueError):
+            wall = 0.0
+        if best is None or (wall, name) > (best[0], best[1]):
+            best = (wall, name, flat, manifest)
+    if best is None:
+        return None
+    _, name, flat, manifest = best
+    here = config_fingerprint()
+    stamped = manifest.get("config_hash")
+    if logger is not None and here and stamped and here != stamped:
+        logger.warning(
+            "checkpoint %s was written under a different config "
+            "(hash %s… vs current %s…) — resuming anyway",
+            name, stamped[:10], here[:10])
+    return _unflatten_tree(flat), name, corrupt
 
 
 class SnapshotterBase(Unit):
@@ -367,7 +860,7 @@ class SnapshotterBase(Unit):
 
     def __init__(self, workflow, prefix="wf", compression="gz",
                  directory=None, keep=2, export_inference=None,
-                 store=None, **kwargs):
+                 store=None, interval=None, keep_interval=2, **kwargs):
         super().__init__(workflow, **kwargs)
         if compression not in _OPENERS:
             raise ValueError("compression must be one of %s"
@@ -375,6 +868,15 @@ class SnapshotterBase(Unit):
         self.prefix = prefix
         self.compression = compression
         self.directory = directory or root.common.dirs.snapshots
+        #: wall-clock gate (seconds): when set, rolling ``current``
+        #: checkpoints are written at the first unit boundary after
+        #: ``interval`` elapsed since the last write — preemption
+        #: bounds the loss to this window even when validation never
+        #: improves. None keeps the improvement-only reference gate.
+        self.interval = None if not interval else float(interval)
+        self.keep_interval = int(keep_interval)
+        self._current_slot = None     # RollingSlot, built with store
+        self._last_write = time.monotonic()
         #: the storage backend; default = local FileSnapshotStore over
         #: ``directory``. Any SnapshotStore plugs in (config can name
         #: an HTTP endpoint: ``store="http://host/bucket"``).
@@ -409,6 +911,57 @@ class SnapshotterBase(Unit):
     def initialize(self, **kwargs):
         super().initialize(**kwargs)
         self.store   # materialize (creates the directory for files)
+        self._current_slot = RollingSlot(
+            self.store, self.prefix, keep=self.keep_interval)
+        self._rebuild_retention()
+
+    def _rebuild_retention(self):
+        """Re-adopt this prefix's snapshots from the store: after a
+        resume, ``_written`` used to start empty, so retention forgot
+        every pre-restart snapshot and the store grew without bound."""
+        try:
+            names = self.store.list()
+        except Exception as exc:
+            self.warning("retention rebuild skipped: store list "
+                         "failed (%s)", exc)
+            return
+        # ONE listing shared by both slots: a second round-trip on an
+        # HTTP store would also let a concurrent writer slip between
+        # the current-slot and best-slot views
+        self._current_slot.rebuild(logger=self, names=names)
+        best = []
+        for name in names:
+            if not name.startswith(self.prefix + "_") \
+                    or _ROLLING_RE.search(name):
+                continue
+            rest = name[len(self.prefix) + 1:]
+            # ONLY this snapshotter's own stamped shapes: a sibling
+            # workflow named "<prefix>_extra" sharing the store must
+            # never have its snapshots adopted (and pruned!) here
+            if rest.startswith("initial.ckpt."):
+                metric = numpy.inf      # "initial" prunes first
+            elif rest.startswith("="):
+                try:
+                    metric = float(rest[1:rest.index(".ckpt.")])
+                except ValueError:
+                    continue
+            else:
+                continue
+            best.append((metric, name))
+        # prune order is pop(0): worst metric (largest error) first,
+        # matching the improvement gate's "newest == best" invariant
+        best.sort(key=lambda t: (-t[0], t[1]))
+        self._written = [n for _, n in best]
+        self._prune(self._written, self.keep)
+
+    def _prune(self, written, keep):
+        while len(written) > keep:
+            stale = written.pop(0)
+            try:
+                self.store.delete(stale)
+            except Exception as exc:
+                self.warning("retention delete of %s failed: %s",
+                             stale, exc)
 
     def suffix(self):
         metric = getattr(self.decision, "best_metric", None)
@@ -417,26 +970,49 @@ class SnapshotterBase(Unit):
         return "=%.6g" % metric
 
     def run(self):
-        self.export_snapshot()
+        if self.interval is None:
+            # reference mode: the GRAPH gate (gate_skip = ~improved)
+            # decides; a direct run() call means "export now" — both
+            # the scheduler contract and tests rely on that
+            self.export_snapshot()
+            return
+        # interval mode: the graph gate stays open and run() fires at
+        # every unit boundary, so the gating moves in here
+        improved = self.decision is not None \
+            and bool(getattr(self.decision, "improved", False))
+        if improved:
+            self.export_snapshot()
+        elif time.monotonic() - self._last_write >= self.interval:
+            # re-arm BEFORE the attempt: a failed write must wait a
+            # full interval to retry, not re-fire at the very next
+            # unit boundary — back-to-back retries would burn the
+            # 3-strike transient-failure budget inside one brief
+            # store outage and kill the run
+            self._last_write = time.monotonic()
+            self.export_snapshot(slot="current")
 
-    def export_snapshot(self):
-        name = "%s_%s.ckpt.npz%s" % (
-            self.prefix, self.suffix(),
-            "." + self.compression if self.compression else "")
-        payload = self.workflow.checkpoint_state()
-        blob = io.BytesIO()
-        numpy.savez(blob, **_flatten_tree(payload))
-        # compress THROUGH the store's stream: file stores get the
-        # old direct-to-disk write (no second in-memory copy of the
-        # blob); buffering stores (HTTP) collect and put once
+    def export_snapshot(self, slot="best"):
+        """Write one checkpoint into ``slot`` ("best": improvement-
+        gated, metric-stamped name; "current": rolling wall-clock /
+        shutdown slot with its own retention)."""
+        if slot == "best":
+            name = "%s_%s.ckpt.npz%s" % (
+                self.prefix, self.suffix(),
+                "." + self.compression if self.compression else "")
+        else:
+            if self._current_slot is None:
+                self._current_slot = RollingSlot(
+                    self.store, self.prefix, keep=self.keep_interval)
+                self._current_slot.rebuild(logger=self)
+            name = self._current_slot.next_name(self.compression)
         try:
-            sp = self.store.stream(name)
-            with sp as sink:
-                if self.compression:
-                    with _OPENERS[self.compression](sink, "wb") as f:
-                        f.write(blob.getvalue())
-                else:
-                    sink.write(blob.getvalue())
+            # the state build is INSIDE the guard too (mirroring the
+            # master's persist_state): a transient get_state failure
+            # must degrade this checkpoint, not kill the run
+            payload = self.workflow.checkpoint_state()
+            path, _ = write_checkpoint(
+                self.store, name, payload,
+                compression=self.compression, slot=slot)
         except Exception as exc:
             # a checkpoint is auxiliary: a TRANSIENT store failure
             # (remote 503, full disk) must not kill hours of training
@@ -456,30 +1032,39 @@ class SnapshotterBase(Unit):
                          self.max_store_failures)
             return None
         self._store_failures = 0
-        path = sp.uri
         self.destination = path
-        # same-suffix rewrites refresh their retention slot
-        if name in self._written:
-            self._written.remove(name)
-        self._written.append(name)
-        # retention: keep the last `keep` snapshots (newest == best so
-        # far, since the gate only opens on improvement)
-        while len(self._written) > self.keep:
-            stale = self._written.pop(0)
-            try:
-                self.store.delete(stale)
-            except Exception as exc:
-                self.warning("retention delete of %s failed: %s",
-                             stale, exc)
-        if self.export_inference_dir:
+        self._last_write = time.monotonic()
+        if slot == "best":
+            # same-suffix rewrites refresh their retention slot
+            if name in self._written:
+                self._written.remove(name)
+            self._written.append(name)
+            # retention: keep the last `keep` snapshots (newest ==
+            # best so far, since the gate only opens on improvement)
+            self._prune(self._written, self.keep)
+        else:
+            self._current_slot.commit(name, logger=self)
+        if slot == "best" and self.export_inference_dir:
             from veles.export_inference import export_inference
             # checkpoint_state() above already synced the at_valid view
             export_inference(self.workflow, self.export_inference_dir,
                              at_valid=True, sync=False)
             self.info("inference archive -> %s",
                       self.export_inference_dir)
-        self.info("snapshot -> %s", path)
+        self.info("snapshot [%s] -> %s", slot, path)
         return path
+
+    def preempt_snapshot(self):
+        """The SIGTERM path (Launcher): one final forced ``current``-
+        slot checkpoint regardless of gates, so a preempted job
+        resumes from its very last unit boundary."""
+        try:
+            return self.export_snapshot(slot="current")
+        except Exception as exc:
+            # the process is exiting: a dead store must not turn a
+            # clean preemption into a crash loop
+            self.warning("preemption checkpoint failed: %s", exc)
+            return None
 
 
 class Snapshotter(SnapshotterBase):
@@ -488,23 +1073,19 @@ class Snapshotter(SnapshotterBase):
 
 def load_snapshot(path):
     """Read a checkpoint written by Snapshotter back into a state
-    tree. ``path``: a local file, or an ``http(s)://`` URI resolved
-    through :class:`HTTPSnapshotStore` (remote resume)."""
+    tree, VERIFYING its embedded manifest when present (legacy
+    pre-manifest blobs load unverified). ``path``: a local file, or an
+    ``http(s)://`` URI resolved through :class:`HTTPSnapshotStore`
+    (remote resume). Raises :class:`CorruptCheckpointError` on a
+    truncated, bit-flipped or otherwise unreadable blob."""
     store, name = store_for(path)
-    base = os.path.basename(name)
-    comp = ""
-    for suffix, opener in _OPENERS.items():
-        if suffix and base.endswith("." + suffix):
-            comp = suffix
     if store is not None:
         raw = store.get(name)
     else:
         with open(path, "rb") as f:
             raw = f.read()
-    data = raw if not comp else \
-        _OPENERS[comp](io.BytesIO(raw), "rb").read()
-    npz = numpy.load(io.BytesIO(data), allow_pickle=False)
-    return _unflatten_tree(dict(npz))
+    flat, _ = parse_checkpoint(raw, name)
+    return _unflatten_tree(flat)
 
 
 def _flatten_tree(tree, prefix=""):

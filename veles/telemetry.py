@@ -80,18 +80,29 @@ class _CounterChild:
 
 
 class _GaugeChild:
-    __slots__ = ("_lock", "_value")
+    __slots__ = ("_lock", "_value", "_fn")
 
     def __init__(self):
         self._lock = threading.Lock()
         self._value = 0.0
+        self._fn = None
 
     def set(self, v):
         with self._lock:
+            self._fn = None
             self._value = float(v)
+
+    def set_function(self, fn):
+        """Evaluate ``fn()`` at read/scrape time instead of storing a
+        value — for gauges that are an AGE or other now-relative
+        quantity (e.g. seconds since the last checkpoint), which a
+        stored value would freeze at whatever it was when set."""
+        with self._lock:
+            self._fn = fn
 
     def inc(self, n=1):
         with self._lock:
+            self._fn = None
             self._value += n
 
     def dec(self, n=1):
@@ -99,6 +110,12 @@ class _GaugeChild:
 
     @property
     def value(self):
+        fn = self._fn
+        if fn is not None:
+            try:
+                return float(fn())
+            except Exception:
+                return float("nan")
         return self._value
 
 
@@ -227,6 +244,9 @@ class _Family:
 
     def set(self, v):
         self._default().set(v)
+
+    def set_function(self, fn):
+        self._default().set_function(fn)
 
     def dec(self, n=1):
         self._default().dec(n)

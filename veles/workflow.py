@@ -165,6 +165,36 @@ class Workflow(Unit, Container):
             if unit is not self:
                 unit.stop()
 
+    # -- checkpoint / resume (generic fallback) ------------------------
+
+    def checkpoint_state(self):
+        """Generic resumable state: every unit exposing ``get_state``
+        contributes under its name. NNWorkflow overrides this with the
+        richer params/optimizer tree; this fallback makes ANY workflow
+        (custom unit graphs driven straight through Launcher) at least
+        preemption-checkpointable."""
+        tree = {"units": {}, "meta": {"workflow": self.name,
+                                      "run_number": self.run_number}}
+        for unit in self._units:
+            get = getattr(unit, "get_state", None)
+            if callable(get):
+                state = get()
+                if state:
+                    tree["units"][unit.name] = state
+        return tree
+
+    def restore_state(self, tree):
+        for name, state in tree.get("units", {}).items():
+            try:
+                unit = self.unit_by_name(name)
+            except KeyError:
+                self.warning("checkpoint names unknown unit %r — "
+                             "skipped", name)
+                continue
+            setter = getattr(unit, "set_state", None)
+            if callable(setter):
+                setter(state)
+
     # -- introspection / observability --------------------------------
 
     def generate_graph(self) -> str:

@@ -759,6 +759,17 @@ class NNWorkflow(AcceleratedWorkflow):
             tree["decision"] = self.decision.get_state()
         if self.loader is not None:
             tree["loader"] = self.loader.get_state()
+        if self.rollback is not None:
+            # divergence-rollback history must survive a RESTART, not
+            # just a same-process restore: a resumed run that forgot
+            # its best loss would re-stash a diverged state as "good"
+            tree["rollback"] = self.rollback.get_state()
+        lr_scales = {gd.name: float(gd.lr_scale) for gd in self.gds
+                     if gd is not None and hasattr(gd, "lr_scale")}
+        if lr_scales:
+            # rollback cuts learning rates via lr_scale; losing the
+            # cuts on resume would re-diverge at the pre-cut rate
+            tree["lr_scales"] = lr_scales
         if self.xla_step is not None:
             # step counter consistent with the at_valid params/state
             tree["meta"]["step_index"] = \
@@ -777,6 +788,12 @@ class NNWorkflow(AcceleratedWorkflow):
             self.decision.set_state(tree["decision"])
         if self.loader is not None and "loader" in tree:
             self.loader.set_state(tree["loader"])
+        if self.rollback is not None and "rollback" in tree:
+            self.rollback.set_state(tree["rollback"])
+        for name, scale in tree.get("lr_scales", {}).items():
+            for gd in self.gds:
+                if gd is not None and gd.name == name:
+                    gd.lr_scale = float(scale)
         if self.xla_step is not None:
             self.xla_step.step_index = int(
                 tree.get("meta", {}).get("step_index", 0))

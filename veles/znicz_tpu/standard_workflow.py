@@ -182,13 +182,19 @@ class StandardWorkflowBase(NNWorkflow):
 
     def link_snapshotter(self, **cfg):
         """Checkpoint writer gated on improved validation (reference
-        behaviour [U]; SURVEY.md §3.4)."""
+        behaviour [U]; SURVEY.md §3.4). With ``interval=SECS`` the
+        graph gate stays OPEN and the unit gates internally: improved
+        validation still writes ``best``, and any later unit boundary
+        past the wall-clock interval writes a rolling ``current``
+        checkpoint (the preemption-loss bound)."""
         from veles.snapshotter import Snapshotter
         cfg.setdefault("prefix", self.name)
+        interval = cfg.get("interval")
         snap = Snapshotter(self, name="snapshotter", **cfg)
         snap.decision = self.decision
         snap.link_from(self.decision)
-        snap.gate_skip = ~self.decision.improved
+        if not interval:
+            snap.gate_skip = ~self.decision.improved
         self.snapshotter = snap
         self._end_point_last()   # post-construction linking support
         return snap
