@@ -354,6 +354,35 @@ def test_rollback_state_survives_checkpoint_resume(tmp_path):
     assert wf2.decision.epoch_number == 3
 
 
+def test_aux_unit_state_survives_nn_checkpoint(tmp_path):
+    """NNWorkflow.checkpoint_state used to carry ONLY the units it
+    knows by name (decision/loader/rollback/params) — any other
+    stateful unit was silently dropped and restarted from constructor
+    defaults on resume (the exact hole the zlint checkpoint-state rule
+    closes statically). An ImageSaver's epoch-directory counter must
+    round-trip."""
+    from veles.znicz_tpu.image_saver import ImageSaver
+    wf = make_wf("AuxSrc", snapdir=str(tmp_path))
+    saver = ImageSaver(wf, name="image_saver",
+                       out_dir=str(tmp_path / "dumps"))
+    saver._epoch = 5
+    saver._saved_this_epoch = 3
+    saver.total_saved = 41
+    tree = wf.checkpoint_state()
+    assert tree["units"]["image_saver"] == {
+        "epoch": 5, "saved_this_epoch": 3, "total_saved": 41}
+
+    wf2 = make_wf("AuxDst", max_epochs=3)
+    saver2 = ImageSaver(wf2, name="image_saver",
+                        out_dir=str(tmp_path / "dumps"))
+    wf2.restore_state(tree)
+    assert (saver2._epoch, saver2._saved_this_epoch,
+            saver2.total_saved) == (5, 3, 41)
+    # explicitly-handled units must NOT be duplicated under "units"
+    assert "decision" not in tree.get("units", {})
+    assert "loader" not in tree.get("units", {})
+
+
 # -- generic workflow checkpoint fallback ------------------------------
 
 

@@ -15,7 +15,7 @@ Re-design of ``velescli.py`` = ``veles/__main__.py`` [U] (SURVEY.md
   ``--workflow-graph`` dumps graphviz, ``--result-file`` writes the
   run's metric history as JSON.
 
-Two subcommands live OUTSIDE the workflow shape:
+Three subcommands live OUTSIDE the workflow shape:
 
     python -m veles serve --model NAME=ARCHIVE_DIR [...]
 
@@ -25,7 +25,13 @@ starts the batched online-inference frontend (``veles/serving/``) over
     python -m veles checkpoints <dir-or-url>
 
 audits a snapshot store (manifest verification: valid / corrupt /
-legacy per blob) before an operator trusts it with ``--snapshot auto``.
+legacy per blob) before an operator trusts it with ``--snapshot auto``;
+
+    python -m veles lint [--json] [paths...]
+
+runs the zlint static-analysis gate (``veles/analysis/``: tracer
+purity, lock order, checkpoint completeness, telemetry hygiene,
+thread lifecycle) — exit 0 clean / 1 findings / 2 usage.
 """
 
 import argparse
@@ -564,6 +570,11 @@ def main(argv=None):
         # store audit: list checkpoints + manifest status so an
         # operator can vet a store before --snapshot auto trusts it
         return checkpoints_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # zlint static analysis (veles/analysis/): the tier-1 gate
+        # runs the same engine over the whole package
+        from veles.analysis.cli import lint_main
+        return lint_main(argv[1:])
     m = Main(argv)
     if getattr(m.args, "background", False):
         if not daemonize(m.args.log_file):

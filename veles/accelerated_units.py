@@ -23,12 +23,11 @@ eliminated by construction).
 """
 
 import time
-from collections import OrderedDict
 
 import numpy
 
 from veles import telemetry
-from veles.backends import Device, NumpyDevice, XLADevice, get_device
+from veles.backends import XLADevice, get_device
 from veles.memory import Array
 from veles.units import Unit
 from veles.workflow import Workflow

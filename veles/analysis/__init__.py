@@ -1,0 +1,50 @@
+"""zlint — framework-aware static analysis for the veles tree.
+
+The runtime grew into a threaded, fault-tolerant, checkpointed system
+(master/slave leases, persist + heartbeat threads, micro-batcher,
+durable snapshotter) whose correctness rests on invariants no test
+exercises exhaustively: lock acquisition order, tracer purity of the
+jit-compiled step functions, and the ``get_state``/``checkpoint_state``
+protocol that silently drops any unit which forgets to implement it.
+This package machine-checks those invariants over the AST:
+
+========================  =============================================
+rule id                   checks
+========================  =============================================
+``tracer-purity``         ``xla_run`` closures (the functions
+                          StepCompiler traces under ``jax.jit``) must
+                          not call ``numpy.random``/``time.*``/
+                          ``print``, concretize traced values
+                          (``.item()``, ``float()``/``int()`` on a
+                          ``ctx`` read) or mutate ``self``
+``lock-order``            inter-procedural lock-acquisition graph;
+                          cycles = potential deadlocks, nested
+                          re-acquisition of a non-reentrant ``Lock``
+``unguarded-shared-state``  instance attributes written both from a
+                          ``threading.Thread`` target and from
+                          unlocked public methods
+``checkpoint-state``      Unit subclasses whose ``run()`` mutates
+                          instance state must implement ``get_state``/
+                          ``checkpoint_state`` (or carry a pragma
+                          explaining why the state is ephemeral)
+``telemetry-hygiene``     instrument families created inside loops;
+                          unbounded label values minted from ids
+``thread-lifecycle``      threads must be daemons or have a join path
+``bare-except``           ``except:`` swallows ``KeyboardInterrupt``
+``unused-import``         dead module-level imports
+``unused-variable``       locals assigned and never read
+========================  =============================================
+
+Findings carry file:line, rule id, severity and a one-line fix hint.
+A finding is suppressed by a pragma comment on its line::
+
+    self.reached = True   # zlint: disable=checkpoint-state (per-run)
+
+``# zlint: disable=all`` silences every rule on that line. Run it as
+``velescli lint [--json] [paths...]`` (exit 0 clean / 1 findings /
+2 usage error); the tier-1 gate ``tests/test_analysis.py`` keeps the
+whole ``veles/`` package at zero findings.
+"""
+
+from veles.analysis.core import (          # noqa: F401  (public API)
+    Finding, Project, RULES, analyze_paths, iter_py_files)

@@ -1,0 +1,89 @@
+"""``velescli lint`` — run zlint over files/directories.
+
+Exit codes follow the gate contract: **0** clean, **1** findings,
+**2** usage error (bad path, unknown rule). ``--json`` emits the
+findings as a JSON array sorted by (file, line, rule) with
+repo-relative paths — byte-stable for CI diffing.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _default_paths():
+    """With no paths given, lint the installed veles package."""
+    import veles
+    return [os.path.dirname(os.path.abspath(veles.__file__))]
+
+
+def lint_main(argv=None):
+    from veles.analysis.core import (
+        RULES, UnknownRuleError, _load_rules, analyze_paths)
+    p = argparse.ArgumentParser(
+        prog="velescli lint",
+        description="Framework-aware static analysis (zlint): tracer "
+                    "purity, lock order, checkpoint completeness, "
+                    "telemetry hygiene, thread lifecycle + generic "
+                    "hygiene. Suppress a finding with "
+                    "`# zlint: disable=RULE (reason)` on its line.")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories (default: the veles "
+                        "package)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable sorted JSON findings")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids to run (default: "
+                        "all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as exc:
+        # argparse exits 2 on usage errors already; normalize others
+        return int(exc.code or 0)
+    if args.list_rules:
+        _load_rules()
+        for rule_id in sorted(RULES):
+            _fn, sev, doc = RULES[rule_id]
+            print("%-24s %-8s %s" % (rule_id, sev, doc))
+        return 0
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",")
+                  if r.strip()]
+    try:
+        findings = analyze_paths(args.paths or _default_paths(),
+                                 select=select)
+    except FileNotFoundError as exc:
+        print("error: no such file or directory: %s" % exc,
+              file=sys.stderr)
+        return 2
+    except UnknownRuleError as exc:
+        print("error: %s" % exc, file=sys.stderr)
+        return 2
+    except (SyntaxError, UnicodeDecodeError) as exc:
+        # an unparseable input is a usage error (2), NOT "findings"
+        # (1): CI diffing on exit codes must never read a crashed
+        # lint as a lint verdict
+        print("error: cannot parse %s: %s"
+              % (getattr(exc, "filename", "input"), exc),
+              file=sys.stderr)
+        return 2
+    except OSError as exc:
+        # unreadable input (permissions, transient FS trouble) is an
+        # environment error, same contract as above
+        print("error: cannot read input: %s" % exc, file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([f.as_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print("%d finding(s)" % len(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(lint_main())

@@ -1,0 +1,280 @@
+"""tracer-purity: the jit-traced step closures must stay pure.
+
+StepCompiler traces every unit's ``xla_run(ctx)`` under ``jax.jit``
+(``veles/accelerated_units.py``): the closure runs ONCE at trace time
+and whatever it does outside the tensor algebra is baked into (or
+silently dropped from) the compiled program. Inside the traced scope —
+``xla_run`` methods in ``veles/znicz_tpu/ops/`` plus everything they
+reach through ``self.*`` and same-module helper calls — this rule
+bans:
+
+* ``numpy.random.*`` — host randomness freezes at trace time; use
+  ``jax.random`` with ``ctx.fold_key(self)``;
+* ``time.*`` — trace-time wall clock constant-folds into the program;
+* ``print(...)`` — executes once at trace time, never per step (use
+  ``jax.debug.print`` if needed);
+* ``.item()`` / ``float()`` / ``int()`` on a value read from the
+  tracing context — concretizing a tracer either crashes or silently
+  hardcodes the first batch's value;
+* assigning ``self.*`` — trace-time mutation runs once, not per step,
+  and hides state from the checkpoint protocol.
+
+``float()/int()`` are only flagged when their argument is (derived
+from) a ``ctx.get(...)`` / ``ctx.unit_params(...)`` read — shape
+arithmetic like ``int(numpy.prod(shape))`` over static python ints is
+legitimate and common.
+"""
+
+import ast
+
+from veles.analysis.core import Finding, register
+
+#: method names that enter jax tracing (StepCompiler collects these)
+_TRACED_METHODS = ("xla_run",)
+
+#: path fragment selecting the traced-op modules
+_OPS_FRAGMENT = "znicz_tpu/ops"
+
+
+def _in_ops(mod):
+    return _OPS_FRAGMENT in mod.relpath.replace("\\", "/")
+
+
+#: (canonical dotted prefix, why it is banned, fix hint)
+_BANNED_PREFIXES = (
+    ("numpy.random",
+     "host randomness freezes at trace time",
+     "use jax.random with ctx.fold_key(self) for per-step "
+     "randomness"),
+    ("time",
+     "the trace-time clock constant-folds into the compiled program",
+     "time the dispatch outside the jitted function"),
+)
+
+
+def _canonical_prefixes(mod):
+    """local name -> canonical dotted path, resolving every import
+    style (``import numpy as np``, ``from numpy import random``,
+    ``from time import monotonic``) so the bans cannot be dodged by
+    how the module was imported."""
+    out = {}
+    for local, target in mod.imports.items():
+        if target[0] == "module":
+            dotted = target[1]
+            if "." in dotted and local == dotted.split(".")[0]:
+                # plain ``import numpy.random`` binds the TOP package
+                # name; the attribute chain spells out the rest
+                dotted = local
+        else:
+            dotted = "%s.%s" % (target[1], target[2])
+        out[local] = dotted
+    return out
+
+
+def _banned(chain, prefixes):
+    """(why, hint) when ``chain`` canonicalizes into a banned
+    namespace, else None."""
+    parts = chain.split(".")
+    root = prefixes.get(parts[0])
+    if root is None:
+        return None
+    canonical = ".".join([root] + parts[1:])
+    for prefix, why, hint in _BANNED_PREFIXES:
+        if canonical == prefix or canonical.startswith(prefix + "."):
+            return why, hint
+    return None
+
+
+def _attr_chain(expr):
+    """Dotted name of an attribute chain, or None."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _ctx_tainted_names(func):
+    """Local names holding traced tensors: assigned from a
+    ``ctx.get(...)``/``ctx.unit_params(...)`` read, or DERIVED from an
+    already-tainted name (``s = t * 2``) — propagated to a fixpoint so
+    ``float(s)`` is caught as surely as ``float(ctx.get("x"))``."""
+    tainted = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign):
+                if not _expr_touches(node.value, tainted):
+                    continue
+                targets = []
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        targets.append(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        targets.extend(el.id for el in t.elts
+                                       if isinstance(el, ast.Name))
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and _expr_touches(node.value, tainted):
+                targets = [node.target.id]
+            else:
+                continue
+            for name in targets:
+                if name not in tainted:
+                    tainted.add(name)
+                    changed = True
+    return tainted
+
+
+def _expr_touches(expr, tainted):
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) \
+                and (sub.id in tainted or sub.id == "ctx"):
+            return True
+        chain = _attr_chain(sub) if isinstance(sub, ast.Attribute) \
+            else None
+        if chain and (chain == "ctx" or chain.startswith("ctx.")):
+            return True
+    return False
+
+
+def _scan_traced(mod, cls_name, func, findings, seen_funcs,
+                 project, depth=0):
+    if id(func) in seen_funcs or depth > 20:
+        return
+    seen_funcs.add(id(func))
+    prefixes = _canonical_prefixes(mod)
+    tainted = _ctx_tainted_names(func)
+    where = "%s.%s" % (cls_name, func.name) if cls_name else func.name
+
+    for node in ast.walk(func):
+        # self mutation
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, "tracer-purity",
+                        "error",
+                        "%s mutates self.%s inside the traced scope "
+                        "— runs once at trace time, not per step"
+                        % (where, t.attr),
+                        "return the value through ctx.set(...) or "
+                        "move the mutation to run()/initialize()"))
+        if not isinstance(node, ast.Call):
+            continue
+        # .item() on anything (incl. chained calls like x.sum().item())
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" and not node.args:
+            findings.append(Finding(
+                mod.relpath, node.lineno, "tracer-purity", "error",
+                "%s calls .item() inside the traced scope — "
+                "concretizing a tracer fails under jit" % where,
+                "keep the value symbolic; reduce with jnp and let "
+                "the step return it"))
+            continue
+        chain = _attr_chain(node.func) \
+            if isinstance(node.func, ast.Attribute) else None
+        # numpy.random.* / time.* under ANY import spelling
+        if chain:
+            ban = _banned(chain, prefixes)
+            if ban is not None:
+                why, hint = ban
+                findings.append(Finding(
+                    mod.relpath, node.lineno, "tracer-purity",
+                    "error",
+                    "%s calls %s inside the traced scope — %s"
+                    % (where, chain, why), hint))
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+            ban = _banned(fname, prefixes)
+            if ban is not None:
+                why, hint = ban
+                findings.append(Finding(
+                    mod.relpath, node.lineno, "tracer-purity",
+                    "error",
+                    "%s calls %s inside the traced scope — %s"
+                    % (where, fname, why), hint))
+            elif fname == "print":
+                findings.append(Finding(
+                    mod.relpath, node.lineno, "tracer-purity",
+                    "error",
+                    "%s calls print() inside the traced scope — it "
+                    "runs once at trace time, never per step" % where,
+                    "drop it, or use jax.debug.print for runtime "
+                    "prints"))
+            elif fname in ("float", "int") and node.args \
+                    and _expr_touches(node.args[0], tainted):
+                findings.append(Finding(
+                    mod.relpath, node.lineno, "tracer-purity",
+                    "error",
+                    "%s calls %s() on a traced value inside the "
+                    "traced scope — concretizing a tracer fails "
+                    "under jit" % (where, fname),
+                    "keep the value symbolic (jnp ops) or read it "
+                    "host-side after the step"))
+        # follow helper calls: self.m(...), same-module functions,
+        # module-alias calls (``A.relu(x)``, the dominant style in
+        # ops/) and symbol-imported functions
+        if isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name):
+            base = node.func.value.id
+            if base == "self" and cls_name:
+                cls = mod.classes.get(cls_name)
+                if cls is not None:
+                    owner, meth = project.find_method(cls,
+                                                      node.func.attr)
+                    if meth is not None and _in_ops(owner.module):
+                        _scan_traced(owner.module, owner.name, meth,
+                                     findings, seen_funcs, project,
+                                     depth + 1)
+            else:
+                tmod = project.resolve_module_alias(mod, base)
+                if tmod is not None and _in_ops(tmod) \
+                        and node.func.attr in tmod.functions:
+                    _scan_traced(tmod, None,
+                                 tmod.functions[node.func.attr],
+                                 findings, seen_funcs, project,
+                                 depth + 1)
+        elif isinstance(node.func, ast.Name):
+            fname = node.func.id
+            if fname in mod.functions:
+                _scan_traced(mod, None, mod.functions[fname],
+                             findings, seen_funcs, project, depth + 1)
+            else:
+                target = mod.imports.get(fname)
+                if target is not None and target[0] == "symbol":
+                    tmod = project.module_by_dotted(target[1])
+                    if tmod is not None and _in_ops(tmod) \
+                            and target[2] in tmod.functions:
+                        _scan_traced(tmod, None,
+                                     tmod.functions[target[2]],
+                                     findings, seen_funcs, project,
+                                     depth + 1)
+
+
+@register("tracer-purity", "error",
+          "jit-traced step closures must not do host I/O, host "
+          "randomness, tracer concretization or self mutation")
+def check_tracer_purity(project):
+    findings = []
+    # ONE project-wide seen set: a shared helper (conv_math etc.) is
+    # scanned once, not re-reported per calling module
+    seen = set()
+    for mod in project.modules:
+        if not _in_ops(mod):
+            continue
+        for cls in mod.classes.values():
+            for mname in _TRACED_METHODS:
+                meth = cls.methods.get(mname)
+                if meth is not None:
+                    _scan_traced(mod, cls.name, meth, findings, seen,
+                                 project)
+    return findings

@@ -1,0 +1,145 @@
+"""telemetry-hygiene: keep the metrics registry cheap and bounded.
+
+Two failure modes the telemetry core cannot defend against at
+runtime:
+
+* **families created inside loops** — ``telemetry.counter(...)`` is
+  idempotent-by-name but pays the registry lock + dict lookup every
+  call; a creation inside a ``for``/``while`` body is either a hot
+  path that should hold a :class:`veles.telemetry.LazyChild`, or an
+  unbounded family leak when the name is formatted per iteration;
+* **label values minted from identities** — ``.labels(id(x))``,
+  ``uuid4()``, ``token_hex()``, ``getpid()`` or a ``*_id`` loop
+  variable create a new child per value; Prometheus series are
+  forever, so identity-labelled series grow without bound (the
+  cluster aggregation path deliberately bounds its ``slave`` label
+  via per-token TTL eviction — see ``MasterServer._tele_states``).
+"""
+
+import ast
+
+from veles.analysis.core import Finding, register
+
+_FACTORIES = ("counter", "gauge", "histogram")
+
+#: calls whose result is an unbounded identity when used as a label
+_IDENTITY_CALLS = ("id", "uuid4", "uuid1", "token_hex", "token_urlsafe",
+                   "getpid", "get_ident", "monotonic", "time",
+                   "perf_counter")
+
+
+def _is_factory_call(node, telemetry_aliases, registry_handles):
+    """True for ``telemetry.counter(...)`` / ``registry.counter(...)``
+    shaped calls carrying a metric-name first argument — literal OR
+    computed: a name formatted per iteration is the worse failure
+    mode (one leaked family per value), so it must not be exempt."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in _FACTORIES:
+        return False
+    if not node.args:
+        return False
+    base = fn.value
+    if isinstance(base, ast.Name) and (
+            base.id in telemetry_aliases
+            or base.id in registry_handles):
+        return True
+    # <anything>.get_registry().counter(...) or a var named *registry*
+    if isinstance(base, ast.Call) and isinstance(
+            base.func, ast.Attribute) \
+            and base.func.attr == "get_registry":
+        return True
+    if isinstance(base, ast.Name) and "registry" in base.id.lower():
+        return True
+    return False
+
+
+def _telemetry_aliases(mod):
+    return {local for local, target in mod.imports.items()
+            if target in (("module", "veles.telemetry"),
+                          ("symbol", "veles", "telemetry"))}
+
+
+def _registry_handles(mod):
+    """Local names bound from a ``*.get_registry()`` call — the
+    handle style the runtime actually uses (``reg =
+    telemetry.get_registry()``), whatever the variable is called."""
+    out = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "get_registry":
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _loop_spans(tree):
+    """[(start, end)] line spans of for/while bodies."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            end = getattr(node, "end_lineno", node.lineno)
+            spans.append((node.lineno, end))
+    return spans
+
+
+def _identity_labelled(node):
+    """True when a ``.labels(...)`` call passes an identity-shaped
+    value: a call to an id/uuid/token factory, or a name ending in
+    ``_id``/named ``uuid``/``token``."""
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                fname = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if fname in _IDENTITY_CALLS:
+                    return True
+            elif isinstance(sub, (ast.Name, ast.Attribute)):
+                n = (sub.id if isinstance(sub, ast.Name)
+                     else sub.attr).lower()
+                if n.endswith("_id") or n in ("uuid", "token"):
+                    return True
+    return False
+
+
+@register("telemetry-hygiene", "error",
+          "no instrument creation in loops; no unbounded identity "
+          "label values")
+def check_telemetry_hygiene(project):
+    findings = []
+    for mod in project.modules:
+        aliases = _telemetry_aliases(mod)
+        handles = _registry_handles(mod)
+        spans = None
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_factory_call(node, aliases, handles):
+                if spans is None:
+                    spans = _loop_spans(mod.tree)
+                if any(s <= node.lineno <= e for s, e in spans):
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, "telemetry-hygiene",
+                        "error",
+                        "instrument family created inside a loop — "
+                        "pays the registry lock per iteration (or "
+                        "leaks families if the name varies)",
+                        "hoist the creation out of the loop or hold "
+                        "a telemetry.LazyChild at the call site"))
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "labels" \
+                    and (node.args or node.keywords) \
+                    and _identity_labelled(node):
+                findings.append(Finding(
+                    mod.relpath, node.lineno, "telemetry-hygiene",
+                    "error",
+                    "label value minted from an identity (id/uuid/"
+                    "token/pid) — every value is a new series that "
+                    "lives forever",
+                    "label by a bounded dimension (kind, model, "
+                    "unit name); aggregate identities before "
+                    "labelling or bound them with TTL eviction"))
+    return findings

@@ -147,7 +147,12 @@ class SlaveClient(Logger):
                 "('welcome', slave_id, lease_id), got %r"
                 % (self.address + (welcome,)))
         self.slave_id, self.lease_id = welcome[1], welcome[2]
-        self._last_io = time.monotonic()
+        # under the io lock: a previous connection's heartbeat thread
+        # may still be mid-round-trip and writes _last_io on exit —
+        # both writers hold the lock, so the fresher timestamp wins
+        # deterministically instead of racing
+        with self._io_lock:
+            self._last_io = time.monotonic()
         self._start_heartbeat()
         return self
 

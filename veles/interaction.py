@@ -22,7 +22,7 @@ import sys
 from veles.units import Unit
 
 
-class Shell(Unit):
+class Shell(Unit):  # zlint: disable=checkpoint-state (activations/results are interactive diagnostics; a resumed run's shell history is meaningless)
     def __init__(self, workflow, commands=None, banner=None, **kwargs):
         super().__init__(workflow, **kwargs)
         #: statements to execute instead of prompting (headless mode)

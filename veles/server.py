@@ -45,7 +45,6 @@ import json
 import os
 import pickle
 import secrets
-import socket
 import socketserver
 import struct
 import threading

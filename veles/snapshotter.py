@@ -59,7 +59,7 @@ import time
 
 import numpy
 
-from veles import prng, telemetry
+from veles import telemetry
 from veles.config import root
 from veles.units import Unit
 
@@ -855,7 +855,7 @@ def resolve_auto(target, logger=None, prefixes=None):
     return _unflatten_tree(flat), name, corrupt
 
 
-class SnapshotterBase(Unit):
+class SnapshotterBase(Unit):  # zlint: disable=checkpoint-state (sequence/retention are rebuilt from store.list() in initialize; the wall-clock gate and failure budget are deliberately per-process)
     """Gated checkpoint writer."""
 
     def __init__(self, workflow, prefix="wf", compression="gz",

@@ -15,7 +15,7 @@ nest, and it aggregates per-unit timing into the profiling report.
 
 import sys
 import time
-from collections import OrderedDict, deque
+from collections import deque
 
 from veles import telemetry
 from veles.units import Unit, TrivialUnit, Container
@@ -25,7 +25,7 @@ class StartPoint(TrivialUnit):
     pass
 
 
-class EndPoint(TrivialUnit):
+class EndPoint(TrivialUnit):  # zlint: disable=checkpoint-state (reached is a per-run completion flag, re-derived by the scheduler every run)
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
         self.reached = False
@@ -97,7 +97,6 @@ class Workflow(Unit, Container):
         """Cycle-tolerant topological order of all units, start_point
         first; unreachable units (plotters linked later) at the end."""
         indeg = {id(u): 0 for u in self._units}
-        unit_by_id = {id(u): u for u in self._units}
         for u in self._units:
             for dst in u.links_to:
                 if id(dst) in indeg:

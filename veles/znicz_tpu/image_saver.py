@@ -70,6 +70,19 @@ class ImageSaver(Unit):
             return int(loader.minibatch_labels.map_read().mem[mb_pos])
         return -1
 
+    def get_state(self):
+        # epoch directory numbering and the per-epoch limit must
+        # survive a resume: a restarted run that reset to epoch0000
+        # would overwrite the dumps it is supposed to extend
+        return {"epoch": self._epoch,
+                "saved_this_epoch": self._saved_this_epoch,
+                "total_saved": self.total_saved}
+
+    def set_state(self, state):
+        self._epoch = int(state["epoch"])
+        self._saved_this_epoch = int(state["saved_this_epoch"])
+        self.total_saved = int(state["total_saved"])
+
     def run(self):
         try:
             self._run()

@@ -29,6 +29,7 @@ from veles import prng
 from veles.accelerated_units import AcceleratedUnit, AcceleratedWorkflow
 from veles.distributable import IDistributable
 from veles.memory import Array
+from veles.workflow import Workflow
 
 # ---------------------------------------------------------------------------
 # MatchingObject registry (reference: metaclass MatchingObject [U])
@@ -774,7 +775,31 @@ class NNWorkflow(AcceleratedWorkflow):
             # step counter consistent with the at_valid params/state
             tree["meta"]["step_index"] = \
                 self.xla_step.snapshot_view(at_valid=True)[2]
+        units = self._generic_state_units()
+        if units:
+            # any OTHER unit exposing get_state rides under "units"
+            # (mirrors base Workflow.checkpoint_state): before this,
+            # a stateful auxiliary unit — ImageSaver's epoch dirs,
+            # say — was silently dropped from NN checkpoints and
+            # restarted from constructor defaults on resume
+            tree["units"] = {u.name: s for u, s in units}
         return tree
+
+    def _generic_state_units(self):
+        """(unit, state) pairs for units NOT already covered by the
+        explicit decision/loader/rollback/params sections above."""
+        handled = {id(u) for u in
+                   [self.decision, self.loader, self.rollback,
+                    self.xla_step] + self._stateful_units()
+                   if u is not None}
+        out = []
+        for u in self._units:
+            get = getattr(u, "get_state", None)
+            if callable(get) and id(u) not in handled:
+                state = get()
+                if state:
+                    out.append((u, state))
+        return out
 
     def restore_state(self, tree):
         """Load a checkpoint_state() tree back into the (already
@@ -794,6 +819,9 @@ class NNWorkflow(AcceleratedWorkflow):
             for gd in self.gds:
                 if gd is not None and gd.name == name:
                     gd.lr_scale = float(scale)
+        # the generic "units" section restores through the base loop
+        # (unit_by_name + set_state, unknown names warned and skipped)
+        Workflow.restore_state(self, tree)
         if self.xla_step is not None:
             self.xla_step.step_index = int(
                 tree.get("meta", {}).get("step_index", 0))

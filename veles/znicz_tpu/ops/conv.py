@@ -13,7 +13,6 @@ Weights are stored reference-style as ``(n_kernels, ky*kx*C)``.
 
 import numpy
 
-from veles.memory import Array
 from veles.znicz_tpu.nn_units import Forward, forward_unit
 from veles.znicz_tpu.ops import activations as A
 from veles.znicz_tpu.ops import conv_math as CM

@@ -22,7 +22,6 @@ from veles import prng
 from veles.memory import Array
 from veles.accelerated_units import AcceleratedUnit
 from veles.znicz_tpu.nn_units import Forward
-from veles.znicz_tpu.ops.all2all import All2AllSigmoid
 from veles.znicz_tpu.ops import activations as A
 
 

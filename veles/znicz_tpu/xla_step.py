@@ -43,7 +43,7 @@ def _record_dispatch(kind, warm, start, dt, **args):
             warm=bool(warm), **args)
 
 
-class XLAStep(Unit):
+class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index are checkpointed by NNWorkflow.checkpoint_state; the rest is per-dispatch bookkeeping reset by restore_state/initialize)
     """Runs the fused step; publishes evaluator metrics to the host."""
 
     def __init__(self, workflow, loader=None, forwards=(), evaluator=None,
