@@ -590,6 +590,17 @@ def main():
     from veles.znicz_tpu.models.datasets import data_provenance
     extra["data"] = {k: v.get("source", "?")
                      for k, v in data_provenance().items()}
+    # the runtime's own per-step accounting (ISSUE 6 perf ledger,
+    # veles/perf.py): recorded in the same artifact so the bench
+    # arithmetic and the scraped veles_step_* families can be
+    # cross-checked — a walker bug or a dispatch path that skips the
+    # ledger shows up as a visible disagreement here
+    from veles import telemetry as _telemetry
+    _reg = _telemetry.get_registry()
+    extra["runtime_step_flops_total"] = int(
+        _reg.counter_total("veles_step_flops_total"))
+    extra["runtime_step_bytes_total"] = int(
+        _reg.counter_total("veles_step_bytes_total"))
     print(json.dumps({
         "metric": "mnist_train_steps_per_sec",
         "value": round(fast_median, 2),

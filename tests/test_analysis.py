@@ -643,6 +643,58 @@ def label_it(fam, kind):
                     select=["telemetry-hygiene"]) == []
 
 
+def test_telemetry_hygiene_identity_span_name_fires(tmp_path):
+    # the tracing twin of identity labels (ISSUE 6 satellite): a span
+    # NAME minted per request is unbounded name cardinality — every
+    # formatted spelling must fire, across receiver shapes
+    src = """\
+from veles import telemetry
+
+
+def serve(tracer, job_id, token):
+    with telemetry.span("job-%s" % job_id):
+        pass
+    with tracer.span(f"req.{token}"):
+        pass
+    telemetry.tracer.add_complete("j.{}".format(job_id), 0.0, 1.0)
+"""
+    findings = lint_src(tmp_path, src, select=["telemetry-hygiene"])
+    assert rule_ids(findings) == ["telemetry-hygiene"] * 3
+    assert "span name" in findings[0].message
+
+
+def test_telemetry_hygiene_span_identity_in_args_quiet(tmp_path):
+    # the sanctioned spelling: constant name, identity in the ARGS —
+    # and non-identity formatting (unit/kind names) stays legal
+    src = """\
+from veles import telemetry
+
+
+def serve(tracer, job_id, kind):
+    with telemetry.span("job.serve", job_id=job_id):
+        pass
+    telemetry.tracer.add_complete("xla.dispatch.%s" % kind, 0.0, 1.0)
+
+
+class Unit:
+    def run(self, tracer):
+        tracer.add_complete("%s.run" % self.name, 0.0, 1.0)
+"""
+    assert lint_src(tmp_path, src,
+                    select=["telemetry-hygiene"]) == []
+
+
+def test_telemetry_hygiene_span_rule_ignores_foreign_span(tmp_path):
+    # .span on a non-telemetry receiver (e.g. a regex Match.span or a
+    # geometry object) must not fire, whatever the argument looks like
+    src = """\
+def shape(layout, col_id):
+    return layout.span("cell-%s" % col_id)
+"""
+    assert lint_src(tmp_path, src,
+                    select=["telemetry-hygiene"]) == []
+
+
 # -- thread-lifecycle --------------------------------------------------
 
 

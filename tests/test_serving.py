@@ -461,6 +461,66 @@ def test_http_error_paths(mnist_artifact):
         reg.close()
 
 
+def test_traceparent_propagation_and_debug_endpoints(mnist_artifact):
+    """ISSUE 6 serving leg: a predict carrying a W3C traceparent gets
+    the SAME trace echoed on the response, its queue wait and the
+    batched execution appear as spans of that trace in the flight
+    recorder, and the frontend serves /debug/trace + /debug/events."""
+    from veles import telemetry
+    from veles.serving import ModelRegistry
+    from veles.serving.frontend import ServingFrontend
+    reg = ModelRegistry(backend="numpy", max_wait_ms=1.0)
+    front = None
+    try:
+        reg.load("mnist", mnist_artifact["archive"])
+        front = ServingFrontend(reg, port=0)
+        base = "http://127.0.0.1:%d" % front.port
+        ctx = telemetry.TraceContext.new()
+        req = urllib.request.Request(
+            base + "/v1/predict",
+            json.dumps({"model": "mnist",
+                        "inputs": [mnist_artifact["x"][0].tolist()]}
+                       ).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "traceparent": ctx.to_traceparent()})
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            assert resp.status == 200
+            echoed = resp.headers.get("traceparent")
+        assert echoed == ctx.to_traceparent()
+
+        # a request WITHOUT the header mints a fresh context
+        req2 = urllib.request.Request(
+            base + "/v1/predict",
+            json.dumps({"model": "mnist",
+                        "inputs": [mnist_artifact["x"][1].tolist()]}
+                       ).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=15) as resp:
+            minted = resp.headers.get("traceparent")
+        assert minted and minted != echoed
+        from veles.telemetry import TraceContext
+        assert TraceContext.from_traceparent(minted) is not None
+
+        # flight recorder (never telemetry.tracer.start()ed) holds
+        # the request's spans under ITS trace_id
+        doc = _get(base + "/debug/trace")
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        mine = [e for e in spans
+                if e.get("args", {}).get("trace_id") == ctx.trace_id]
+        names = {e["name"] for e in mine}
+        assert "http.predict" in names, sorted(names)
+        assert "serving.queue" in names, sorted(names)
+        assert any(e["name"] == "serving.execute" for e in spans)
+        events_doc = _get(base + "/debug/events")
+        assert "events" in events_doc
+    finally:
+        if front is not None:
+            front.close()
+        reg.close()
+
+
 def test_web_status_surfaces_serving_metrics(mnist_artifact):
     from veles.serving import ModelRegistry
     from veles.serving.frontend import ServingFrontend

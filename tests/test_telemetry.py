@@ -157,7 +157,195 @@ def test_prometheus_exposition_parses():
     assert 't_c_total{model="we\\"ird\\\\na<me"} 2' in samples
 
 
+def test_prometheus_escapes_label_values_and_help():
+    """Satellite regression (ISSUE 6): label values carrying every
+    escapable character (backslash, double quote, newline) and HELP
+    text carrying backslash/newline must render per the exposition
+    format — one raw ``"`` in a model name used to be the difference
+    between a scrape and a parser error."""
+    fam = telemetry.counter(
+        "t_esc_total", 'help with \\ backslash\nand newline',
+        ("model",))
+    fam.labels('say "hi"\\now\n!').inc(3)
+    text = telemetry.get_registry().render_prometheus()
+    lines = text.strip().split("\n")
+    help_line = [l for l in lines
+                 if l.startswith("# HELP t_esc_total")][0]
+    assert help_line == ("# HELP t_esc_total help with \\\\ "
+                         "backslash\\nand newline")
+    sample = [l for l in lines if l.startswith("t_esc_total{")][0]
+    assert sample == \
+        't_esc_total{model="say \\"hi\\"\\\\now\\n!"} 3'
+    assert _SAMPLE_RE.match(sample), sample
+    # no raw newline leaked into any line
+    assert all("\n" not in l for l in lines)
+
+
 # -- span tracer -------------------------------------------------------
+
+
+def test_traceparent_round_trip_and_rejects_garbage():
+    ctx = telemetry.TraceContext.new()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    back = telemetry.TraceContext.from_traceparent(
+        ctx.to_traceparent())
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id
+    for bad in (None, "", "xx", "00-short-ff-01",
+                "00-%s-%s-01" % ("g" * 32, "f" * 16)):
+        assert telemetry.TraceContext.from_traceparent(bad) is None
+    assert telemetry.TraceContext.from_wire("not-a-dict") is None
+    wire = telemetry.TraceContext.from_wire(ctx.to_wire())
+    assert wire.trace_id == ctx.trace_id
+
+
+def test_tracer_drop_counter_exported():
+    """Satellite: full-buffer drops are a scraped counter, not just a
+    note buried in the dump's otherData — a scrape can now SEE that a
+    trace window is incomplete."""
+    tr = telemetry.Tracer()
+    tr.max_events = 3
+    tr.start()
+    for _ in range(5):
+        tr.add_complete("e", 0.0, 0.0)
+    reg = telemetry.get_registry()
+    assert reg.counter_total(
+        "veles_trace_dropped_events_total") == 2
+    assert len(tr.events()) == 3
+
+
+def test_flight_recorder_records_while_disabled(tmp_path):
+    """The tentpole's postmortem contract: with the tracer NEVER
+    enabled, spans still land in the bounded ring and flight_doc()
+    serves a parseable Perfetto window of them."""
+    assert not telemetry.tracer.enabled
+    assert telemetry.tracer.active          # flight is on by default
+    with telemetry.span("bg.work", step=1):
+        pass
+    assert telemetry.tracer.events() == []  # full buffer untouched
+    doc = telemetry.tracer.flight_doc()
+    names = [e["name"] for e in doc["traceEvents"]
+             if e["ph"] == "X"]
+    assert "bg.work" in names
+    # the document round-trips as JSON (what /debug/trace serves)
+    doc2 = json.loads(json.dumps(doc))
+    assert doc2["otherData"]["spans"] == str(len(names))
+    # a zero-width window serves nothing
+    assert [e for e in telemetry.tracer.flight_doc(0)["traceEvents"]
+            if e["ph"] == "X"] in ([], )
+
+
+def test_record_event_log_and_absorb_remote():
+    telemetry.record_event("reconnect", name="slave-1", attempt=2)
+    telemetry.record_event("checkpoint_written", name="x", slot="best")
+    events = telemetry.tracer.recent_events()
+    assert [e["event"] for e in events[-2:]] == \
+        ["reconnect", "checkpoint_written"]
+    assert telemetry.tracer.recent_events(limit=1)[0]["event"] == \
+        "checkpoint_written"
+    # remote spans merge wall-anchored, with a named track; malformed
+    # entries are skipped, not fatal
+    import time as _time
+    n = telemetry.tracer.absorb_remote([
+        {"name": "slave.compute", "wall": _time.time(), "dur": 0.01,
+         "pid": 4242, "tid": 7, "args": {"trace_id": "t" * 32}},
+        {"garbage": True},
+    ], process_name="slave:far")
+    assert n == 1
+    doc = telemetry.tracer.flight_doc()
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert any(e["name"] == "slave.compute" and e["pid"] == 4242
+               for e in spans)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["args"]["name"] == "slave:far" for e in meta)
+
+
+def test_debug_endpoints_on_web_status_and_cli(tmp_path):
+    """GET /debug/trace and /debug/events on a live dashboard return
+    parseable payloads, and ``velescli debug`` drives them end to
+    end (table + saved Perfetto file; exit 2 on a dead endpoint)."""
+    from veles.web_status import WebStatus
+    from veles.__main__ import debug_main
+    with telemetry.span("live.span", job=1):
+        pass
+    telemetry.record_event("fault", kind="drops", n=1)
+    ws = WebStatus(port=0)
+    try:
+        base = "http://127.0.0.1:%d" % ws.port
+        with urllib.request.urlopen(base + "/debug/trace?window=60",
+                                    timeout=10) as resp:
+            doc = json.load(resp)
+        assert doc["otherData"]["window_s"] == "60"
+        assert any(e["name"] == "live.span"
+                   for e in doc["traceEvents"] if e["ph"] == "X")
+        with urllib.request.urlopen(base + "/debug/events",
+                                    timeout=10) as resp:
+            events = json.load(resp)["events"]
+        assert any(e["event"] == "fault" for e in events)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/debug/nope", timeout=10)
+        assert err.value.code == 404
+        out = str(tmp_path / "window.json")
+        assert debug_main([base, "--trace-out", out]) == 0
+        with open(out) as f:
+            saved = json.load(f)
+        assert any(e["name"] == "live.span"
+                   for e in saved["traceEvents"] if e["ph"] == "X")
+    finally:
+        ws.close()
+    assert debug_main(["http://127.0.0.1:1"]) == 2
+
+
+def test_debug_cli_exits_2_on_misshaped_200():
+    """A 200 answer that is not the /debug payload shape (array
+    instead of object, wrong value types) exits 2 — never a
+    traceback (same contract as the checkpoints CLI)."""
+    import http.server
+    import threading
+    from veles.__main__ import debug_main
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b'["not", "the", "shape"]'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        rc = debug_main(["http://127.0.0.1:%d"
+                         % srv.server_address[1]])
+        assert rc == 2
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_flight_doc_reports_ring_coverage():
+    """Under span pressure the bounded ring holds less than the
+    requested window; flight_doc must say so (covered_s +
+    ring_evicted) instead of silently truncating."""
+    import time as _time
+    tr = telemetry.Tracer()
+    tr.flight_max_events = 8
+    tr._ring = __import__("collections").deque(maxlen=8)
+    now = _time.perf_counter()
+    for i in range(20):
+        tr.add_complete("e%d" % (i % 2), now + i * 1e-6, 0.0)
+    doc = tr.flight_doc(window=600)
+    other = doc["otherData"]
+    assert other["ring_evicted"] == "12"
+    assert int(other["spans"]) == 8
+    assert float(other["covered_s"]) <= 600.0
 
 
 def test_trace_file_is_valid_chrome_trace(tmp_path):
@@ -450,6 +638,93 @@ def test_jsonl_handler_serializes_exc_info(tmp_path):
     assert 0 < exc_row["t"] <= plain_row["t"]
 
 
+def test_distributed_trace_merges_three_processes(tmp_path):
+    """ISSUE 6 acceptance: a 2-slave training run with ``--trace-out``
+    on the master produces ONE Perfetto file in which at least one
+    job's dispatch, wire, slave-compute and merge spans share one
+    trace_id across three real processes (master + 2 slaves), with
+    per-process track names."""
+    import socket
+    import subprocess
+    import threading
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    trace = str(tmp_path / "cluster.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    # enough jobs (10/epoch x 3) that BOTH slaves serve some even if
+    # one's interpreter start lags the other by a few seconds — with
+    # a handful of jobs the early slave drains the whole run alone
+    # and the merged trace shows only two pids
+    overrides = [
+        os.path.join(REPO, "veles/znicz_tpu/models/mnist.py"),
+        "root.mnist.decision.max_epochs=3",
+        "root.mnist.loader.n_train=400",
+        "root.mnist.loader.n_valid=100",
+        "root.mnist.loader.minibatch_size=50",
+        "-d", "numpy", "--no-stats", "--seed", "11",
+    ]
+    cli = [sys.executable, "-m", "veles"]
+    master = subprocess.Popen(
+        cli + overrides + ["--listen-address", "127.0.0.1:%d" % port,
+                           "--trace-out", trace],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    slaves = [subprocess.Popen(
+        cli + overrides + ["--master-address",
+                           "127.0.0.1:%d" % port,
+                           "--slave-retries", "60"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for _ in range(2)]
+
+    def _drain(proc, sink):
+        sink.append(proc.communicate()[0])
+
+    outs = {p: [] for p in [master] + slaves}
+    threads = [threading.Thread(target=_drain, args=(p, outs[p]))
+               for p in [master] + slaves]
+    for t in threads:
+        t.start()
+    try:
+        master.wait(timeout=420)
+        for p in slaves:
+            p.wait(timeout=120)
+    finally:
+        for p in [master] + slaves:
+            if p.poll() is None:
+                p.kill()
+    for t in threads:
+        t.join(timeout=30)
+    assert master.returncode == 0, outs[master]
+    with open(trace) as f:
+        doc = json.load(f)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    pids = {e["pid"] for e in spans}
+    assert len(pids) >= 3, "expected master+2 slave pids, got %s" % pids
+    track_names = {e["args"]["name"] for e in meta
+                   if e["name"] == "process_name"}
+    assert "master" in track_names, track_names
+    assert any(n.startswith("slave") for n in track_names), track_names
+    by_trace = {}
+    for e in spans:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    want = {"job.dispatch", "job.wire", "slave.compute", "job.merge"}
+    full = [evs for evs in by_trace.values()
+            if want <= {e["name"] for e in evs}]
+    assert full, "no job with the full causal chain: %s" % sorted(
+        {e["name"] for evs in by_trace.values() for e in evs})
+    # the chain genuinely crosses the process boundary
+    chain = full[0]
+    master_pid = next(e["pid"] for e in chain
+                      if e["name"] == "job.dispatch")
+    slave_pid = next(e["pid"] for e in chain
+                     if e["name"] == "slave.compute")
+    assert master_pid != slave_pid
+
+
 # -- CLI acceptance: --trace-out on a sample run -----------------------
 
 
@@ -471,10 +746,13 @@ def test_velescli_trace_out(tmp_path):
     assert "trace -> %s" % trace in r.stdout
     with open(trace) as f:
         doc = json.load(f)
-    events = doc["traceEvents"]
+    # span events plus ph="M" process_name metadata (the launcher
+    # names this pid's track — ISSUE 6 per-process track names)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
     assert events, "empty trace"
+    assert any(e["name"] == "process_name" for e in meta)
     for e in events:
-        assert e["ph"] == "X"
         assert isinstance(e["ts"], (int, float))
         assert isinstance(e["dur"], (int, float))
     names = {e["name"] for e in events}

@@ -15,7 +15,7 @@ Re-design of ``velescli.py`` = ``veles/__main__.py`` [U] (SURVEY.md
   ``--workflow-graph`` dumps graphviz, ``--result-file`` writes the
   run's metric history as JSON.
 
-Three subcommands live OUTSIDE the workflow shape:
+Four subcommands live OUTSIDE the workflow shape:
 
     python -m veles serve --model NAME=ARCHIVE_DIR [...]
 
@@ -31,7 +31,15 @@ legacy per blob) before an operator trusts it with ``--snapshot auto``;
 
 runs the zlint static-analysis gate (``veles/analysis/``: tracer
 purity, lock order, checkpoint completeness, telemetry hygiene,
-thread lifecycle) — exit 0 clean / 1 findings / 2 usage.
+thread lifecycle) — exit 0 clean / 1 findings / 2 usage;
+
+    python -m veles debug http://host:port [--trace-out t.json]
+
+pulls the flight-recorder postmortem surfaces (``/debug/events``,
+``/debug/trace``) off a LIVE web-status dashboard or serving
+frontend — recent structured events printed as a table, the retained
+span window written as Perfetto JSON. Works on a degraded cluster
+that was never started with ``--trace-out``.
 """
 
 import argparse
@@ -558,6 +566,90 @@ def checkpoints_main(argv):
     return 1 if any(r["status"] == "corrupt" for r in rows) else 0
 
 
+def debug_main(argv):
+    """``velescli debug <url>``: fetch the flight-recorder surfaces
+    of a live process — ``/debug/events`` printed as a table (or
+    ``--json``), ``/debug/trace`` optionally saved as Perfetto JSON
+    (``--trace-out``). Exit 0 on success, 2 when the endpoint is
+    unreachable or answers garbage."""
+    import time as _time
+    import urllib.request
+    p = argparse.ArgumentParser(
+        prog="velescli debug",
+        description="Postmortem view of a live master/serving "
+                    "process via its /debug endpoints")
+    p.add_argument("url",
+                   help="base URL of a --web-status dashboard or "
+                        "serving frontend (http://host:port)")
+    p.add_argument("--window", type=float, default=None,
+                   metavar="SECS",
+                   help="trace window to fetch (default: the "
+                        "recorder's full retained window)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the Perfetto JSON trace window here "
+                        "(load in ui.perfetto.dev)")
+    p.add_argument("--json", action="store_true",
+                   help="print raw events JSON instead of the table")
+    args = p.parse_args(argv)
+    base = args.url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    trace_url = base + "/debug/trace"
+    if args.window is not None:
+        trace_url += "?window=%g" % args.window
+    try:
+        with urllib.request.urlopen(base + "/debug/events",
+                                    timeout=10) as resp:
+            events = json.load(resp)["events"]
+        with urllib.request.urlopen(trace_url, timeout=10) as resp:
+            trace = json.load(resp)
+        # shape validation INSIDE the guard: a 200 from something
+        # that is not a veles debug surface (JSON array, wrong value
+        # types) must exit 2 like any other non-store answer — the
+        # same contract the checkpoints CLI hardened in PR 4
+        if not isinstance(events, list) \
+                or not all(isinstance(e, dict)
+                           and isinstance(e.get("wall", 0.0),
+                                          (int, float))
+                           for e in events) \
+                or not isinstance(trace, dict) \
+                or not isinstance(trace.get("traceEvents", []), list) \
+                or not all(isinstance(e, dict)
+                           for e in trace.get("traceEvents", [])):
+            raise ValueError("endpoint answered 200 but not the "
+                             "/debug payload shape")
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        # unreachable endpoint / non-debug server answering HTML or
+        # mis-shaped JSON: distinct exit, never a traceback
+        print("error: %s: %s" % (type(exc).__name__, exc),
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(events, indent=2))
+    else:
+        print("%-12s %-20s %s" % ("AGE(s)", "EVENT", "FIELDS"))
+        now = _time.time()
+        for ev in events:
+            fields = " ".join(
+                "%s=%s" % (k, v) for k, v in sorted(ev.items())
+                if k not in ("wall", "event"))
+            print("%-12s %-20s %s"
+                  % (round(now - ev.get("wall", now), 1),
+                     ev.get("event", "?"), fields))
+        print("%d event(s)" % len(events))
+    spans = sum(1 for e in trace.get("traceEvents", ())
+                if e.get("ph") == "X")
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            json.dump(trace, f)
+        print("trace window (%d span(s)) -> %s"
+              % (spans, args.trace_out))
+    else:
+        print("trace window holds %d span(s); re-run with "
+              "--trace-out PATH to save the Perfetto JSON" % spans)
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
@@ -575,6 +667,10 @@ def main(argv=None):
         # runs the same engine over the whole package
         from veles.analysis.cli import lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] == "debug":
+        # flight-recorder postmortem: /debug/events + /debug/trace
+        # off a live web-status or serving endpoint
+        return debug_main(argv[1:])
     m = Main(argv)
     if getattr(m.args, "background", False):
         if not daemonize(m.args.log_file):

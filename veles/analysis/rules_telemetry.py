@@ -13,7 +13,13 @@ runtime:
   variable create a new child per value; Prometheus series are
   forever, so identity-labelled series grow without bound (the
   cluster aggregation path deliberately bounds its ``slave`` label
-  via per-token TTL eviction — see ``MasterServer._tele_states``).
+  via per-token TTL eviction — see ``MasterServer._tele_states``);
+* **span names minted from identities** — the tracing twin of the
+  label failure mode: ``tracer.span("job-%s" % job_id)`` turns every
+  request into its own timeline row (Perfetto groups by name) and an
+  unbounded name universe in any aggregating backend. The identity
+  belongs in the span's ``args`` (``span("job.serve",
+  job_id=job_id)``), where it is per-event payload, not cardinality.
 """
 
 import ast
@@ -85,29 +91,74 @@ def _loop_spans(tree):
     return spans
 
 
+def _has_identity(node):
+    """True when the expression involves an identity-shaped value: a
+    call to an id/uuid/token factory, or a name ending in
+    ``_id``/named ``uuid``/``token``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if fname in _IDENTITY_CALLS:
+                return True
+        elif isinstance(sub, (ast.Name, ast.Attribute)):
+            n = (sub.id if isinstance(sub, ast.Name)
+                 else sub.attr).lower()
+            if n.endswith("_id") or n in ("uuid", "token"):
+                return True
+    return False
+
+
 def _identity_labelled(node):
     """True when a ``.labels(...)`` call passes an identity-shaped
-    value: a call to an id/uuid/token factory, or a name ending in
-    ``_id``/named ``uuid``/``token``."""
-    for arg in list(node.args) + [kw.value for kw in node.keywords]:
-        for sub in ast.walk(arg):
-            if isinstance(sub, ast.Call):
-                f = sub.func
-                fname = f.attr if isinstance(f, ast.Attribute) else (
-                    f.id if isinstance(f, ast.Name) else "")
-                if fname in _IDENTITY_CALLS:
-                    return True
-            elif isinstance(sub, (ast.Name, ast.Attribute)):
-                n = (sub.id if isinstance(sub, ast.Name)
-                     else sub.attr).lower()
-                if n.endswith("_id") or n in ("uuid", "token"):
-                    return True
+    value."""
+    return any(_has_identity(arg) for arg in
+               list(node.args) + [kw.value for kw in node.keywords])
+
+
+def _is_span_call(node):
+    """``*.span(name, ...)`` / ``*.add_complete(name, ...)`` calls on
+    a telemetry/tracer-shaped receiver — ``telemetry.span(...)``,
+    ``tracer.span(...)``, ``telemetry.tracer.add_complete(...)``, a
+    ``self._tracer``-style attribute. Receiver-shape matching keeps
+    unrelated ``.span`` methods out."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) \
+            or fn.attr not in ("span", "add_complete") \
+            or not node.args:
+        return False
+    base = fn.value
+    if isinstance(base, ast.Name):
+        name = base.id.lower()
+        return name == "telemetry" or "tracer" in name
+    if isinstance(base, ast.Attribute):
+        return "tracer" in base.attr.lower()
     return False
+
+
+def _formatted_identity(node):
+    """True when a string-building expression (``%``, f-string,
+    ``.format``, ``+``) interpolates an identity-shaped value."""
+    operands = []
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        operands.append(node.right)
+    elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        operands.extend((node.left, node.right))
+    elif isinstance(node, ast.JoinedStr):
+        operands.extend(v.value for v in node.values
+                        if isinstance(v, ast.FormattedValue))
+    elif isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "format":
+        operands.extend(node.args)
+        operands.extend(kw.value for kw in node.keywords)
+    return any(_has_identity(op) for op in operands)
 
 
 @register("telemetry-hygiene", "error",
           "no instrument creation in loops; no unbounded identity "
-          "label values")
+          "label values or span names")
 def check_telemetry_hygiene(project):
     findings = []
     for mod in project.modules:
@@ -142,4 +193,16 @@ def check_telemetry_hygiene(project):
                     "label by a bounded dimension (kind, model, "
                     "unit name); aggregate identities before "
                     "labelling or bound them with TTL eviction"))
+            if _is_span_call(node) \
+                    and _formatted_identity(node.args[0]):
+                findings.append(Finding(
+                    mod.relpath, node.lineno, "telemetry-hygiene",
+                    "error",
+                    "span name minted from a per-request identity "
+                    "(id/uuid/token/pid) — every request becomes its "
+                    "own timeline row / unbounded name cardinality "
+                    "(same failure mode as identity label values)",
+                    "use a constant span name and carry the identity "
+                    "in the span args: span(\"job.serve\", "
+                    "job_id=job_id)"))
     return findings

@@ -22,6 +22,7 @@ master's ``slave_timeout`` measures actual silence, not compute time,
 and the slave sees lease revocation early.
 """
 
+import os
 import random
 import socket
 import threading
@@ -215,6 +216,9 @@ class SlaveClient(Logger):
         if resp == ("stale",):
             self.stale_resyncs += 1
             self._tele["stale"].get().inc()
+            telemetry.record_event(
+                "lease_stale", request=str(request[0]),
+                slave=self.slave_id)
             raise StaleLease(
                 "master fenced %r for slave %s — lease %s revoked"
                 % (request[0], self.slave_id, self.lease_id))
@@ -233,8 +237,19 @@ class SlaveClient(Logger):
             raise ProtocolDesync(
                 "expected a job, got %r" % (resp[:1],))
         _, payload, job_id, epoch = resp[:4]
+        # the master-minted trace context (5th element; absent from a
+        # pre-ISSUE-6 master): every phase span below joins that trace
+        ctx = telemetry.TraceContext.from_wire(resp[4]) \
+            if len(resp) > 4 else None
+        spans = []
+        t0 = time.perf_counter()
         self.registry.apply_job(payload)
+        t1 = time.perf_counter()
+        self._job_span(spans, ctx, "slave.apply", t0, t1 - t0, job_id)
         self._run_iteration()
+        t2 = time.perf_counter()
+        self._job_span(spans, ctx, "slave.compute", t1, t2 - t1,
+                       job_id)
         # count the job BEFORE building the pushed state: the state
         # rides the update that completes this very job, so the master
         # sees N jobs after N accepted updates (post-ack counting
@@ -245,9 +260,16 @@ class SlaveClient(Logger):
         # exact on the fault-free one.
         self._tele["jobs"].get().inc()
         update = self.registry.generate_update()
-        tele = self._telemetry_state()
-        if tele:
-            update["__telemetry__"] = tele
+        t3 = time.perf_counter()
+        self._job_span(spans, ctx, "slave.update_build", t2, t3 - t2,
+                       job_id)
+        tele = self._telemetry_state() or {"token": self._push_token}
+        # total job wall time: what the master subtracts from its
+        # serve→update round-trip to attribute the WIRE portion
+        tele["job_seconds"] = t3 - t0
+        if spans:
+            tele["spans"] = spans
+        update["__telemetry__"] = tele
         ok = self._roundtrip(
             ("update", self.slave_id, self.lease_id, job_id, epoch,
              update))
@@ -255,6 +277,21 @@ class SlaveClient(Logger):
             raise ProtocolDesync("expected ok, got %r" % (ok[:1],))
         self.jobs_done += 1
         return True
+
+    def _job_span(self, spans, ctx, name, start, duration, job_id):
+        """Append one completed job-phase span to the SHIPPED list
+        (wall-clock anchored so the master can merge it into its own
+        timeline). Not recorded into the local tracer: the master's
+        absorb is the single recording point, so a co-located
+        master+slave pair (shared tracer) never sees duplicates."""
+        args = {"job_id": job_id, "slave": self.slave_id}
+        if ctx is not None:
+            args.update(ctx.child().span_args())
+        spans.append({
+            "name": name,
+            "wall": time.time() - (time.perf_counter() - start),
+            "dur": duration, "pid": os.getpid(),
+            "tid": threading.get_ident(), "args": args})
 
     def _telemetry_state(self):
         """The ABSOLUTE counter state pushed on each update — what
@@ -363,6 +400,8 @@ class SlaveClient(Logger):
         self.slave_id = self.lease_id = None
         self.reconnects += 1
         self._tele["reconnects"].get().inc()
+        telemetry.record_event("reconnect", name=self.name,
+                               attempt=attempt)
         # interruptible backoff: a preempted slave must exit now, not
         # after its reconnect sleep runs out
         self._stop.wait(self._backoff(attempt))

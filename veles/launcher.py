@@ -86,6 +86,11 @@ class Launcher(Logger):
 
     def initialize(self, workflow, **kwargs):
         self.workflow = workflow
+        # name this pid's track in span dumps: a merged cluster trace
+        # (master absorbing slave spans) reads as roles, not pids
+        from veles import telemetry
+        telemetry.tracer.set_process_name(
+            self.mode if self.mode != "standalone" else workflow.name)
         if self.mode == "slave":
             workflow.is_slave = True
         # master holds weights but never computes: numpy device is

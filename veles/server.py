@@ -10,9 +10,13 @@ length-prefixed pickle frames is sufficient and dependency-free.
 Protocol (client-initiated, synchronous per connection):
 
 * ``("hello", name)``       → ``("welcome", slave_id, lease_id)``
-* ``("job", sid, lease)``   → ``("job", payload, job_id, epoch)`` |
-                              ``("wait",)`` | ``("bye",)`` |
-                              ``("stale",)``
+* ``("job", sid, lease)``   → ``("job", payload, job_id, epoch,
+                              trace)`` | ``("wait",)`` | ``("bye",)``
+                              | ``("stale",)`` — ``trace`` is the
+                              job's minted W3C-style trace context
+                              (``TraceContext.to_wire``); pre-ISSUE-6
+                              clients unpack ``resp[:4]`` and ignore
+                              it
 * ``("update", sid, lease, job_id, epoch, data)``
                             → ``("ok",)`` | ``("stale",)``
 * ``("ping", sid, lease)``  → ``("pong", epoch)`` | ``("stale",)``
@@ -87,10 +91,28 @@ def require_secret_for(host, role):
             "the same random value on every node." % (role, host))
 
 
+#: per-frame wire overhead: 4-byte length header + 32-byte HMAC tag
+_FRAME_OVERHEAD = 36
+
+#: process-level wire accounting (`veles_wire_bytes_total`): the
+#: honest scraped view of what the protocol moves — the
+#: grad_sync_bytes_per_step plateau (ROADMAP item 3) as a first-class
+#: metric instead of a bench-only number
+_WIRE_TX = telemetry.LazyChild(lambda: telemetry.counter(
+    "veles_wire_bytes_total",
+    "Bytes moved over the framed master/slave protocol by direction "
+    "(payload + length header + auth tag)", ("direction",)).labels("tx"))
+_WIRE_RX = telemetry.LazyChild(lambda: telemetry.counter(
+    "veles_wire_bytes_total",
+    "Bytes moved over the framed master/slave protocol by direction "
+    "(payload + length header + auth tag)", ("direction",)).labels("rx"))
+
+
 def send_frame(sock, obj):
     blob = pickle.dumps(obj, protocol=4)
     tag = hmac.new(_secret(), blob, hashlib.sha256).digest()
     sock.sendall(struct.pack(">I", len(blob)) + tag + blob)
+    _WIRE_TX.get().inc(len(blob) + _FRAME_OVERHEAD)
 
 
 #: The length header arrives BEFORE authentication, so it must not be
@@ -119,6 +141,7 @@ def recv_frame(sock):
         raise ConnectionError(
             "frame failed HMAC authentication (cluster secret mismatch "
             "or untrusted peer) — refusing to deserialize")
+    _WIRE_RX.get().inc(size + _FRAME_OVERHEAD)
     return pickle.loads(blob)
 
 
@@ -448,6 +471,10 @@ class MasterServer(Logger):
             "veles_cluster_faults_total",
             "Cluster degradation/recovery events by kind",
             ("kind",)).labels(kind).inc(n)
+        if kind != "joins":
+            # flight-recorder log: a postmortem on a degraded cluster
+            # needs WHEN each fence/drop happened, not just how many
+            telemetry.record_event("fault", kind=kind, n=n)
 
     def _set_slaves_gauge(self):
         telemetry.gauge(
@@ -528,10 +555,17 @@ class MasterServer(Logger):
                 lease = secrets.token_hex(8)
                 self.slaves[slave_id] = {
                     "name": request[1], "jobs": 0, "lease": lease,
-                    "outstanding": set(),
-                    "last_seen": time.monotonic()}
+                    # job_id -> {trace, wall, perf} of the serve
+                    # moment: the fencing set AND the per-hop latency
+                    # anchor (wire round-trip = update arrival - wall)
+                    "outstanding": {},
+                    "last_seen": time.monotonic(),
+                    "last_rtt_s": None, "last_job_s": None,
+                    "last_wire_s": None}
                 self._count_fault("joins")
                 self._set_slaves_gauge()
+                telemetry.record_event("slave_joined", slave=slave_id,
+                                       name=str(request[1]))
                 self.info("slave %d (%s) joined, lease %s",
                           slave_id, request[1], lease)
                 return ("welcome", slave_id, lease)
@@ -544,6 +578,7 @@ class MasterServer(Logger):
             if kind == "job":
                 if self.done.is_set():
                     return ("bye",)
+                t_serve = time.perf_counter()
                 slave_id, info = self._live_slave(request)
                 if info is None:
                     # never-helloed or dropped: serving it a job would
@@ -563,8 +598,21 @@ class MasterServer(Logger):
                 job_id = self._next_job
                 self._next_job += 1
                 info["jobs"] += 1
-                info["outstanding"].add(job_id)
-                return ("job", job, job_id, self.epoch)
+                # one trace per minibatch job: every hop (dispatch /
+                # wire / slave phases / merge) tags its span with this
+                # context, so the merged dump reads as one timeline
+                ctx = telemetry.TraceContext.new()
+                info["outstanding"][job_id] = {
+                    "trace": ctx, "wall": time.time(),
+                    "perf": t_serve}
+                if telemetry.tracer.active:
+                    telemetry.tracer.add_complete(
+                        "job.dispatch", t_serve,
+                        time.perf_counter() - t_serve,
+                        job_id=job_id, epoch=self.epoch,
+                        slave=slave_id, **ctx.span_args())
+                return ("job", job, job_id, self.epoch,
+                        ctx.to_wire())
             if kind == "update":
                 slave_id, info = self._live_slave(request)
                 if len(request) < 6:       # pre-lease protocol frame
@@ -582,7 +630,7 @@ class MasterServer(Logger):
                         "fenced update from slave %s (job %s, epoch "
                         "%s)", slave_id, job_id, epoch)
                     return ("stale",)
-                info["outstanding"].discard(job_id)
+                served = info["outstanding"].pop(job_id)
                 # slave-pushed telemetry counter state rides the update
                 # frame under a reserved key: pop BEFORE the unit merge
                 # (it is not a unit payload). One scrape of the master
@@ -590,9 +638,41 @@ class MasterServer(Logger):
                 # tagged slave="<id>".
                 tele = data.pop("__telemetry__", None) \
                     if isinstance(data, dict) else None
+                job_seconds = None
                 if tele:
                     self._absorb_telemetry(tele, slave_id)
+                    job_seconds = tele.get("job_seconds")
+                    spans = tele.get("spans")
+                    if spans:
+                        # the slave's per-phase spans, wall-anchored:
+                        # merged here they complete the job's causal
+                        # timeline in THIS process's dump/ring
+                        telemetry.tracer.absorb_remote(
+                            spans,
+                            process_name="slave:%s" % info["name"])
+                # per-hop latency attribution: round-trip measured
+                # here, slave compute self-reported, wire = the rest
+                rtt = time.time() - served["wall"]
+                info["last_rtt_s"] = rtt
+                wire = None
+                if isinstance(job_seconds, (int, float)):
+                    wire = max(rtt - float(job_seconds), 0.0)
+                    info["last_job_s"] = float(job_seconds)
+                    info["last_wire_s"] = wire
+                ctx = served["trace"]
+                t_merge = time.perf_counter()
                 merged = self.registry.apply_update(data, slave_id)
+                if telemetry.tracer.active:
+                    if wire is not None:
+                        telemetry.tracer.add_complete(
+                            "job.wire", served["perf"], wire,
+                            job_id=job_id, slave=slave_id,
+                            **ctx.child().span_args())
+                    telemetry.tracer.add_complete(
+                        "job.merge", t_merge,
+                        time.perf_counter() - t_merge, job_id=job_id,
+                        slave=slave_id, merged=bool(merged),
+                        **ctx.child().span_args())
                 if not merged and data:
                     # the payload named no unit of this workflow — a
                     # config-mismatched peer silently burning jobs is
@@ -632,6 +712,9 @@ class MasterServer(Logger):
             requeued = self.registry.drop_slave(slave_id)
             del self.slaves[slave_id]
             self._set_slaves_gauge()
+            telemetry.record_event(
+                "lease_revoked", slave=slave_id, clean=bool(clean),
+                requeued=requeued)
             if clean and not requeued:
                 self.info("slave %d left cleanly", slave_id)
                 return
@@ -649,13 +732,23 @@ class MasterServer(Logger):
         with self.lock:
             slaves = {}
             for sid, info in self.slaves.items():
-                slaves[str(sid)] = {
+                row = {
                     "name": info["name"], "jobs": info["jobs"],
                     # prefix only: status.json is a dashboard surface,
                     # not a place to hand out whole fencing tokens
                     "lease": info["lease"][:6],
                     "outstanding": len(info["outstanding"]),
                     "idle_s": round(now - info["last_seen"], 3)}
+                # last-job latency attribution (satellite: slow-slave
+                # skew is visible on the dashboard without a trace
+                # fetch): serve→merge round-trip, the slave's self-
+                # reported compute, and the wire remainder
+                for key in ("last_rtt_s", "last_job_s",
+                            "last_wire_s"):
+                    value = info.get(key)
+                    row[key] = None if value is None \
+                        else round(value, 4)
+                slaves[str(sid)] = row
             return {
                 "mode": "master",
                 "epoch": self.epoch,

@@ -44,16 +44,21 @@ class DeadlineExceeded(Exception):
 
 
 class _Request:
-    __slots__ = ("rows", "deadline", "t_enqueue", "event", "result",
-                 "error")
+    __slots__ = ("rows", "deadline", "t_enqueue", "t_perf", "event",
+                 "result", "error", "trace")
 
-    def __init__(self, rows, deadline):
+    def __init__(self, rows, deadline, trace=None):
         self.rows = rows
         self.deadline = deadline
         self.t_enqueue = time.monotonic()
+        # tracer timestamps are perf_counter-based; monotonic is not
+        # guaranteed to share its epoch, so keep a second reading
+        self.t_perf = time.perf_counter()
         self.event = threading.Event()
         self.result = None
         self.error = None
+        #: veles.telemetry.TraceContext of the originating request
+        self.trace = trace
 
 
 class MicroBatcher(Logger):
@@ -121,16 +126,18 @@ class MicroBatcher(Logger):
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, rows, timeout_ms=None):
+    def submit(self, rows, timeout_ms=None, trace=None):
         """Enqueue ``rows`` (n, *sample); -> a wait()able handle.
-        Raises :class:`QueueFull` when the queue is at capacity."""
+        Raises :class:`QueueFull` when the queue is at capacity.
+        ``trace`` tags the request's queue-wait span with the
+        caller's trace context."""
         n = int(rows.shape[0])
         if n < 1 or n > self.max_batch:
             raise ValueError("request rows %d outside [1, %d]"
                              % (n, self.max_batch))
         timeout = (self.default_timeout if timeout_ms is None
                    else float(timeout_ms) / 1000.0)
-        req = _Request(rows, time.monotonic() + timeout)
+        req = _Request(rows, time.monotonic() + timeout, trace=trace)
         with self._lock:
             if not self._running:
                 raise RuntimeError("batcher is closed")
@@ -146,9 +153,9 @@ class MicroBatcher(Logger):
             self._have_work.notify()
         return req
 
-    def predict(self, rows, timeout_ms=None):
+    def predict(self, rows, timeout_ms=None, trace=None):
         """submit + wait; raises DeadlineExceeded / the batch error."""
-        req = self.submit(rows, timeout_ms=timeout_ms)
+        req = self.submit(rows, timeout_ms=timeout_ms, trace=trace)
         req.event.wait(timeout=(req.deadline - time.monotonic())
                        + self.max_wait + 30.0)
         if req.error is not None:
@@ -216,6 +223,7 @@ class MicroBatcher(Logger):
                 continue
             rows = numpy.concatenate([r.rows for r in live], axis=0) \
                 if len(live) > 1 else live[0].rows
+            t_dispatch = time.perf_counter()
             try:
                 outputs, bucket = self._run_batch(rows)
             except Exception as exc:
@@ -227,12 +235,15 @@ class MicroBatcher(Logger):
                     req.event.set()
                 continue
             done = time.monotonic()
+            done_perf = time.perf_counter()
             off = 0
             for req in live:
                 n = req.rows.shape[0]
                 req.result = outputs[off:off + n]
                 off += n
                 req.event.set()
+            if telemetry.tracer.active:
+                self._trace_batch(live, t_dispatch, done_perf, bucket)
             self._c["batches_total"].get().inc()
             self._c["batched_requests_total"].get().inc(len(live))
             self._c["batched_rows_total"].get().inc(rows.shape[0])
@@ -242,6 +253,30 @@ class MicroBatcher(Logger):
                 for req in live:
                     latency.observe(done - req.t_enqueue)
                     self._completions.append(done)
+
+    def _trace_batch(self, live, t_dispatch, done_perf, bucket):
+        """Spans for one dispatched batch: a per-request queue-wait
+        span in each request's own trace, plus ONE execute span for
+        the shared forward (parented on the first traced request —
+        batching is many-to-one by nature; the rest correlate via
+        their queue spans' timeline overlap)."""
+        parent = next((r.trace for r in live if r.trace is not None),
+                      None)
+        args = {"model": self.model, "requests": len(live),
+                "bucket": bucket}
+        if parent is not None:
+            args.update(parent.child().span_args())
+        telemetry.tracer.add_complete(
+            "serving.execute", t_dispatch, done_perf - t_dispatch,
+            **args)
+        for req in live:
+            qargs = {"model": self.model,
+                     "rows": int(req.rows.shape[0])}
+            if req.trace is not None:
+                qargs.update(req.trace.child().span_args())
+            telemetry.tracer.add_complete(
+                "serving.queue", req.t_perf,
+                t_dispatch - req.t_perf, **qargs)
 
     def close(self, zero_gauge=True):
         """``zero_gauge=False`` is for the hot-reload path: the

@@ -18,6 +18,8 @@ imports.
 import threading
 import time
 
+from veles import telemetry
+
 
 def bucket_sizes(max_batch):
     """The power-of-two bucket ladder: 1, 2, 4, ... max_batch."""
@@ -141,6 +143,9 @@ class InferenceEngine:
                 self._device_params,
                 jax.ShapeDtypeStruct(shape, numpy.float32)).compile()
             dt = time.perf_counter() - t0
+            if telemetry.tracer.active:
+                telemetry.tracer.add_complete(
+                    "serving.compile", t0, dt, bucket=shape[0])
             with self._lock:
                 # params are a runtime ARGUMENT of the compiled
                 # program, so a params_only hot reload keeps this

@@ -20,6 +20,14 @@ Endpoints:
 * ``GET  /metrics.json`` — the original JSON view (queue depth,
   batch-fill ratio, p50/p99 latency, requests/s, per model), exact
   pre-registry key shape
+* ``GET  /debug/trace``  — Perfetto JSON of the flight-recorder
+  window (``?window=SECS``); ``GET /debug/events`` — recent
+  structured events. Live postmortem surfaces (``velescli debug``).
+
+Tracing: ``POST /v1/predict`` honours an incoming W3C ``traceparent``
+header (or mints a fresh context) and returns ``traceparent`` on the
+response; the request's queue wait and batched execution are recorded
+as spans of that trace (see ``batcher.py``).
 
 ``register_status(web_status)`` surfaces the same metrics in the
 training dashboard (``web_status.py``) so one page shows both halves
@@ -28,6 +36,7 @@ of a train→serve deployment.
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy
@@ -50,14 +59,16 @@ class ServingFrontend(Logger):
             def log_message(self, *args):
                 pass
 
-            def _reply(self, code, doc):
+            def _reply(self, code, doc, headers=()):
                 self._reply_raw(code, json.dumps(doc).encode(),
-                                "application/json")
+                                "application/json", headers=headers)
 
-            def _reply_raw(self, code, body, ctype):
+            def _reply_raw(self, code, body, ctype, headers=()):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in headers:
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -73,6 +84,12 @@ class ServingFrontend(Logger):
                     self._reply_raw(
                         200, reg.render_prometheus().encode(),
                         reg.CONTENT_TYPE)
+                elif self.path.startswith("/debug/"):
+                    payload = telemetry.debug_endpoint(self.path)
+                    if payload is None:
+                        self._reply(404, {"error": "not found"})
+                    else:
+                        self._reply(200, payload)
                 elif self.path.startswith("/v1/models"):
                     self._reply(200,
                                 {"models": front.registry.describe()})
@@ -83,14 +100,25 @@ class ServingFrontend(Logger):
                 if self.path != "/v1/predict":
                     self._reply(404, {"error": "not found"})
                     return
+                # join the caller's distributed trace, or root a new
+                # one: either way the response names the context so
+                # the caller can correlate
+                trace = telemetry.TraceContext.from_traceparent(
+                    self.headers.get("traceparent"))
+                if trace is None:
+                    trace = telemetry.TraceContext.new()
+                tp_header = (("traceparent", trace.to_traceparent()),)
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     doc = json.loads(self.rfile.read(n))
                 except ValueError:
-                    self._reply(400, {"error": "bad json"})
+                    # the 400 carries the echo too: callers correlate
+                    # failures by the same header as successes
+                    self._reply(400, {"error": "bad json"},
+                                headers=tp_header)
                     return
-                code, reply = front.predict_request(doc)
-                self._reply(code, reply)
+                code, reply = front.predict_request(doc, trace=trace)
+                self._reply(code, reply, headers=tp_header)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
@@ -103,9 +131,24 @@ class ServingFrontend(Logger):
 
     # -- request handling ----------------------------------------------
 
-    def predict_request(self, doc):
+    def predict_request(self, doc, trace=None):
         """-> (http_code, reply_dict); shared by the HTTP handler and
-        tests (no socket needed to exercise the logic)."""
+        tests (no socket needed to exercise the logic). ``trace`` is
+        the request's :class:`veles.telemetry.TraceContext` — threaded
+        through batcher and engine so queue wait and batched execution
+        appear as spans of the caller's trace."""
+        t0 = time.perf_counter()
+        code, reply = self._predict_request(doc, trace)
+        if telemetry.tracer.active:
+            args = {"code": code, "model": str(doc.get("model"))
+                    if isinstance(doc, dict) else "?"}
+            if trace is not None:
+                args.update(trace.span_args())
+            telemetry.tracer.add_complete(
+                "http.predict", t0, time.perf_counter() - t0, **args)
+        return code, reply
+
+    def _predict_request(self, doc, trace):
         try:
             name = doc["model"]
             inputs = numpy.asarray(doc["inputs"], numpy.float32)
@@ -132,7 +175,8 @@ class ServingFrontend(Logger):
             return 400, {"error": "empty inputs"}
         try:
             out = entry.predict(inputs,
-                                timeout_ms=doc.get("timeout_ms"))
+                                timeout_ms=doc.get("timeout_ms"),
+                                trace=trace)
         except QueueFull as exc:
             return 503, {"error": str(exc)}
         except DeadlineExceeded as exc:
@@ -247,6 +291,7 @@ def serve_main(argv=None):
     if unknown:
         raise SystemExit("--checkpoint for unloaded model(s): %s"
                          % ", ".join(unknown))
+    telemetry.tracer.set_process_name("serving")
     registry = ModelRegistry(
         backend=args.backend, max_batch=args.max_batch,
         max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,

@@ -42,8 +42,9 @@ class ServedModel:
         self.version = 1
         self.loaded_at = time.time()
 
-    def predict(self, rows, timeout_ms=None):
-        return self.batcher.predict(rows, timeout_ms=timeout_ms)
+    def predict(self, rows, timeout_ms=None, trace=None):
+        return self.batcher.predict(rows, timeout_ms=timeout_ms,
+                                    trace=trace)
 
     def describe(self):
         return {
@@ -156,6 +157,8 @@ class ModelRegistry(Logger):
                 "veles_serving_refresh_failures_total",
                 "Hot reloads that failed and degraded to the loaded "
                 "version", ("model",)).labels(name).inc()
+            telemetry.record_event("reload_failed", model=name,
+                                   error=str(exc))
             self.warning(
                 "hot reload of %s failed (%s: %s; failure #%d) — "
                 "still serving v%d", name, type(exc).__name__, exc,

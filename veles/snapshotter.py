@@ -637,6 +637,10 @@ def write_checkpoint(store, name, tree, compression="gz", slot="best",
         else:
             counting.write(data)
     _record_write(slot, counting.nbytes, time.perf_counter() - t0)
+    # flight-recorder log entry: postmortems need to know WHICH
+    # checkpoint existed when the cluster degraded
+    telemetry.record_event("checkpoint_written", name=name, slot=slot,
+                           bytes=counting.nbytes)
     return sp.uri, counting.nbytes
 
 

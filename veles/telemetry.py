@@ -38,12 +38,35 @@ events when tracing is enabled (``velescli.py --trace-out PATH``) and
 costs one attribute check when it is not. :meth:`Tracer.dump` writes
 Chrome-trace/Perfetto-loadable JSON (``chrome://tracing`` or
 https://ui.perfetto.dev).
+
+Distributed tracing & flight recorder (ISSUE 6)
+-----------------------------------------------
+
+* :class:`TraceContext` — W3C-traceparent-style ``(trace_id,
+  span_id, parent_id)`` minted per minibatch job / serving request
+  and propagated through the master↔slave pickle frames and the
+  serving frontend→batcher→engine chain; spans tagged with the ids
+  reconstruct one causal timeline across processes.
+* the **flight recorder** — a bounded ring that continuously retains
+  the newest spans (:attr:`Tracer.flight`, on by default) plus a
+  short log of structured operational events
+  (:func:`record_event`: job fenced, lease revoked, checkpoint
+  written, reconnect). ``GET /debug/trace`` / ``GET /debug/events``
+  on web-status and the serving frontend (and ``velescli debug
+  URL``) expose the window from a LIVE process — a postmortem view
+  that needs no restart with tracing enabled.
+* :meth:`Tracer.absorb_remote` merges completed spans a peer shipped
+  over the wire (slaves piggyback them on update frames) into this
+  process's buffers, wall-clock anchored, so the master's
+  ``--trace-out`` dump shows dispatch → wire → slave-compute → merge
+  as one timeline with per-process track names.
 """
 
 import bisect
 import collections
 import json
 import os
+import secrets
 import threading
 import time
 from contextlib import contextmanager
@@ -392,9 +415,13 @@ class Registry:
         """The registry in Prometheus text exposition format 0.0.4."""
         lines = []
         for fam in self.families():
+            # HELP escaping per the 0.0.4 format: backslash and
+            # newline (label VALUES additionally escape the double
+            # quote — see _escape_label)
             lines.append("# HELP %s %s"
                          % (fam.name,
-                            (fam.help or fam.name).replace("\n", " ")))
+                            (fam.help or fam.name)
+                            .replace("\\", "\\\\").replace("\n", "\\n")))
             lines.append("# TYPE %s %s" % (fam.name, fam.kind))
             for items, child in fam.children():
                 if fam.kind in ("counter", "gauge"):
@@ -491,6 +518,79 @@ class LazyChild:
         return self._child
 
 
+# -- trace context -----------------------------------------------------
+
+
+class TraceContext:
+    """W3C-traceparent-style identity of one causal chain.
+
+    ``trace_id`` (32 hex chars) names the whole request/minibatch
+    job; ``span_id`` (16 hex chars) names one hop; ``parent_id`` is
+    the span this one descends from. Contexts ride the master↔slave
+    pickle frames (:meth:`to_wire`) and HTTP ``traceparent`` headers
+    (:meth:`to_traceparent`); spans tagged with :meth:`span_args`
+    can be stitched back into one cross-process timeline."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id, span_id, parent_id=None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    @classmethod
+    def new(cls):
+        return cls(secrets.token_hex(16), secrets.token_hex(8))
+
+    def child(self):
+        """A new span in the SAME trace, parented on this one."""
+        return TraceContext(self.trace_id, secrets.token_hex(8),
+                            self.span_id)
+
+    # -- serialization -------------------------------------------------
+
+    def to_wire(self):
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_wire(cls, doc):
+        """Rebuild from a frame payload; None on anything malformed —
+        a peer speaking an older protocol must not kill the run."""
+        if not isinstance(doc, dict):
+            return None
+        trace_id, span_id = doc.get("trace_id"), doc.get("span_id")
+        if not isinstance(trace_id, str) or not isinstance(span_id, str):
+            return None
+        return cls(trace_id, span_id, doc.get("parent_id"))
+
+    def to_traceparent(self):
+        return "00-%s-%s-01" % (self.trace_id, self.span_id)
+
+    @classmethod
+    def from_traceparent(cls, header):
+        """Parse a ``traceparent`` header; None when malformed."""
+        if not isinstance(header, str):
+            return None
+        parts = header.strip().split("-")
+        if len(parts) != 4:
+            return None
+        _, trace_id, span_id, _ = parts
+        if len(trace_id) != 32 or len(span_id) != 16:
+            return None
+        try:
+            int(trace_id, 16), int(span_id, 16)
+        except ValueError:
+            return None
+        return cls(trace_id, span_id)
+
+    def span_args(self):
+        """The ids as span ``args`` (what links events in the dump)."""
+        out = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            out["parent_id"] = self.parent_id
+        return out
+
+
 # -- span tracer -------------------------------------------------------
 
 
@@ -534,28 +634,75 @@ def _jsonable(v):
 class Tracer:
     """Wall-time span recorder dumping Chrome-trace JSON.
 
-    Disabled by default: ``span()`` then returns a shared no-op
-    context manager and ``add_complete`` is guarded by callers with
-    ``if tracer.enabled`` (one attribute check on the hot path)."""
+    Two recording surfaces share one ``add_complete`` entry point:
 
-    #: event-buffer cap (~200MB of dicts; multi-GB traces don't load
-    #: in chrome://tracing anyway). Oldest events are dropped first —
-    #: for a crash postmortem the tail is what matters — and the drop
-    #: count is recorded in the dump's otherData.
+    * the **full-run buffer** (``enabled``, off by default) — every
+      span since :meth:`start`, dumped by ``--trace-out``;
+    * the **flight recorder** (``flight``, ON by default) — a bounded
+      ring of the newest spans, readable any time via
+      :meth:`flight_doc` (``GET /debug/trace``). Always-on postmortem
+      coverage for a live cluster at the cost of one dict build +
+      ring append per span.
+
+    Callers guard hot paths with ``if tracer.active`` (one attribute
+    read); ``span()`` returns a shared no-op context manager when
+    neither surface records."""
+
+    #: full-run event-buffer cap (~200MB of dicts; multi-GB traces
+    #: don't load in chrome://tracing anyway). Oldest events are
+    #: dropped first — for a crash postmortem the tail is what
+    #: matters — and the drop count lands in the dump's otherData AND
+    #: the veles_trace_dropped_events_total counter, so a scrape can
+    #: see that a trace window is incomplete.
     max_events = 1_000_000
+    #: flight-recorder ring cap (newest spans win)
+    flight_max_events = 16384
+    #: default time window flight_doc() serves
+    flight_window = 300.0
+    #: structured operational events retained (record_event)
+    max_log_events = 1024
 
     def __init__(self):
         self.enabled = False
+        #: continuous bounded-ring recording (the flight recorder);
+        #: on by default — this is what makes /debug/trace useful on
+        #: a cluster that was never started with tracing
+        self.flight = True
         self._lock = threading.Lock()
         self._events = collections.deque()
+        self._ring = collections.deque(maxlen=self.flight_max_events)
+        self._log = collections.deque(maxlen=self.max_log_events)
         self._dropped = 0
-        self._t0 = 0.0
+        # ring WRAP is normal operation (bounded window by design),
+        # so it is counted separately from full-buffer drops and
+        # reported as coverage honesty in flight_doc, not as the
+        # scraped incomplete-trace counter
+        self._ring_evicted = 0
+        # one (perf_counter, wall) anchor pair: every event's ts is
+        # perf-based (monotonic), and wall = _wall0 + (perf - _t0)
+        # is what lets spans from DIFFERENT processes merge onto one
+        # timeline (NTP-level skew applies)
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._proc_names = {}
+        self._drop_counter = LazyChild(lambda: counter(
+            "veles_trace_dropped_events_total",
+            "Span events dropped from the tracer's bounded buffers "
+            "(a growing count means trace windows are incomplete)"))
+
+    @property
+    def active(self):
+        """True when add_complete records ANYTHING (full buffer or
+        flight ring) — the one cheap guard for instrumentation sites
+        that do extra work to build a span."""
+        return self.enabled or self.flight
 
     def start(self):
         with self._lock:
             self._events = collections.deque()
             self._dropped = 0
             self._t0 = time.perf_counter()
+            self._wall0 = time.time()
             self.enabled = True
 
     def stop(self):
@@ -564,17 +711,29 @@ class Tracer:
     def clear(self):
         with self._lock:
             self._events = collections.deque()
+            self._ring.clear()
+            self._log.clear()
+            self._proc_names.clear()
             self._dropped = 0
+            self._ring_evicted = 0
+
+    def set_process_name(self, name, pid=None):
+        """Name a pid's track in the dumps (Chrome ``process_name``
+        metadata). Used for "master" / "slave:N" / "serving" so the
+        merged cluster timeline reads as processes, not pids."""
+        with self._lock:
+            self._proc_names[int(pid if pid is not None
+                                 else os.getpid())] = str(name)
 
     def span(self, name, **args):
-        if not self.enabled:
+        if not (self.enabled or self.flight):
             return _NULL_SPAN
         return _Span(self, name, args)
 
     def add_complete(self, name, start, duration, **args):
         """Record one complete ('ph: X') event; ``start`` is a
         ``time.perf_counter()`` reading, ``duration`` seconds."""
-        if not self.enabled:
+        if not (self.enabled or self.flight):
             return
         ev = {
             "name": name,
@@ -586,20 +745,144 @@ class Tracer:
         }
         if args:
             ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+        self._record(ev, self._wall0 + (start - self._t0))
+
+    def _record(self, ev, wall):
+        dropped = False
         with self._lock:
-            if len(self._events) >= self.max_events:
-                self._events.popleft()
-                self._dropped += 1
-            self._events.append(ev)
+            if self.enabled:
+                if len(self._events) >= self.max_events:
+                    self._events.popleft()
+                    self._dropped += 1
+                    dropped = True
+                self._events.append(ev)
+            if self.flight:
+                if len(self._ring) == self._ring.maxlen:
+                    self._ring_evicted += 1
+                self._ring.append((wall, ev))
+        if dropped:
+            # outside the tracer lock: the counter has its own
+            self._drop_counter.get().inc()
+
+    def absorb_remote(self, spans, process_name=None):
+        """Merge completed spans a peer process shipped over the wire
+        (the master absorbing slave spans off update frames). Each
+        span dict carries an absolute ``wall`` start (``time.time``
+        seconds), ``dur`` seconds, ``name``, ``pid``/``tid`` and
+        optional ``args`` (incl. trace-context ids); wall-clock
+        anchoring is what lets one merged timeline span processes.
+        Malformed entries are skipped — a bad peer must not kill the
+        absorbing side."""
+        if not (self.enabled or self.flight):
+            return 0
+        absorbed = 0
+        named = set()
+        for s in spans:
+            try:
+                wall = float(s["wall"])
+                ev = {"name": str(s["name"]), "ph": "X",
+                      "ts": (wall - self._wall0) * 1e6,
+                      "dur": float(s["dur"]) * 1e6,
+                      "pid": int(s.get("pid", 0)),
+                      "tid": int(s.get("tid", 0)) & 0x7FFFFFFF}
+            except (KeyError, TypeError, ValueError):
+                continue
+            args = s.get("args")
+            if isinstance(args, dict) and args:
+                ev["args"] = {str(k): _jsonable(v)
+                              for k, v in args.items()}
+            if process_name and ev["pid"] not in named:
+                # once per distinct pid, not per span: the name is
+                # constant and this runs on the master's update path
+                named.add(ev["pid"])
+                self.set_process_name(process_name, pid=ev["pid"])
+            self._record(ev, wall)
+            absorbed += 1
+        return absorbed
+
+    # -- structured events (the /debug/events log) ----------------------
+
+    def record_event(self, event, **fields):
+        """Append one structured operational event (job fenced, lease
+        revoked, checkpoint written, reconnect, ...) to the bounded
+        postmortem log. Always on: these are rare by construction.
+        ``fields`` may use any names except ``wall``/``event``."""
+        ev = {"wall": time.time(), "event": str(event)}
+        for k, v in fields.items():
+            ev[k] = _jsonable(v)
+        with self._lock:
+            self._log.append(ev)
+
+    def recent_events(self, limit=None):
+        """Newest-last structured events (``GET /debug/events``).
+        ``limit`` is clamped defensively: it arrives straight from a
+        query string, so 0/negative means none and inf/nan means
+        unlimited rather than an exception in the HTTP handler."""
+        with self._lock:
+            out = list(self._log)
+        if limit is None:
+            return out
+        try:
+            n = int(limit)
+        except (ValueError, OverflowError):
+            return out
+        return out[-n:] if n > 0 else []
+
+    # -- reads -----------------------------------------------------------
 
     def events(self):
         with self._lock:
             return list(self._events)
 
+    def _metadata_events(self):
+        # caller holds no lock requirement: _proc_names is snapshotted
+        with self._lock:
+            names = dict(self._proc_names)
+        return [{"name": "process_name", "ph": "M", "pid": pid,
+                 "args": {"name": name}}
+                for pid, name in sorted(names.items())]
+
+    def flight_doc(self, window=None):
+        """Perfetto/Chrome-trace JSON document of the flight-recorder
+        window: the newest spans within ``window`` seconds (default
+        :attr:`flight_window`), timestamps re-based to the window
+        start. This is what ``GET /debug/trace`` serves — a live,
+        bounded postmortem view with zero restart required."""
+        now = time.time()
+        window = self.flight_window if window is None \
+            else max(float(window), 0.0)
+        cutoff = now - window
+        with self._lock:
+            kept = [(w, ev) for w, ev in self._ring if w >= cutoff]
+            evicted = self._ring_evicted
+        base = min(w for w, _ in kept) if kept else now
+        events = []
+        for w, ev in kept:
+            ev = dict(ev)
+            ev["ts"] = (w - base) * 1e6
+            events.append(ev)
+        return {
+            "traceEvents": self._metadata_events() + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "window_s": "%g" % window,
+                # coverage honesty: under span pressure the bounded
+                # ring holds LESS than the requested window — readers
+                # compare covered_s against window_s and see
+                # ring_evicted grow instead of trusting a silently
+                # truncated view
+                "covered_s": "%g" % round(now - base, 3),
+                "ring_evicted": str(evicted),
+                "base_unix_s": repr(base),
+                "spans": str(len(events)),
+                "dropped_events": str(self._dropped),
+            },
+        }
+
     def dump(self, path):
         """Write the recorded events as Chrome-trace JSON (loadable by
         chrome://tracing and Perfetto); -> ``path``."""
-        doc = {"traceEvents": self.events(),
+        doc = {"traceEvents": self._metadata_events() + self.events(),
                "displayTimeUnit": "ms"}
         if self._dropped:
             doc["otherData"] = {"dropped_events": str(self._dropped)}
@@ -618,3 +901,35 @@ def span(name, **args):
     """``with telemetry.span("conv.forward", unit=u):`` — module-level
     convenience over the process tracer."""
     return tracer.span(name, **args)
+
+
+def record_event(event, **fields):
+    """Module-level convenience over :meth:`Tracer.record_event`."""
+    tracer.record_event(event, **fields)
+
+
+def debug_endpoint(path):
+    """Route a ``/debug/*`` HTTP path to its payload dict, or None
+    when the path is not a debug surface. Shared by ``web_status.py``
+    and the serving frontend so both speak the exact same debug
+    protocol (and ``velescli debug`` works against either):
+
+    * ``/debug/trace[?window=SECS]`` — Perfetto JSON of the flight-
+      recorder window;
+    * ``/debug/events[?limit=N]``    — recent structured events.
+    """
+    from urllib.parse import parse_qs, urlparse
+    parsed = urlparse(path)
+    query = parse_qs(parsed.query)
+
+    def _num(key):
+        try:
+            return float(query[key][0])
+        except (KeyError, IndexError, ValueError):
+            return None
+
+    if parsed.path == "/debug/trace":
+        return tracer.flight_doc(_num("window"))
+    if parsed.path == "/debug/events":
+        return {"events": tracer.recent_events(_num("limit"))}
+    return None

@@ -137,7 +137,7 @@ class Unit(Logger):
             self.run_time += dt
             self.run_calls += 1
             self._run_seconds.get().observe(dt)
-            if telemetry.tracer.enabled:
+            if telemetry.tracer.active:
                 telemetry.tracer.add_complete(
                     "%s.run" % self.name, start, dt,
                     unit=type(self).__name__)

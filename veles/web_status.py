@@ -82,6 +82,18 @@ class WebStatus(Logger):
                     self._reply(200,
                                 reg.render_prometheus().encode(),
                                 reg.CONTENT_TYPE)
+                elif self.path.startswith("/debug/"):
+                    # flight-recorder surfaces: /debug/trace (Perfetto
+                    # JSON of the retained span window) and
+                    # /debug/events (recent structured events) — same
+                    # protocol as the serving frontend
+                    payload = telemetry.debug_endpoint(self.path)
+                    if payload is None:
+                        self._reply(404, b"not found", "text/plain")
+                    else:
+                        self._reply(
+                            200, json.dumps(payload).encode(),
+                            "application/json")
                 elif self.path == "/":
                     self._reply(200, status.render_page().encode(),
                                 "text/html")

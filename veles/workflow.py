@@ -152,7 +152,7 @@ class Workflow(Unit, Container):
                 continue
             if unit._ready():
                 worklist.extend(unit._execute())
-        if telemetry.tracer.enabled:
+        if telemetry.tracer.active:
             telemetry.tracer.add_complete(
                 "workflow.run", run_start,
                 time.perf_counter() - run_start, workflow=self.name,
