@@ -21,7 +21,7 @@ import time
 
 import numpy
 
-from veles import telemetry
+from veles import perf, telemetry
 from veles.accelerated_units import StepCompiler
 from veles.loader.base import CLASS_TRAIN
 from veles.units import Unit
@@ -37,7 +37,7 @@ def _record_dispatch(kind, warm, start, dt, **args):
         "Wall time of one fused dispatch incl. metric fetch "
         "(warm=\"0\" includes XLA compilation)",
         ("kind", "warm")).labels(kind, "1" if warm else "0").observe(dt)
-    if telemetry.tracer.enabled:
+    if telemetry.tracer.active:
         telemetry.tracer.add_complete(
             "xla.dispatch.%s" % kind, start, dt,
             warm=bool(warm), **args)
@@ -396,12 +396,21 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
             self._pre_epoch_state = copy(self.state)
             self._pre_epoch_step_index = self.step_index
         self.step_index += serves_per_epoch * n_epochs
+        # cost BEFORE the call: analysis traces the program from its
+        # live arguments, and donation invalidates them afterwards
+        cost = perf.ledger.cost(
+            ("epoch", id(fn), n_epochs, serves_per_epoch), fn, args)
         t0 = time.perf_counter()
         self.params, self.state, outs = fn(*args)
         host_outs = _fetch_tree(outs)
         dt = time.perf_counter() - t0
         warm = n_epochs in self._seen_chunk_lengths
         _record_dispatch("epoch", warm, t0, dt, epochs=n_epochs)
+        samples = n_epochs * int(loader.total_samples)
+        tps = self._tokens_per_sample()
+        perf.ledger.record_dispatch(
+            "epoch", cost, dt, samples=samples,
+            tokens=samples * tps if tps else None)
         if warm:
             # a clean (compile-free) run of this program: usable for
             # sizing the next chunk
@@ -512,6 +521,7 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
             stage(j)
         outs_per_cls = {cls: [] for cls, _, _ in plan}
         pending = []       # (cls, device outputs) — fetch lags by one
+        epoch_flops = epoch_bytes = 0.0
         for i, (cls, valids_w, _) in enumerate(spans):
             train = cls == CLASS_TRAIN
             units = self.train_units if train else self.eval_units
@@ -531,6 +541,12 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
                 stage(i + stage_depth)
             key0 = jax.random.fold_in(self.base_key, self.step_index)
             self.step_index += len(valids_w)
+            w_cost = perf.ledger.cost(
+                ("window", id(fn), len(valids_w)), fn,
+                (self.params, self.state, stacked, valids_w, hyper,
+                 key0))
+            epoch_flops += w_cost.flops
+            epoch_bytes += w_cost.bytes
             self.params, self.state, outs = fn(
                 self.params, self.state, stacked, valids_w, hyper, key0)
             pending.append((cls, outs))
@@ -557,9 +573,15 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
             seen = self._stream_sigs = set()
         warm = sig in seen
         seen.add(sig)
-        _record_dispatch("stream", warm, t_epoch0,
-                         time.perf_counter() - t_epoch0,
+        dt_epoch = time.perf_counter() - t_epoch0
+        _record_dispatch("stream", warm, t_epoch0, dt_epoch,
                          windows=len(spans))
+        samples = int(loader.total_samples)
+        tps = self._tokens_per_sample()
+        perf.ledger.record_dispatch(
+            "stream", perf.StepCost(epoch_flops, epoch_bytes),
+            dt_epoch, samples=samples,
+            tokens=samples * tps if tps else None)
 
     def _run_per_step(self):
         import jax
@@ -579,11 +601,34 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
         batch = self._gather_batch()
         key = jax.random.fold_in(self.base_key, self.step_index)
         self.step_index += 1
+        hyper = self._gather_hyper()
+        cost = perf.ledger.cost(
+            ("step", id(fn)), fn,
+            (self.params, self.state, batch, hyper, key))
+        t0 = time.perf_counter()
         params, state, outputs = fn(
-            self.params, self.state, batch, self._gather_hyper(), key)
+            self.params, self.state, batch, hyper, key)
         if train:
             self.params, self.state = params, state
         self._publish_metrics(outputs)
+        # _publish_metrics fetched scalar metrics, so the wall time
+        # above includes real device execution, not just the enqueue
+        samples = int(getattr(self.loader, "minibatch_size", 0) or 0)
+        tps = self._tokens_per_sample()
+        perf.ledger.record_dispatch(
+            "step", cost, time.perf_counter() - t0, samples=samples,
+            tokens=samples * tps if tps else None)
+
+    def _tokens_per_sample(self):
+        """Tokens one sample carries, for the tokens/s gauge: an LM
+        loader's minibatch is a (mb, S) integer id matrix — anything
+        else has no token notion and returns None."""
+        mem = getattr(getattr(self.loader, "minibatch_data", None),
+                      "mem", None)
+        if mem is not None and getattr(mem, "ndim", 0) == 2 \
+                and mem.dtype.kind in "iu":
+            return int(mem.shape[1])
+        return None
 
     def _publish_metrics(self, outputs):
         """Hand step metrics to the host side. Every unit may declare
