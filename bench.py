@@ -287,6 +287,102 @@ def _grad_sync_bytes(step):
     return parallel.grad_sync_bytes(host)
 
 
+def _wire_tx_bytes():
+    """tx-side frame bytes from the SAME ``veles_wire_bytes_total``
+    counters the runtime increments — excluding slave-labelled
+    absorbed copies (co-located master+slave share one registry and
+    the slave pushes its counter state to the master; counting those
+    too would double every frame)."""
+    from veles import telemetry
+    state = telemetry.get_registry().counter_state(
+        exclude_label_keys=("slave",))
+    return sum(v for (name, items), v in state.items()
+               if name == "veles_wire_bytes_total"
+               and ("direction", "tx") in items)
+
+
+def _dist_wire_row(codec, n_slaves=1, max_epochs=2):
+    """One co-located master + ``n_slaves`` run over real sockets on
+    the numpy backend (the row measures the WIRE protocol, not
+    compute — it runs, and means the same thing, with or without a
+    TPU); -> (wire bytes per served job, jobs per second)."""
+    import threading
+    from veles.client import SlaveClient
+    from veles.server import MasterServer
+    master = _build_mnist("numpy", "BenchWireM%d%s" % (n_slaves, codec),
+                          mb=50, n_train=500, n_valid=100,
+                          max_epochs=max_epochs)
+    server = MasterServer(master, "127.0.0.1:0",
+                          max_epochs=max_epochs, grad_codec=codec)
+    server.start_background()
+    address = "127.0.0.1:%d" % server.bound_address[1]
+    slaves = []
+    for i in range(n_slaves):
+        wf = _build_mnist("numpy", "BenchWireS%d%s-%d"
+                          % (n_slaves, codec, i), mb=50, n_train=500,
+                          n_valid=100, max_epochs=max_epochs)
+        wf.is_slave = True
+        slaves.append(wf)
+    jobs = [0] * n_slaves
+    errors = []
+
+    def pump(i):
+        try:
+            jobs[i] = SlaveClient(
+                slaves[i], address, name="bench-%s-%d" % (codec, i),
+                grad_codec=codec).run_forever()
+        except Exception as exc:       # surfaced below: a dead-slave
+            errors.append(exc)         # row must be an _error entry,
+                                       # never a bogus data point
+
+    before = _wire_tx_bytes()
+    threads = [threading.Thread(target=pump, args=(i,))
+               for i in range(n_slaves)]
+    t0 = time.perf_counter()
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        # a failed row must not leak the master's serving thread,
+        # listener and workflow for the rest of the bench process
+        server.request_stop()
+    wall = time.perf_counter() - t0
+    moved = _wire_tx_bytes() - before
+    total_jobs = sum(jobs)
+    if errors:
+        raise RuntimeError("slave failed: %s" % errors[0])
+    if not total_jobs:
+        raise RuntimeError("no jobs completed — nothing to measure")
+    if server.faults["codec_fallbacks"]:
+        raise RuntimeError("codec %r fell back to 'none' — the row "
+                           "would measure the wrong thing" % codec)
+    return moved / total_jobs, total_jobs / wall
+
+
+def _grad_codec_rows(extra):
+    """The 318,040-byte plateau as a tracked, falsifiable trajectory:
+    measured wire bytes per sync step for EVERY codec, plus a 2-slave
+    distributed throughput row (protocol-level steps/s, none vs int8
+    — co-located numpy processes, so this prices the wire+codec path,
+    not device scaling)."""
+    for codec in ("none", "bf16", "int8", "topk"):
+        key = "grad_sync_wire_bytes_per_step_%s" % codec
+        try:
+            bytes_per_job, _ = _dist_wire_row(codec, n_slaves=1)
+            extra[key] = int(round(bytes_per_job))
+        except Exception as exc:
+            extra[key + "_error"] = str(exc)[:200]
+    for codec in ("none", "int8"):
+        key = "dist_2slave_steps_per_sec_%s" % codec
+        try:
+            _, steps_per_sec = _dist_wire_row(codec, n_slaves=2)
+            extra[key] = round(steps_per_sec, 1)
+        except Exception as exc:
+            extra[key + "_error"] = str(exc)[:200]
+
+
 def _xla_throughput(create_workflow, cfg, counter_kind, scale,
                     epochs_per_dispatch, name, measure_chunks=1):
     """Shared build-and-time scaffold: seed, size the dataset via the
@@ -525,10 +621,11 @@ def _device_reachable(timeout_s=240):
 def main():
     ok, detail = _device_reachable()
     if not ok:
-        # the serving row is device-independent: still report it so
-        # the inference-path trajectory survives tunnel outages
+        # the serving + wire rows are device-independent: still
+        # report them so those trajectories survive tunnel outages
         extra = {"device_error": detail[:300]}
         _serving_row(extra)
+        _grad_codec_rows(extra)
         print(json.dumps({
             "metric": "mnist_train_steps_per_sec",
             "value": 0.0,
@@ -548,10 +645,14 @@ def main():
     base = numpy_steps_per_sec()
     fast, fast_median, grad_bytes = xla_mnist_bench(measure_chunks=3)
     extra.update({
+        # the DP all-reduce payload (static param bytes — kept for
+        # cross-round comparability) ...
+        "grad_sync_bytes_per_step": int(grad_bytes),
         "mnist_numpy_steps_per_sec": round(base, 2),
         "mnist_train_steps_per_sec_best": round(fast, 2),
-        "grad_sync_bytes_per_step": int(grad_bytes),
     })
+    # ... and the MEASURED wire bytes per sync, per codec (ISSUE 7)
+    _grad_codec_rows(extra)
     _record(extra, "cifar_conv_images_per_sec", xla_cifar_images_per_sec)
 
     def alexnet_row():

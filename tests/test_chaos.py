@@ -222,20 +222,23 @@ def test_clean_completion_counts_no_faults():
 # -- the acceptance chaos run ------------------------------------------
 
 
-def test_chaos_convergence_two_slaves():
-    """Acceptance: 2 slaves through a ChaosProxy injecting seeded
-    drops/delays, one duplicated update and one mid-job kill —
-    training finishes, status() shows >=1 drop and >=1 fenced update,
-    and the final master weights match the fault-free single-process
-    run within tolerance (every minibatch merged exactly once)."""
+def _chaos_convergence_two_slaves(codec="none", topk_percent=25.0):
+    """2 slaves through a ChaosProxy injecting seeded drops/delays,
+    one duplicated update and one mid-job kill — training finishes,
+    status() shows >=1 drop and >=1 fenced update, and the final
+    master weights match the fault-free single-process UNCOMPRESSED
+    run within tolerance (every minibatch merged exactly once;
+    under a lossy ``codec``, error feedback must survive retries,
+    re-hellos and fencing)."""
     w_ref = sequential_reference(max_epochs=2)
 
-    master_wf = make_wf("ChaosMaster", max_epochs=None)
+    master_wf = make_wf("ChaosMaster-%s" % codec, max_epochs=None)
     master_wf.loader.shuffle_enabled = False
     master_wf.loader._start_epoch(first=True)
     master_wf.decision.max_epochs = 2
     server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2,
-                          slave_timeout=5.0)
+                          slave_timeout=5.0, grad_codec=codec,
+                          grad_topk_percent=topk_percent)
     server.start_background()
 
     lock = threading.Lock()
@@ -264,7 +267,8 @@ def test_chaos_convergence_two_slaves():
     with ChaosProxy(("127.0.0.1", server.bound_address[1]), seed=1337,
                     plan=plan, drop_rate=0.01, delay_rate=0.10,
                     delay_s=0.01) as proxy:
-        slaves = [make_wf("ChaosSlave%d" % i) for i in range(2)]
+        slaves = [make_wf("ChaosSlave%s%d" % (codec, i))
+                  for i in range(2)]
         clients = []
         for wf in slaves:
             wf.is_slave = True
@@ -274,7 +278,8 @@ def test_chaos_convergence_two_slaves():
             client = SlaveClient(
                 wf, proxy.address, name="chaos-%d" % idx,
                 io_timeout=2.0, retry_base=0.02, retry_max=0.25,
-                max_retries=25)
+                max_retries=25, grad_codec=codec,
+                grad_topk_percent=topk_percent)
             clients.append(client)
             try:
                 client.run_forever()
@@ -298,16 +303,45 @@ def test_chaos_convergence_two_slaves():
     st = server.status()
     assert st["faults"]["drops"] >= 1, (st, stats)
     assert st["faults"]["fenced_updates"] >= 1, (st, stats)
+    assert st["faults"]["codec_fallbacks"] == 0, st
     assert seen["dup_done"] and seen["kill_done"], (seen, stats)
 
     w_master = numpy.asarray(
         master_wf.forwards[0].weights.map_read().mem)
     assert numpy.isfinite(w_master).all()
-    # exactly-once merge per minibatch: only slave-interleaving keeps
-    # this from being bitwise
+    # exactly-once merge per minibatch: only slave-interleaving (and,
+    # under a lossy codec, the bounded residual tail) keeps this from
+    # being bitwise
     numpy.testing.assert_allclose(
         w_master, w_ref, atol=0.02,
         err_msg=str({"status": st, "proxy": stats}))
+    if codec != "none":
+        # the compression REALLY ran through the chaos: every re-
+        # hello re-negotiated the codec and the tensor payloads
+        # shrank (falsifiable: a silent fallback to 'none' would
+        # leave encoded == raw)
+        from veles import telemetry
+        reg = telemetry.get_registry()
+        raw = reg.counter_total("veles_grad_codec_raw_bytes_total",
+                                codec=codec)
+        enc = reg.counter_total(
+            "veles_grad_codec_encoded_bytes_total", codec=codec)
+        assert raw > 0, "codec never encoded a tensor"
+        assert enc < raw * 0.55, (enc, raw)
+
+
+def test_chaos_convergence_two_slaves():
+    """Acceptance (ISSUE 2): the uncompressed chaos convergence run."""
+    _chaos_convergence_two_slaves("none")
+
+
+@pytest.mark.parametrize("codec", ["int8", "topk"])
+def test_chaos_convergence_two_slaves_compressed(codec):
+    """Acceptance (ISSUE 7): the same seeded drops/dups/mid-job-kill
+    chaos run under a LOSSY gradient codec still lands within the
+    existing 2e-2 atol of the fault-free uncompressed run — error
+    feedback survives retries, duplicated updates and fencing."""
+    _chaos_convergence_two_slaves(codec)
 
 
 def test_trace_context_propagation_under_chaos():
@@ -701,16 +735,17 @@ def test_master_restart_recovery(tmp_path):
     wf1, server1 = spawn_master(resume=False)
 
     def pace(evt):
-        # pace the cluster: ~20ms per served job, so the synthetic
+        # pace the cluster: ~40ms per served job, so the synthetic
         # workload cannot race from start to done before the test
         # thread (GIL-starved by the in-process cluster) gets to kill
-        # the master mid-run
+        # the master mid-run (was 20ms; the PR-7 zero-copy framing
+        # made the wire fast enough to flake that window)
         if evt.direction == S2C and evt.kind == "job":
             return DELAY
         return None
 
     with ChaosProxy(("127.0.0.1", server1.bound_address[1]),
-                    plan=pace, delay_s=0.02) as proxy:
+                    plan=pace, delay_s=0.04) as proxy:
         clients, errors = [], []
 
         def run_slave(idx):

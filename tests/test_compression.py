@@ -1,0 +1,545 @@
+"""Gradient wire codec layer (ISSUE 7): round-trips over dtypes/
+shapes/edge values, the pinned NaN/inf policy, error-feedback
+convergence, the pickle-5 out-of-band frame format, hello codec
+negotiation with counted fallback, and the acceptance wire-bytes
+ratio (int8 <= 30% of uncompressed)."""
+
+import pickle
+import socket
+import struct
+import threading
+
+import numpy
+import pytest
+
+from veles import compression, telemetry
+from veles.client import SlaveClient
+from veles.server import (MAX_FRAME_BYTES, MasterServer,
+                          _frame_parts, decode_frame_payload,
+                          recv_frame, send_frame)
+from tests.test_service import make_wf
+
+RNG = numpy.random.default_rng(1234)
+
+
+# -- codec round-trips -------------------------------------------------
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8"])
+@pytest.mark.parametrize("shape", [(), (1,), (7,), (3, 4), (2, 3, 5)])
+def test_roundtrip_shapes(codec, shape):
+    c = compression.get_codec(codec)
+    a = RNG.standard_normal(shape).astype(numpy.float32)
+    for encode in (c.encode_update, c.encode_broadcast):
+        c.reset()
+        out = compression.decode(encode("k", a))
+        assert out.shape == a.shape
+        assert out.dtype == numpy.float32
+        if codec == "bf16":
+            # one bf16 round-trip keeps 8 mantissa bits
+            assert numpy.abs(out - a).max() <= \
+                numpy.abs(a).max() * 2.0 ** -8 + 1e-12
+        else:
+            spread = float(a.max() - a.min()) if a.size else 0.0
+            assert numpy.abs(out - a).max() <= spread / 255.0 + 1e-12
+
+
+def test_none_codec_is_passthrough_and_unknown_raises():
+    assert compression.get_codec("none") is None
+    raw = numpy.arange(4, dtype=numpy.float32)
+    assert compression.decode(raw) is raw   # no tag -> untouched
+    with pytest.raises(KeyError, match="unknown grad codec"):
+        compression.get_codec("zstd")
+    with pytest.raises(ValueError, match="unknown grad codec"):
+        compression.decode({compression.TAG: "zstd"})
+
+
+def test_int8_constant_and_zero_tensors_are_exact():
+    c = compression.get_codec("int8")
+    for value in (0.0, -3.25, 7.5):
+        a = numpy.full((5, 5), value, numpy.float32)
+        out = compression.decode(c.encode_broadcast("k", a))
+        numpy.testing.assert_array_equal(out, a)
+
+
+def test_int8_worst_case_scale_error_bound():
+    """The documented bound holds even at the worst float32 spread:
+    max abs error <= (hi - lo) / 255 (scale arithmetic runs in
+    float64, so the spread cannot overflow to an inf scale)."""
+    c = compression.get_codec("int8")
+    a = numpy.array([-3e38, -1.0, 0.0, 2.5, 3e38], numpy.float32)
+    payload = c.encode_broadcast("k", a)
+    assert numpy.isfinite(payload["scale"])
+    out = compression.decode(payload)
+    assert numpy.isfinite(out).all()
+    assert numpy.abs(out - a).max() <= (6e38 / 255.0) * 1.0001
+
+
+def test_nonfinite_policy_pinned():
+    """The documented policy, pinned: update deltas ZERO non-finite
+    entries under every lossy codec (and keep them out of the
+    residual); bf16 broadcasts preserve inf and canonicalize NaN;
+    int8 broadcasts sanitize (an inf would destroy the scale)."""
+    bad = numpy.array([numpy.nan, numpy.inf, -numpy.inf, 1.5, -2.0],
+                      numpy.float32)
+    for name in ("bf16", "int8", "topk"):
+        c = compression.get_codec(name, topk_percent=100.0)
+        out = compression.decode(c.encode_update("k", bad))
+        assert numpy.isfinite(out).all(), name
+        assert abs(out[3] - 1.5) < 0.01 and abs(out[4] + 2.0) < 0.02
+        if c._residual:
+            assert numpy.isfinite(c._residual["k"]).all(), name
+    # bf16 broadcast: inf survives, NaN stays NaN (canonical quiet
+    # NaN — a naive mantissa rounding would read back as inf)
+    out = compression.decode(
+        compression.get_codec("bf16").encode_broadcast("k", bad))
+    assert numpy.isnan(out[0])
+    assert out[1] == numpy.inf and out[2] == -numpy.inf
+    # bf16 rounds past-max-finite values UP to inf (RNE semantics)
+    big = numpy.array([numpy.finfo(numpy.float32).max], numpy.float32)
+    assert compression.decode(
+        compression.get_codec("bf16").encode_broadcast(
+            "k", big))[0] == numpy.inf
+    # int8 broadcast sanitizes
+    out = compression.decode(
+        compression.get_codec("int8").encode_broadcast("k", bad))
+    assert numpy.isfinite(out).all()
+
+
+def test_topk_ships_k_entries_and_residual_catches_up():
+    c = compression.get_codec("topk", topk_percent=10.0)
+    a = numpy.zeros(100, numpy.float32)
+    a[:20] = numpy.arange(20, 0, -1, dtype=numpy.float32)
+    payload = c.encode_update("k", a)
+    assert payload["idx"].size == 10
+    out = compression.decode(payload)
+    # the largest-magnitude 10 shipped, exactly
+    numpy.testing.assert_array_equal(numpy.sort(out[out != 0]),
+                                     numpy.arange(11, 21,
+                                                  dtype=numpy.float32))
+    # the suppressed entries live in the residual and ship NEXT sync
+    out2 = compression.decode(
+        c.encode_update("k", numpy.zeros(100, numpy.float32)))
+    numpy.testing.assert_array_equal(
+        numpy.sort(out2[out2 != 0]),
+        numpy.arange(1, 11, dtype=numpy.float32))
+
+
+def test_topk_percent_100_is_dense_exact():
+    c = compression.get_codec("topk", topk_percent=100.0)
+    a = RNG.standard_normal((8, 8)).astype(numpy.float32)
+    numpy.testing.assert_array_equal(
+        compression.decode(c.encode_update("k", a)), a)
+
+
+@pytest.mark.parametrize("codec,percent", [("int8", 1.0),
+                                           ("topk", 10.0)])
+def test_error_feedback_converges_to_uncompressed(codec, percent):
+    """The regression the residuals exist for: the decoded sum of N
+    compressed syncs equals the raw delta sum MINUS exactly the
+    residual still held (acc + residual == total, an identity), and
+    the tracking error does NOT grow with N — without feedback int8
+    would random-walk at ~sqrt(N) quantization errors."""
+    c = compression.get_codec(codec, topk_percent=percent)
+    rng = numpy.random.default_rng(7)
+    total = numpy.zeros(200, numpy.float32)
+    acc = numpy.zeros_like(total)
+    errs = []
+    for i in range(120):
+        d = (rng.standard_normal(200) * 0.01).astype(numpy.float32)
+        total += d
+        acc += compression.decode(c.encode_update("w", d))
+        errs.append(float(numpy.abs(acc - total).max()))
+    residual = c._residual["w"]
+    numpy.testing.assert_allclose(acc + residual, total, atol=1e-4)
+    # bounded, not growing: the late-run error is no worse than a
+    # small multiple of the early-run error
+    assert max(errs[60:]) <= max(errs[:20]) * 3.0 + 1e-3
+    assert errs[-1] < 0.1
+
+
+def test_codec_telemetry_counts_shrink():
+    c = compression.get_codec("int8")
+    a = RNG.standard_normal(1000).astype(numpy.float32)
+    c.encode_update("k", a)
+    compression.decode(c.encode_broadcast("k", a))
+    reg = telemetry.get_registry()
+    raw = reg.counter_total("veles_grad_codec_raw_bytes_total",
+                            codec="int8")
+    enc = reg.counter_total("veles_grad_codec_encoded_bytes_total",
+                            codec="int8")
+    assert raw == 2 * a.nbytes
+    assert 0 < enc <= raw / 3.9     # 4x shrink, both directions
+
+
+# -- GD-unit threading (the nn_units hook points) ----------------------
+
+
+def run_iteration(wf):
+    from veles.loader.base import CLASS_TRAIN
+    for u in wf.forwards:
+        u.run()
+    wf.evaluator.run()
+    if wf.loader.minibatch_class == CLASS_TRAIN:
+        for gd in reversed(wf.gds):
+            gd.run()
+
+
+def _sync_rounds(codec, rounds=12):
+    """Drive master/slave registries in process for a few jobs with
+    ``codec`` on both directions; -> final master weights."""
+    from veles.distributable import DistributionRegistry
+    master = make_wf("CodecM-%s" % codec, max_epochs=None)
+    master.decision.max_epochs = 2
+    slave = make_wf("CodecS-%s" % codec)
+    slave.is_slave = True
+    enc = compression.get_codec(codec, topk_percent=25.0)
+    if enc is not None:
+        master.grad_codec_by_slave = {
+            1: compression.get_codec(codec, topk_percent=25.0)}
+        slave.grad_codec = enc
+    mreg = DistributionRegistry(master)
+    sreg = DistributionRegistry(slave)
+    for _ in range(rounds):
+        job = mreg.generate_job(1)
+        if job.get(master.loader.name) is None:
+            break
+        sreg.apply_job(job)
+        run_iteration(slave)
+        mreg.apply_update(sreg.generate_update(), 1)
+    return numpy.array(master.forwards[0].weights.map_read().mem)
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8", "topk"])
+def test_gd_unit_sync_matches_uncompressed(codec):
+    """Satellite: repeated compressed syncs through the REAL GD-unit
+    hook points land within tolerance of the uncompressed result —
+    the error-feedback residuals work where they are actually
+    wired."""
+    w_ref = _sync_rounds("none")
+    w = _sync_rounds(codec)
+    assert numpy.isfinite(w).all()
+    numpy.testing.assert_allclose(w, w_ref, atol=5e-3)
+
+
+# -- wire framing (pickle protocol 5, out-of-band buffers) -------------
+
+
+def _pipe():
+    return socket.socketpair()
+
+
+def test_frame_out_of_band_roundtrip():
+    """ndarray payloads ship as out-of-band buffers (no monolithic
+    blob copy) and reconstruct equal AND writable on the far side."""
+    obj = ("update", 1, "lease", 5, 0,
+           {"gd": {"dweights": RNG.standard_normal(
+               (64, 32)).astype(numpy.float32)}})
+    assert len(_frame_parts(obj)) > 1     # buffers really split out
+    a, b = _pipe()
+    t = threading.Thread(target=send_frame, args=(a, obj))
+    t.start()
+    got = recv_frame(b)
+    t.join()
+    numpy.testing.assert_array_equal(
+        got[5]["gd"]["dweights"], obj[5]["gd"]["dweights"])
+    assert got[5]["gd"]["dweights"].flags.writeable
+    assert got[:5] == obj[:5]
+    a.close(), b.close()
+
+
+def test_frame_bufferless_stays_bare_pickle():
+    """Control frames (pings, acks) keep the single-part bare-pickle
+    payload — byte-compatible with a pre-codec recv."""
+    parts = _frame_parts(("ping", 1, "lease"))
+    assert len(parts) == 1 and parts[0][:1] == b"\x80"
+    assert decode_frame_payload(parts[0]) == ("ping", 1, "lease")
+
+
+def test_decode_frame_payload_accepts_legacy_pickle():
+    obj = ("job", {"x": [1, 2, 3]}, 7, 0)
+    assert decode_frame_payload(
+        pickle.dumps(obj, protocol=4)) == obj
+
+
+def test_frame_hmac_tamper_rejected():
+    obj = ("update", 1, "l", 2, 0,
+           {"gd": {"dweights": numpy.ones(100, numpy.float32)}})
+    import hashlib
+    import hmac as hmac_mod
+    from veles.server import _FRAME_OVERHEAD, _secret
+    parts = _frame_parts(obj)
+    blob = bytearray(b"".join(bytes(p) for p in parts))
+    tag = hmac_mod.new(_secret(), blob, hashlib.sha256).digest()
+    blob[len(blob) // 2] ^= 0xFF          # bit rot mid-tensor
+    frame = struct.pack(">I", len(blob)) + tag + bytes(blob)
+    assert len(frame) == len(blob) + _FRAME_OVERHEAD
+    a, b = _pipe()
+    a.sendall(frame)
+    with pytest.raises(ConnectionError, match="HMAC"):
+        recv_frame(b)
+    a.close(), b.close()
+
+
+def test_frame_buffer_accounting_mismatch_rejected():
+    good = b"".join(bytes(p) for p in _frame_parts(
+        {"w": numpy.ones(16, numpy.float32)}))
+    assert good[:1] == b"\xf5"
+    with pytest.raises(ConnectionError, match="mismatch"):
+        decode_frame_payload(good[:-8])   # truncated buffer tail
+    with pytest.raises(ConnectionError):
+        decode_frame_payload(b"\xf5\x00")  # garbled header
+
+
+def test_graphics_framing_reuses_hardened_helpers():
+    """Satellite: the graphics channel now rides the server's capped
+    framing — an oversized length header is refused BEFORE any
+    allocation, and a normal npz frame round-trips."""
+    from veles import graphics
+    a, b = _pipe()
+    payload = graphics.pack_payload({"plot": "w"},
+                                    {"y": numpy.arange(5.0)})
+    graphics.send_frame(a, payload)
+    blob = graphics.recv_frame(b)
+    meta, arrays = graphics.unpack_payload(blob)
+    assert meta == {"plot": "w"}
+    numpy.testing.assert_array_equal(arrays["y"], numpy.arange(5.0))
+    a.sendall(struct.pack(">I", MAX_FRAME_BYTES + 1))
+    with pytest.raises(ConnectionError, match="cap"):
+        graphics.recv_frame(b)
+    a.close(), b.close()
+
+
+# -- hello negotiation -------------------------------------------------
+
+
+def test_hello_negotiation_master_config_wins():
+    wf = make_wf("NegoM", max_epochs=None)
+    wf.decision.max_epochs = 2
+    server = MasterServer(wf, "127.0.0.1:0", max_epochs=2,
+                          grad_codec="int8")
+    # agreeing slave: codec granted, per-slave encoder minted
+    resp = server.handle(("hello", "new-slave", "int8"))
+    assert resp[0] == "welcome" and resp[3] == "int8"
+    assert wf.grad_codec_by_slave[resp[1]].name == "int8"
+    assert server.faults["codec_fallbacks"] == 0
+    # pre-codec peer (2-tuple hello): falls back, counted, 3-tuple
+    # welcome so welcome[:3] unpacking keeps working
+    resp_old = server.handle(("hello", "old-slave"))
+    assert resp_old[0] == "welcome" and len(resp_old) == 3
+    assert server.faults["codec_fallbacks"] == 1
+    assert resp_old[1] not in wf.grad_codec_by_slave
+    # differently-configured slave: same counted fallback — but the
+    # welcome stays a 4-tuple ("none"): its LENGTH tells a
+    # codec-aware slave this master speaks the out-of-band frames
+    resp_mis = server.handle(("hello", "mis-slave", "topk"))
+    assert len(resp_mis) == 4 and resp_mis[3] == "none"
+    assert server.faults["codec_fallbacks"] == 2
+    # status surfaces the negotiated codec per slave
+    st = server.status()
+    assert st["grad_codec"] == "int8"
+    assert st["slaves"][str(resp[1])]["codec"] == "int8"
+    assert st["slaves"][str(resp_old[1])]["codec"] == "none"
+    # dropping the lease drops the encoder (and its residual state)
+    server.drop_slave(resp[1])
+    assert resp[1] not in wf.grad_codec_by_slave
+
+
+def test_hello_none_master_declines_offer():
+    wf = make_wf("NegoNone", max_epochs=None)
+    wf.decision.max_epochs = 2
+    server = MasterServer(wf, "127.0.0.1:0", max_epochs=2)
+    resp = server.handle(("hello", "eager", "bf16"))
+    assert len(resp) == 4 and resp[3] == "none"
+    assert server.faults["codec_fallbacks"] == 1
+    resp2 = server.handle(("hello", "plain", "none"))
+    assert len(resp2) == 4 and resp2[3] == "none"
+    assert server.faults["codec_fallbacks"] == 1   # agreement, no count
+
+
+def test_topk_percent_rides_welcome_master_wins():
+    """Master config wins for the sparsity level too: a slave
+    configured with a different --grad-topk-percent adopts the
+    master's K from the welcome instead of silently shipping a
+    different fraction of each delta."""
+    wf = make_wf("NegoK", max_epochs=None)
+    wf.decision.max_epochs = 2
+    server = MasterServer(wf, "127.0.0.1:0", max_epochs=2,
+                          grad_codec="topk", grad_topk_percent=5.0)
+    resp = server.handle(("hello", "k-slave", "topk"))
+    assert resp[3] == "topk" and resp[4] == 5.0
+    server.start_background()
+    swf = make_wf("NegoKS")
+    swf.is_slave = True
+    client = SlaveClient(swf, "127.0.0.1:%d" % server.bound_address[1],
+                         name="k", grad_codec="topk",
+                         grad_topk_percent=1.0, ping_interval=0)
+    client.connect()
+    assert swf.grad_codec.topk_percent == 5.0
+    assert client._codec_active == ("topk", 5.0)
+    client._close_sock()
+    server.done.set()
+
+
+def test_unknown_codec_rejected_at_construction():
+    wf = make_wf("NegoBad", max_epochs=None)
+    wf.decision.max_epochs = 2
+    with pytest.raises(ValueError, match="unknown grad codec"):
+        MasterServer(wf, "127.0.0.1:0", max_epochs=2,
+                     grad_codec="zstd")
+    swf = make_wf("NegoBadS")
+    swf.is_slave = True
+    with pytest.raises(ValueError, match="unknown grad codec"):
+        SlaveClient(swf, "127.0.0.1:1", grad_codec="zstd")
+
+
+def test_codec_mismatch_over_real_sockets_degrades_not_crashes():
+    """Acceptance: a mismatched slave trains to completion
+    UNCOMPRESSED — counted warning on both sides, never a crash."""
+    m = make_wf("MisM", max_epochs=None)
+    m.decision.max_epochs = 2
+    server = MasterServer(m, "127.0.0.1:0", max_epochs=2,
+                          grad_codec="int8")
+    server.start_background()
+    s = make_wf("MisS")
+    s.is_slave = True
+    client = SlaveClient(s, "127.0.0.1:%d" % server.bound_address[1],
+                         name="mis", grad_codec="bf16")
+    jobs = client.run_forever()
+    assert jobs > 0 and server.done.is_set()
+    assert client.codec_fallbacks >= 1
+    assert client._codec_active[0] == "none"
+    assert server.faults["codec_fallbacks"] >= 1
+    assert telemetry.get_registry().counter_total(
+        "veles_slave_codec_fallbacks_total") >= 1
+
+
+# -- mixed-version frame compatibility ---------------------------------
+
+
+def _old_recv_frame(sock):
+    """What a pre-PR-7 peer does: pickle.loads over the whole
+    authenticated payload — no out-of-band format knowledge."""
+    from veles.server import _recv_exact
+    header = _recv_exact(sock, 4)
+    size, = struct.unpack(">I", header)
+    _recv_exact(sock, 32)                 # tag (authenticity tested
+    return pickle.loads(_recv_exact(sock, size))   # elsewhere)
+
+
+def test_old_slave_gets_legacy_frames_from_new_master():
+    """Rolling upgrade, master first: a pre-codec slave (2-tuple
+    hello, monolithic-pickle recv) must be able to read EVERY reply —
+    including the array-carrying job payload, which a new-format
+    frame would crash with UnpicklingError."""
+    wf = make_wf("LegacyM", max_epochs=None)
+    wf.decision.max_epochs = 2
+    server = MasterServer(wf, "127.0.0.1:0", max_epochs=2,
+                          grad_codec="int8")
+    server.start_background()
+    sock = socket.create_connection(server.bound_address, timeout=10)
+    # old peers pickle monolithically — send_frame(legacy=True) is
+    # byte-shape-compatible with what they produced
+    send_frame(sock, ("hello", "old-peer"), legacy=True)
+    welcome = _old_recv_frame(sock)
+    assert welcome[0] == "welcome" and len(welcome) == 3
+    send_frame(sock, ("job", welcome[1], welcome[2]), legacy=True)
+    resp = _old_recv_frame(sock)          # ships full ndarrays
+    assert resp[0] == "job"
+    payload = resp[1]
+    arrays = [v for unit in payload.values() if isinstance(unit, dict)
+              for v in unit.values()]
+    assert any(isinstance(v, numpy.ndarray) for v in arrays)
+    # and uncompressed: the int8-wanting master fell back for us
+    assert not any(isinstance(v, dict) and compression.TAG in v
+                   for unit in payload.values() if isinstance(unit, dict)
+                   for v in unit.values())
+    sock.close()
+    server.done.set()
+
+
+def test_new_slave_pins_legacy_frames_against_old_master():
+    """Rolling upgrade, slaves first: an OLD master answers hello
+    with a 3-tuple welcome in a monolithic frame — the new client
+    must notice and pin its own sends to legacy frames it can read."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen()
+    seen = {}
+
+    def old_master():
+        conn, _ = listener.accept()
+        hello = _old_recv_frame(conn)
+        seen["hello"] = hello
+        send_frame(conn, ("welcome", 1, "lease-x"), legacy=True)
+        # the client's next frame must be a LEGACY payload: read it
+        # the old way, which crashes on the new format
+        seen["next"] = _old_recv_frame(conn)
+        conn.close()
+
+    t = threading.Thread(target=old_master)
+    t.start()
+    wf = make_wf("LegacyS")
+    wf.is_slave = True
+    client = SlaveClient(
+        wf, "127.0.0.1:%d" % listener.getsockname()[1],
+        io_timeout=10.0, grad_codec="int8", ping_interval=0)
+    client.connect()
+    assert client._legacy_frames is True
+    assert client._codec_active[0] == "none"
+    # an update frame (array-carrying) round-trips through the old
+    # master's monolithic recv without UnpicklingError
+    try:
+        client._roundtrip(("update", 1, "lease-x", 1, 0,
+                           {"gd": {"dweights": numpy.ones(
+                               8, numpy.float32)}}))
+    except ConnectionError:
+        pass                              # old_master hangs up after
+    t.join(timeout=10)
+    assert seen["hello"][2] == "int8"     # extra element was harmless
+    assert seen["next"][0] == "update"
+    numpy.testing.assert_array_equal(
+        seen["next"][5]["gd"]["dweights"], numpy.ones(8, numpy.float32))
+    listener.close()
+
+
+# -- the acceptance byte ratio -----------------------------------------
+
+
+def _wire_tx_bytes():
+    """tx-side frame bytes, EXCLUDING slave-labelled absorbed copies
+    (co-located master+slave share one registry, and the slave pushes
+    its counter state to the master — counting those too would double
+    every frame)."""
+    state = telemetry.get_registry().counter_state(
+        exclude_label_keys=("slave",))
+    return sum(v for (name, items), v in state.items()
+               if name == "veles_wire_bytes_total"
+               and ("direction", "tx") in items)
+
+
+def _measure_wire_bytes_per_job(codec):
+    m = make_wf("WireM-%s" % codec, max_epochs=None)
+    m.decision.max_epochs = 1
+    server = MasterServer(m, "127.0.0.1:0", max_epochs=1,
+                          grad_codec=codec)
+    server.start_background()
+    s = make_wf("WireS-%s" % codec)
+    s.is_slave = True
+    before = _wire_tx_bytes()
+    jobs = SlaveClient(
+        s, "127.0.0.1:%d" % server.bound_address[1],
+        name="wire-%s" % codec, grad_codec=codec).run_forever()
+    assert jobs > 0
+    return (_wire_tx_bytes() - before) / jobs
+
+
+def test_int8_wire_bytes_at_most_30_percent_of_none():
+    """Acceptance: grad_sync bytes/step under int8 <= 30% of the
+    'none' codec's, measured from the SAME veles_wire_bytes_total
+    counters the runtime increments (4x on both directions leaves
+    plenty of room for frame/telemetry overhead)."""
+    none_bpj = _measure_wire_bytes_per_job("none")
+    int8_bpj = _measure_wire_bytes_per_job("int8")
+    assert none_bpj > 300_000     # full fp32 weights really shipped
+    assert int8_bpj / none_bpj <= 0.30, (int8_bpj, none_bpj)

@@ -94,6 +94,20 @@ def build_argparser():
                    help="host:port -> run as distribution master")
     p.add_argument("--master-address", default=None,
                    help="host:port -> run as slave of that master")
+    p.add_argument("--grad-codec", default=None,
+                   choices=["none", "bf16", "int8", "topk"],
+                   help="gradient wire codec for master/slave sync "
+                        "(veles/compression.py): bf16 = 2x shrink, "
+                        "int8 = 4x with error-feedback residuals, "
+                        "topk = ship only the largest K%% of delta "
+                        "entries. Negotiated at hello; the master's "
+                        "setting wins and mismatched slaves fall "
+                        "back to 'none' with a counted warning")
+    p.add_argument("--grad-topk-percent", type=float, default=1.0,
+                   metavar="K",
+                   help="topk codec: percentage of delta entries "
+                        "shipped per sync (default 1.0; the rest "
+                        "accumulates in the error-feedback residual)")
     p.add_argument("--workflow-graph", default=None,
                    help="write the unit DAG as graphviz dot and exit")
     p.add_argument("--dump-config", action="store_true",
@@ -255,7 +269,9 @@ class Main:
             profile_dir=args.profile_dir,
             slave_timeout=args.slave_timeout,
             slave_options=slave_options,
-            checkpoint_every=args.checkpoint_every)
+            checkpoint_every=args.checkpoint_every,
+            grad_codec=args.grad_codec,
+            grad_topk_percent=args.grad_topk_percent)
         if args.graphics_dir and not getattr(
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):

@@ -25,7 +25,6 @@ loopback, in tests) to expose the request kind (``hello`` / ``job`` /
 frame", not "frame #7".
 """
 
-import pickle
 import random
 import socket
 import struct
@@ -33,7 +32,7 @@ import threading
 import time
 
 from veles.logger import Logger
-from veles.server import _recv_exact
+from veles.server import _recv_exact, decode_frame_payload
 
 
 # -- checkpoint/blob corruption (the disk-side fault models) -----------
@@ -175,10 +174,12 @@ class _Pump(threading.Thread):
         return True
 
     def _peek(self, blob):
-        # frames are our own HMAC-verified-shape pickles on loopback;
-        # surface the protocol tag so plans can target by meaning
+        # frames are our own HMAC-verified-shape payloads on loopback
+        # (bare pickle OR the out-of-band buffer format — the shared
+        # decoder handles both); surface the protocol tag so plans can
+        # target by meaning
         try:
-            obj = pickle.loads(blob)
+            obj = decode_frame_payload(blob)
             return obj[0] if isinstance(obj, tuple) and obj else None
         except Exception:
             return None

@@ -66,9 +66,30 @@ class ProtocolDesync(ConnectionError):
 class SlaveClient(Logger):
     def __init__(self, workflow, address, name=None, io_timeout=30.0,
                  retry_base=0.05, retry_max=2.0, max_retries=8,
-                 ping_interval=1.0):
+                 ping_interval=1.0, grad_codec="none",
+                 grad_topk_percent=1.0):
+        from veles import compression
         self.name = name or "SlaveClient"
         self.workflow = workflow
+        #: gradient wire codec OFFERED at hello (the master's config
+        #: wins — see veles/server.py negotiation); validated here so
+        #: a typo fails at construction, not at the first sync
+        self.grad_codec = str(grad_codec or "none")
+        if self.grad_codec not in compression.CODEC_NAMES:
+            raise ValueError(
+                "unknown grad codec %r (known: %s)"
+                % (grad_codec, ", ".join(compression.CODEC_NAMES)))
+        self.grad_topk_percent = float(grad_topk_percent)
+        #: the codec actually negotiated (welcome's 4th element);
+        #: tracked so a re-hello under the SAME codec keeps the
+        #: error-feedback residuals instead of resetting them
+        self._codec_active = None
+        self.codec_fallbacks = 0
+        #: True while talking to a pre-OOB master (detected per
+        #: connection: a codec-aware hello always earns a 4-tuple
+        #: welcome from a new master, so a 3-tuple back means OLD —
+        #: pin our sends to legacy monolithic frames it can read)
+        self._legacy_frames = False
         self._check_mode()
         host, _, port = str(address).rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
@@ -120,6 +141,9 @@ class SlaveClient(Logger):
                  "Reconnect/re-hello cycles"),
                 ("stale", "veles_slave_stale_resyncs_total",
                  "Lease revocations noticed (fenced responses)"),
+                ("codec_fallback", "veles_slave_codec_fallbacks_total",
+                 "Hellos where the master declined this slave's grad "
+                 "codec and the sync fell back to 'none'"),
             )}
         #: stable token identifying this PROCESS's counter stream
         #: across re-hellos: the master diffs pushed absolute state
@@ -133,7 +157,7 @@ class SlaveClient(Logger):
         self.sock = socket.create_connection(self.address,
                                              timeout=self.io_timeout)
         self.sock.settimeout(self.io_timeout)
-        send_frame(self.sock, ("hello", self.name))
+        send_frame(self.sock, ("hello", self.name, self.grad_codec))
         welcome = recv_frame(self.sock)
         # no asserts: they vanish under ``python -O`` and a bad
         # handshake must fail LOUDLY either way
@@ -148,6 +172,10 @@ class SlaveClient(Logger):
                 "('welcome', slave_id, lease_id), got %r"
                 % (self.address + (welcome,)))
         self.slave_id, self.lease_id = welcome[1], welcome[2]
+        self._legacy_frames = len(welcome) < 4
+        self._adopt_codec(
+            welcome[3] if len(welcome) > 3 else "none",
+            welcome[4] if len(welcome) > 4 else None)
         # under the io lock: a previous connection's heartbeat thread
         # may still be mid-round-trip and writes _last_io on exit —
         # both writers hold the lock, so the fresher timestamp wins
@@ -156,6 +184,35 @@ class SlaveClient(Logger):
             self._last_io = time.monotonic()
         self._start_heartbeat()
         return self
+
+    def _adopt_codec(self, chosen, topk_percent=None):
+        """Install the codec the master chose for this lease. A
+        fallback (master config wins — old master, different config)
+        is warned and counted, never fatal: the slave keeps training,
+        uncompressed. The master's ``topk_percent`` rides the welcome
+        and wins too — a locally-configured K would silently change
+        how much of each delta ships. A re-hello under the SAME
+        (codec, K) keeps the encoder instance, so the error-feedback
+        residuals survive reconnects; a change discards them (they
+        compensate a quantizer that no longer exists)."""
+        from veles import compression
+        if chosen != self.grad_codec:
+            self.codec_fallbacks += 1
+            self._tele["codec_fallback"].get().inc()
+            self.warning(
+                "master negotiated grad codec %r (this slave asked "
+                "for %r) — syncing uncompressed", chosen,
+                self.grad_codec)
+        k = self.grad_topk_percent if topk_percent is None \
+            else float(topk_percent)
+        if k != self.grad_topk_percent:
+            self.info("master imposed topk_percent %g (this slave "
+                      "was configured with %g)", k,
+                      self.grad_topk_percent)
+        if (chosen, k) != self._codec_active:
+            self.workflow.grad_codec = compression.get_codec(
+                chosen, k)
+            self._codec_active = (chosen, k)
 
     def _start_heartbeat(self):
         """Best-effort liveness pings whenever the socket has been
@@ -208,7 +265,8 @@ class SlaveClient(Logger):
 
     def _roundtrip(self, request):
         with self._io_lock:
-            send_frame(self.sock, request)
+            send_frame(self.sock, request,
+                       legacy=self._legacy_frames)
             resp = recv_frame(self.sock)
             self._last_io = time.monotonic()
         if resp is None:

@@ -21,7 +21,6 @@ the hot path by design — SURVEY.md §5.8).
 import io
 import json
 import socket
-import struct
 import subprocess
 import sys
 import threading
@@ -29,9 +28,7 @@ import threading
 import numpy
 
 from veles.logger import Logger
-
-#: same generous-but-bounded cap rationale as veles/server.py
-MAX_FRAME_BYTES = 1 << 30
+from veles.server import recv_raw_frame, send_raw_frame
 
 
 def pack_payload(meta, arrays):
@@ -52,27 +49,14 @@ def unpack_payload(blob):
 
 
 def send_frame(sock, blob):
-    sock.sendall(struct.pack(">I", len(blob)) + blob)
+    """npz blob -> wire: the HARDENED raw framing from veles/server.py
+    (this module used to keep a private uncapped clone — length cap
+    and exact-recv now have exactly one implementation)."""
+    send_raw_frame(sock, blob)
 
 
 def recv_frame(sock):
-    header = _recv_exact(sock, 4)
-    if header is None:
-        return None
-    size, = struct.unpack(">I", header)
-    if size > MAX_FRAME_BYTES:
-        raise ConnectionError("oversized graphics frame %d" % size)
-    return _recv_exact(sock, size)
-
-
-def _recv_exact(sock, n):
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf += chunk
-    return bytes(buf)
+    return recv_raw_frame(sock)
 
 
 class GraphicsServer(Logger):

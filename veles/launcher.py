@@ -36,7 +36,8 @@ class Launcher(Logger):
                  listen_address=None, master_address=None,
                  graphics_dir=None, web_status_port=None,
                  profile_dir=None, slave_timeout=None,
-                 slave_options=None, checkpoint_every=None):
+                 slave_options=None, checkpoint_every=None,
+                 grad_codec=None, grad_topk_percent=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
@@ -54,6 +55,12 @@ class Launcher(Logger):
         #: snapshotter's rolling ``current`` slot in standalone mode
         #: and the master's state-persist loop in master mode
         self.checkpoint_every = checkpoint_every
+        #: gradient wire codec for the distributed modes
+        #: (veles/compression.py): the master's configured codec wins
+        #: the per-slave hello negotiation; the slave offers its own
+        self.grad_codec = grad_codec or "none"
+        self.grad_topk_percent = 1.0 if grad_topk_percent is None \
+            else float(grad_topk_percent)
         self.workflow = None
         self.interrupted = False
         #: True once SIGTERM asked for a preemption shutdown: the run
@@ -293,6 +300,8 @@ class Launcher(Logger):
                               checkpoint_store=store,
                               checkpoint_every=self.checkpoint_every,
                               resume_state=self._master_resume,
+                              grad_codec=self.grad_codec,
+                              grad_topk_percent=self.grad_topk_percent,
                               **kwargs)
         self.master_server = server
         if self.preempted:
@@ -310,6 +319,8 @@ class Launcher(Logger):
     def _run_slave(self):
         from veles.client import SlaveClient
         client = SlaveClient(self.workflow, self.master_address,
+                             grad_codec=self.grad_codec,
+                             grad_topk_percent=self.grad_topk_percent,
                              **self.slave_options)
         self.slave_client = client
         if self.preempted:

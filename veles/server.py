@@ -9,7 +9,29 @@ length-prefixed pickle frames is sufficient and dependency-free.
 
 Protocol (client-initiated, synchronous per connection):
 
-* ``("hello", name)``       → ``("welcome", slave_id, lease_id)``
+* ``("hello", name[, codec])``
+                            → ``("welcome", slave_id, lease_id
+                              [, codec])`` — ``codec`` is the gradient
+                              wire codec (``veles/compression.py``):
+                              the slave offers its configured one, the
+                              master answers the one it chose for this
+                              slave (master config wins; any mismatch
+                              falls back to ``"none"`` with a counted
+                              warning, so rolling upgrades keep
+                              working). The hello's THIRD element
+                              doubles as the version marker for the
+                              out-of-band frame format below: a
+                              2-tuple hello is a pre-codec peer, so
+                              the connection stays on legacy
+                              monolithic frames and the welcome stays
+                              a 3-tuple; a 3-tuple hello always earns
+                              a 4-tuple welcome (codec possibly
+                              ``"none"``), and a codec-aware slave
+                              that receives only a 3-tuple back knows
+                              ITS master is old and sends legacy
+                              frames too. Hello/welcome themselves are
+                              buffer-free, hence readable by every
+                              version.
 * ``("job", sid, lease)``   → ``("job", payload, job_id, epoch,
                               trace)`` | ``("wait",)`` | ``("bye",)``
                               | ``("stale",)`` — ``trace`` is the
@@ -108,11 +130,81 @@ _WIRE_RX = telemetry.LazyChild(lambda: telemetry.counter(
     "(payload + length header + auth tag)", ("direction",)).labels("rx"))
 
 
-def send_frame(sock, obj):
-    blob = pickle.dumps(obj, protocol=4)
-    tag = hmac.new(_secret(), blob, hashlib.sha256).digest()
-    sock.sendall(struct.pack(">I", len(blob)) + tag + blob)
-    _WIRE_TX.get().inc(len(blob) + _FRAME_OVERHEAD)
+#: first payload byte of the buffer-carrying frame format below; a
+#: plain pickle starts with b"\x80" (the PROTO opcode), so the two
+#: formats are distinguishable from byte 0 and old-format frames stay
+#: decodable forever
+_FRAME_MAGIC = b"\xf5"
+
+
+def _frame_parts(obj):
+    """Serialize ``obj`` into a list of buffer-ish payload parts.
+
+    Pickle protocol 5 with OUT-OF-BAND ndarray buffers: the pickle
+    stream carries only tensor metadata while each array's memory
+    ships as its own part — a multi-MB weight frame is never copied
+    into one monolithic blob. Payload layout when buffers exist::
+
+        magic(1) | n_buffers(>I) | pickle_len(>I) | n x buf_len(>Q)
+        | pickle stream | buffer bytes...
+
+    Buffer-free frames (pings, acks) stay a bare pickle stream."""
+    buffers = []
+    blob = pickle.dumps(obj, protocol=5,
+                        buffer_callback=buffers.append)
+    if not buffers:
+        return [blob]
+    raws = [b.raw() for b in buffers]
+    head = [_FRAME_MAGIC, struct.pack(">II", len(raws), len(blob))]
+    head.extend(struct.pack(">Q", len(r)) for r in raws)
+    return [b"".join(head), blob] + raws
+
+
+def decode_frame_payload(blob):
+    """Authenticated payload bytes -> object, both frame formats.
+    Out-of-band buffers are reconstructed as ZERO-COPY views into
+    ``blob`` (pass a bytearray for writable arrays)."""
+    if blob[:1] != _FRAME_MAGIC:
+        return pickle.loads(blob)
+    try:
+        nbuf, plen = struct.unpack_from(">II", blob, 1)
+        sizes = struct.unpack_from(">%dQ" % nbuf, blob, 9)
+    except struct.error:
+        raise ConnectionError("garbled out-of-band frame header")
+    off = 9 + 8 * nbuf
+    if off + plen + sum(sizes) != len(blob):
+        raise ConnectionError(
+            "out-of-band frame buffer accounting mismatch "
+            "(%d parts, %d bytes claimed, %d received)"
+            % (nbuf, off + plen + sum(sizes), len(blob)))
+    view = memoryview(blob)
+    pos = off + plen
+    bufs = []
+    for size in sizes:
+        bufs.append(view[pos:pos + size])
+        pos += size
+    return pickle.loads(view[off:off + plen], buffers=bufs)
+
+
+def send_frame(sock, obj, legacy=False):
+    # the frame is sent as a memoryview SEQUENCE (header, pickle
+    # stream, raw tensor buffers) — sequential sendall, so the
+    # multi-MB weight payload is never concatenated into a second
+    # copy. ``legacy=True`` pins the payload to one monolithic bare
+    # pickle for a pre-OOB peer (negotiated from the hello shape —
+    # see the protocol docstring); a bare protocol-5 stream with no
+    # out-of-band buffers is exactly what an old recv_frame's
+    # pickle.loads expects.
+    parts = [pickle.dumps(obj, protocol=5)] if legacy \
+        else _frame_parts(obj)
+    size = sum(len(p) for p in parts)
+    mac = hmac.new(_secret(), digestmod=hashlib.sha256)
+    for part in parts:
+        mac.update(part)
+    sock.sendall(struct.pack(">I", size) + mac.digest())
+    for part in parts:
+        sock.sendall(part)
+    _WIRE_TX.get().inc(size + _FRAME_OVERHEAD)
 
 
 #: The length header arrives BEFORE authentication, so it must not be
@@ -133,7 +225,10 @@ def recv_frame(sock):
     tag = _recv_exact(sock, 32)
     if tag is None:
         return None
-    blob = _recv_exact(sock, size)
+    # into a bytearray (writable): out-of-band tensor payloads become
+    # zero-copy WRITABLE views of this buffer instead of a second
+    # allocation + copy per multi-MB weight frame
+    blob = _recv_exact_into(sock, size)
     if blob is None:
         return None
     if not hmac.compare_digest(
@@ -142,7 +237,7 @@ def recv_frame(sock):
             "frame failed HMAC authentication (cluster secret mismatch "
             "or untrusted peer) — refusing to deserialize")
     _WIRE_RX.get().inc(size + _FRAME_OVERHEAD)
-    return pickle.loads(blob)
+    return decode_frame_payload(blob)
 
 
 def _recv_exact(sock, n):
@@ -153,6 +248,49 @@ def _recv_exact(sock, n):
             return None
         buf += chunk
     return bytes(buf)
+
+
+def _recv_exact_into(sock, n):
+    """Like :func:`_recv_exact` but receives straight into one
+    preallocated WRITABLE buffer (``recv_into``) — no per-chunk
+    concatenation, and the returned bytearray can back zero-copy
+    ndarray views."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
+            return None
+        got += r
+    return buf
+
+
+# -- raw (unauthenticated) framing -------------------------------------
+
+
+def send_raw_frame(sock, blob):
+    """Length-prefixed frame WITHOUT pickle or HMAC — for channels
+    whose payloads are inert bytes (the graphics npz stream,
+    ``veles/graphics.py``). Sent as two parts so the payload is never
+    copied into a concatenated frame."""
+    sock.sendall(struct.pack(">I", len(blob)))
+    sock.sendall(memoryview(blob))
+
+
+def recv_raw_frame(sock, max_bytes=MAX_FRAME_BYTES):
+    """Counterpart of :func:`send_raw_frame`: the hardened receive —
+    length cap BEFORE allocation, exact recv — shared so no caller
+    grows its own uncapped clone; ``None`` on EOF."""
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    size, = struct.unpack(">I", header)
+    if size > max_bytes:
+        raise ConnectionError(
+            "frame header claims %d bytes (cap %d) — dropping peer"
+            % (size, max_bytes))
+    return _recv_exact(sock, size)
 
 
 def framed_server(address, handle_request, done_event, on_drop,
@@ -176,6 +314,11 @@ def framed_server(address, handle_request, done_event, on_drop,
                 self.request.settimeout(timeout)
             slave_id = None
             clean = False
+            # a 2-tuple hello marks a pre-OOB peer: every reply on
+            # this connection must stay a legacy monolithic frame or
+            # the first array-carrying job payload would crash the
+            # old recv_frame (see the protocol docstring)
+            legacy = False
             try:
                 # NOT `while not done_event.is_set()`: that slammed
                 # the connection between recv and response, so a slave
@@ -190,13 +333,14 @@ def framed_server(address, handle_request, done_event, on_drop,
                         break
                     resp = handle_request(req)
                     if req[0] == "hello" and resp[0] == "welcome":
+                        legacy = len(req) < 3
                         if slave_id is not None and slave_id != resp[1]:
                             # a duplicated hello frame minted a second
                             # lease on this connection: revoke the one
                             # we stop tracking or it leaks forever
                             on_drop(slave_id)
                         slave_id = resp[1]
-                    send_frame(self.request, resp)
+                    send_frame(self.request, resp, legacy=legacy)
                     if resp[0] == "bye":
                         clean = True
                         break
@@ -236,9 +380,25 @@ class MasterServer(Logger):
                  slave_timeout=DEFAULT_SLAVE_TIMEOUT,
                  checkpoint_store=None, checkpoint_every=None,
                  resume_state=None,
-                 drain_timeout=DEFAULT_DRAIN_TIMEOUT):
+                 drain_timeout=DEFAULT_DRAIN_TIMEOUT,
+                 grad_codec="none", grad_topk_percent=1.0):
+        from veles import compression
         self.name = "MasterServer"
         self.workflow = workflow
+        #: gradient wire codec this master WANTS (veles/compression.py)
+        #: — negotiated per slave at hello: an agreeing slave gets it,
+        #: anything else (old peer, different config) falls back to
+        #: "none" with a counted warning
+        self.grad_codec = str(grad_codec or "none")
+        if self.grad_codec not in compression.CODEC_NAMES:
+            raise ValueError(
+                "unknown grad codec %r (known: %s)"
+                % (grad_codec, ", ".join(compression.CODEC_NAMES)))
+        self.grad_topk_percent = float(grad_topk_percent)
+        #: slave_id -> GradCodec encoding that slave's job payloads
+        #: (read by GradientDescentBase.generate_data_for_slave via
+        #: the workflow; all access under self.lock)
+        workflow.grad_codec_by_slave = {}
         host, _, port = str(address).rpartition(":")
         self.address = (host or "0.0.0.0", int(port))
         require_secret_for(self.address[0], "master listen")
@@ -278,7 +438,7 @@ class MasterServer(Logger):
         self.faults = {"drops": 0, "requeued_jobs": 0,
                        "fenced_updates": 0, "stale_jobs": 0,
                        "stale_pings": 0, "unmerged_updates": 0,
-                       "joins": 0}
+                       "codec_fallbacks": 0, "joins": 0}
         #: per-client-token (state, last_seen) of absorbed counter
         #: pushes (see _absorb_telemetry). One entry per SlaveClient
         #: instance; idle tokens are evicted after _TELE_TOKEN_TTL so
@@ -518,6 +678,28 @@ class MasterServer(Logger):
 
     # -- job lifecycle -------------------------------------------------
 
+    def _negotiate_codec(self, slave_id, name, offered):
+        """Pick the gradient wire codec for one hello (called under
+        self.lock). MASTER CONFIG WINS: a slave offering exactly the
+        master's codec gets it; anything else — an old peer that
+        offered nothing, a differently-configured one, or a codec
+        name this build doesn't know — falls back to ``"none"`` with
+        a counted warning, never a crash, so rolling upgrades and
+        mixed configs keep training (uncompressed for that slave)."""
+        from veles import compression
+        want = self.grad_codec
+        if (offered or "none") == want:
+            if want != "none":
+                self.workflow.grad_codec_by_slave[slave_id] = \
+                    compression.get_codec(want, self.grad_topk_percent)
+            return want
+        self._count_fault("codec_fallbacks")
+        self.warning(
+            "slave %d (%s) offered grad codec %r but master runs %r "
+            "— falling back to 'none' for this slave", slave_id,
+            name, offered, want)
+        return "none"
+
     def _live_slave(self, request):
         """The (slave_id, info) behind ``request`` iff its lease is
         live: the id is registered AND the lease_id matches what the
@@ -553,8 +735,12 @@ class MasterServer(Logger):
                 slave_id = self._next_slave
                 self._next_slave += 1
                 lease = secrets.token_hex(8)
+                codec = self._negotiate_codec(
+                    slave_id, request[1],
+                    request[2] if len(request) > 2 else None)
                 self.slaves[slave_id] = {
                     "name": request[1], "jobs": 0, "lease": lease,
+                    "codec": codec,
                     # job_id -> {trace, wall, perf} of the serve
                     # moment: the fencing set AND the per-hop latency
                     # anchor (wire round-trip = update arrival - wall)
@@ -565,10 +751,27 @@ class MasterServer(Logger):
                 self._count_fault("joins")
                 self._set_slaves_gauge()
                 telemetry.record_event("slave_joined", slave=slave_id,
-                                       name=str(request[1]))
-                self.info("slave %d (%s) joined, lease %s",
-                          slave_id, request[1], lease)
-                return ("welcome", slave_id, lease)
+                                       name=str(request[1]),
+                                       codec=codec)
+                self.info("slave %d (%s) joined, lease %s, codec %s",
+                          slave_id, request[1], lease, codec)
+                # a 2-tuple hello is a pre-codec peer: it gets the
+                # 3-tuple welcome it can unpack (absence == "none").
+                # A codec-aware hello ALWAYS earns the 4-tuple (codec
+                # possibly "none"): its presence is how the slave
+                # learns this master speaks the out-of-band frame
+                # format — a 3-tuple back means an OLD master, and
+                # the slave pins its own sends to legacy frames
+                if len(request) < 3:
+                    return ("welcome", slave_id, lease)
+                if codec == "topk":
+                    # master config wins for the sparsity level too:
+                    # K rides the welcome so a slave started with a
+                    # different --grad-topk-percent cannot silently
+                    # ship a different fraction of delta entries
+                    return ("welcome", slave_id, lease, codec,
+                            self.grad_topk_percent)
+                return ("welcome", slave_id, lease, codec)
             if kind == "ping":
                 _, info = self._live_slave(request)
                 if info is None:
@@ -711,6 +914,7 @@ class MasterServer(Logger):
                 return
             requeued = self.registry.drop_slave(slave_id)
             del self.slaves[slave_id]
+            self.workflow.grad_codec_by_slave.pop(slave_id, None)
             self._set_slaves_gauge()
             telemetry.record_event(
                 "lease_revoked", slave=slave_id, clean=bool(clean),
@@ -734,6 +938,7 @@ class MasterServer(Logger):
             for sid, info in self.slaves.items():
                 row = {
                     "name": info["name"], "jobs": info["jobs"],
+                    "codec": info.get("codec", "none"),
                     # prefix only: status.json is a dashboard surface,
                     # not a place to hand out whole fencing tokens
                     "lease": info["lease"][:6],
@@ -752,6 +957,7 @@ class MasterServer(Logger):
             return {
                 "mode": "master",
                 "epoch": self.epoch,
+                "grad_codec": self.grad_codec,
                 "max_epochs": self.max_epochs,
                 "complete": self.done.is_set(),
                 "slave_timeout": self.slave_timeout,

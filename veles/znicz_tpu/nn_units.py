@@ -605,39 +605,78 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
                 out.append((name, arr))
         return out
 
-    def generate_data_for_slave(self, slave=None):
+    def _param_values(self):
+        """Raw {name: float32 ndarray} of every wire parameter — the
+        pre-codec view both payload directions encode from."""
         return {name: numpy.array(arr.map_read().mem)
                 for name, arr in self._wire_params()}
+
+    def _codec_for(self, slave=None):
+        """The gradient wire codec (``veles/compression.py``) for one
+        payload: on the master, the per-slave encoder minted at hello
+        (``workflow.grad_codec_by_slave``, keyed by ``slave``); on the
+        slave, the single negotiated encoder (``workflow.grad_codec``,
+        set by SlaveClient.connect). ``None`` — in-process registries,
+        codec "none", pre-codec setups — means passthrough."""
+        wf = self.workflow
+        if slave is not None:
+            table = getattr(wf, "grad_codec_by_slave", None)
+            if table is not None:
+                return table.get(slave)
+        return getattr(wf, "grad_codec", None)
+
+    def generate_data_for_slave(self, slave=None):
+        values = self._param_values()
+        codec = self._codec_for(slave)
+        if codec is None:
+            return values
+        # dense weight broadcast: encoded stateless (the canonical
+        # fp32 weights live here, so broadcast error is fresh per job)
+        return {name: codec.encode_broadcast(
+            "%s/%s" % (self.name, name), value)
+            for name, value in values.items()}
 
     def apply_data_from_master(self, data):
         if not data:
             return
+        from veles import compression
+        decoded = {k: compression.decode(v) for k, v in data.items()}
         for name, arr in self._wire_params():
-            if name not in data:
+            if name not in decoded:
                 # fail loudly: silently skipping a declared parameter
                 # would let it diverge across slaves with no error
                 raise KeyError(
                     "%s: master payload missing %r (version skew?)"
                     % (self.name, name))
             arr.map_write()
-            arr.mem[...] = data[name]
-        # remember the basis the master handed us: updates ship as
-        # DELTAS against it (same bytes on the wire as full weights,
-        # but the master can apply each slave's training verbatim —
-        # a single-slave run reproduces standalone training exactly,
-        # and concurrent slaves' contributions ADD instead of each
-        # dragging the canonical weights halfway to its own copy)
+            arr.mem[...] = decoded[name]
+        # remember the basis the master handed us — the DECODED view,
+        # exactly what the local weights now hold: updates ship as
+        # DELTAS against it (the master can apply each slave's
+        # training verbatim — a single-slave run reproduces standalone
+        # training exactly, and concurrent slaves' contributions ADD
+        # instead of each dragging the canonical weights halfway to
+        # its own copy)
         self._master_basis = {
-            k: numpy.array(v) for k, v in data.items()}
+            k: numpy.array(v) for k, v in decoded.items()}
 
     def generate_data_for_master(self):
         basis = getattr(self, "_master_basis", None)
         if basis is None:
-            return self.generate_data_for_slave()
-        current = self.generate_data_for_slave()
+            return self._param_values()
+        current = self._param_values()
         # apply_data_from_master guarantees the basis covers every
         # wire param, so a KeyError here is a real protocol bug
-        return {"d" + k: current[k] - basis[k] for k in current}
+        deltas = {k: current[k] - basis[k] for k in current}
+        codec = self._codec_for(None)
+        if codec is None:
+            return {"d" + k: v for k, v in deltas.items()}
+        # the quantized/sparsified direction: deltas tolerate lossy
+        # encoding because the codec's error-feedback residual folds
+        # this sync's quantization error into the next delta
+        return {"d" + k: codec.encode_update(
+            "%s/%s" % (self.name, k), v)
+            for k, v in deltas.items()}
 
     def apply_data_from_slave(self, data, slave=None):
         """Merge one slave's training into the canonical weights.
@@ -645,17 +684,22 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         Delta payloads (``dweights``/``dbias``/...) apply additively
         scaled by ``slave_merge_scale`` (default 1.0). Absolute
         payloads fall back to the reference's halfway parameter
-        averaging [U]."""
+        averaging [U]. Encoded entries are self-describing
+        (``compression.decode``), so no per-slave codec state is
+        consulted here."""
         if not data:
             return
+        from veles import compression
         scale = float(getattr(self, "slave_merge_scale", 1.0))
         for key, arr in self._wire_params():
             if "d" + key in data:
                 arr.map_write()
-                arr.mem[...] += scale * data["d" + key]
+                arr.mem[...] += scale * compression.decode(
+                    data["d" + key])
             elif key in data:
                 arr.map_write()
-                arr.mem[...] = 0.5 * (arr.mem + data[key])
+                arr.mem[...] = 0.5 * (
+                    arr.mem + compression.decode(data[key]))
 
 
 class NNWorkflow(AcceleratedWorkflow):
@@ -680,6 +724,13 @@ class NNWorkflow(AcceleratedWorkflow):
         #: distributed role (set by the Launcher); slaves receive their
         #: minibatch index ranges from the master
         self.is_slave = False
+        #: gradient wire codec (veles/compression.py) — slave side:
+        #: the negotiated encoder, set by SlaveClient.connect from the
+        #: hello exchange; None = uncompressed
+        self.grad_codec = None
+        #: master side: slave_id -> per-slave encoder, owned/locked by
+        #: MasterServer (minted at hello, dropped with the lease)
+        self.grad_codec_by_slave = {}
 
     def export_inference(self, path):
         """Write the C++-engine archive (contents.json + .npy weights)
