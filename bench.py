@@ -35,7 +35,11 @@ runtime increments per served minibatch (``_train_counter``), so bench
 figures and a /metrics scrape of the same run can never disagree.
 """
 
+import argparse
+import glob
 import json
+import os
+import re
 import sys
 import time
 
@@ -618,7 +622,136 @@ def _device_reachable(timeout_s=240):
     return True, out["devices"]
 
 
-def main():
+# -- self-check: the bench trajectory as a first-class diff ------------
+
+#: keys where SMALLER is better (wire bytes); everything else numeric
+#: in the report is a throughput/efficiency figure where bigger wins
+_LOWER_BETTER = ("bytes",)
+
+#: keys that are environment stamps, not performance rows
+_SELF_CHECK_SKIP = ("calibration",)
+
+
+def _latest_bench_artifact(directory=None):
+    """Newest ``BENCH_r*.json`` next to this file (natural-sorted by
+    round number), or None."""
+    directory = directory or os.path.dirname(os.path.abspath(__file__))
+    def round_no(path):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+    files = [p for p in glob.glob(os.path.join(directory,
+                                               "BENCH_r*.json"))
+             if round_no(p) >= 0]
+    return max(files, key=round_no) if files else None
+
+
+def _flatten_rows(report):
+    """One {key: number} dict out of a bench report — the primary
+    metric under its name plus every numeric ``extra`` row (error
+    strings, provenance dicts and *_best duplicates excluded: the
+    deltas compare the stable median convention keys)."""
+    rows = {}
+    if isinstance(report.get("value"), (int, float)) \
+            and report.get("metric"):
+        rows[str(report["metric"])] = float(report["value"])
+    for key, value in (report.get("extra") or {}).items():
+        if not isinstance(value, (int, float)) \
+                or isinstance(value, bool):
+            continue
+        if key.endswith("_best") \
+                or any(s in key for s in _SELF_CHECK_SKIP):
+            continue
+        rows[key] = float(value)
+    return rows
+
+
+def self_check(report, threshold_pct=10.0, baseline_path=None,
+               stream=sys.stderr):
+    """Compare this run's rows against the latest recorded bench
+    artifact and print per-row deltas — WARN-ONLY (the trajectory was
+    previously invisible without manually diffing BENCH_r*.json; this
+    never changes the exit code or the report). A row regresses when
+    it moves more than ``threshold_pct`` percent in its bad direction
+    (down for throughput, up for byte counts); -> the regressed keys.
+    """
+    path = baseline_path or _latest_bench_artifact()
+    if path is None:
+        print("self-check: no BENCH_r*.json baseline found — "
+              "nothing to compare", file=stream)
+        return []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        print("self-check: cannot read %s (%s) — skipped"
+              % (path, exc), file=stream)
+        return []
+    old = _flatten_rows(doc.get("parsed") or doc)
+    new = _flatten_rows(report)
+    common = sorted(set(old) & set(new))
+    if not common:
+        print("self-check: no comparable rows vs %s" % path,
+              file=stream)
+        return []
+    print("self-check vs %s (threshold ±%g%%):"
+          % (os.path.basename(path), threshold_pct), file=stream)
+    regressed = []
+    for key in common:
+        was, now = old[key], new[key]
+        if was == 0:
+            continue
+        pct = (now - was) / abs(was) * 100.0
+        lower_better = any(s in key for s in _LOWER_BETTER)
+        bad = pct > threshold_pct if lower_better \
+            else pct < -threshold_pct
+        flag = "  << REGRESSION" if bad else ""
+        if bad:
+            regressed.append(key)
+        print("  %-44s %14.6g -> %14.6g  %+7.1f%%%s"
+              % (key, was, now, pct, flag), file=stream)
+    dropped = sorted(set(old) - set(new))
+    if dropped:
+        # a silently vanished row reads as "fine" without this line
+        print("  (rows in baseline but not this run: %s)"
+              % ", ".join(dropped), file=stream)
+    print("self-check: %d row(s) compared, %d regression(s) beyond "
+          "±%g%% (warn-only)" % (len(common), len(regressed),
+                                 threshold_pct), file=stream)
+    return regressed
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Benchmark entry: prints ONE JSON report line; "
+                    "--self-check additionally diffs the rows "
+                    "against the latest BENCH_r*.json (warn-only)")
+    p.add_argument("--self-check", action="store_true",
+                   help="compare this run's rows to the newest "
+                        "BENCH_r*.json and print per-row deltas to "
+                        "stderr (never changes the exit code)")
+    p.add_argument("--self-check-threshold", type=float, default=10.0,
+                   metavar="PCT",
+                   help="flag rows moving more than PCT%% in their "
+                        "bad direction (default 10)")
+    p.add_argument("--self-check-baseline", default=None,
+                   metavar="PATH",
+                   help="explicit baseline artifact (default: "
+                        "newest BENCH_r*.json next to bench.py)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+
+    def emit(report, rc=0):
+        print(json.dumps(report))
+        if args.self_check:
+            self_check(report,
+                       threshold_pct=args.self_check_threshold,
+                       baseline_path=args.self_check_baseline)
+        return rc
+
     ok, detail = _device_reachable()
     if not ok:
         # the serving + wire rows are device-independent: still
@@ -626,14 +759,13 @@ def main():
         extra = {"device_error": detail[:300]}
         _serving_row(extra)
         _grad_codec_rows(extra)
-        print(json.dumps({
+        return emit({
             "metric": "mnist_train_steps_per_sec",
             "value": 0.0,
             "unit": "steps/s",
             "vs_baseline": 0.0,
             "extra": extra,
-        }))
-        return 1
+        }, rc=1)
     extra = {}
     try:
         # calibration FIRST: a fixed device-only matmul rate stamps
@@ -702,13 +834,13 @@ def main():
         _reg.counter_total("veles_step_flops_total"))
     extra["runtime_step_bytes_total"] = int(
         _reg.counter_total("veles_step_bytes_total"))
-    print(json.dumps({
+    return emit({
         "metric": "mnist_train_steps_per_sec",
         "value": round(fast_median, 2),
         "unit": "steps/s",
         "vs_baseline": round(fast_median / base, 3),
         "extra": extra,
-    }))
+    })
 
 
 if __name__ == "__main__":

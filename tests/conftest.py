@@ -63,3 +63,15 @@ def _telemetry_isolation():
         yield
     telemetry.tracer.stop()
     telemetry.tracer.clear()
+
+
+@pytest.fixture(autouse=True)
+def _health_isolation():
+    """Each test gets a fresh health monitor (veles/health.py): the
+    readiness checks and SLO alert state one test registers (web
+    status, serving frontends, masters) can never leak into another.
+    The monitor is closed on exit so no sampler thread outlives its
+    test."""
+    from veles import health
+    with health.scoped():
+        yield

@@ -769,6 +769,74 @@ def spawn(work):
                     select=["thread-lifecycle"]) == []
 
 
+# -- probe-purity ------------------------------------------------------
+
+_PROBE_BAD = """\
+import urllib.request
+
+
+class Handler:
+    def do_GET(self):
+        if self.path == "/healthz":
+            with self.server.lock:
+                doc = self.master.status()
+            self._reply(200, doc)
+        elif self.path.startswith("/readyz"):
+            body = urllib.request.urlopen(
+                "http://peer:8080/metrics").read()
+            self._reply(200, body)
+        else:
+            self._reply(404, {})
+"""
+
+_PROBE_GOOD = """\
+class Handler:
+    def do_GET(self):
+        if self.path.startswith(("/healthz", "/readyz")):
+            code, doc = monitor.probe(self.path)
+            self._reply(code, doc)
+        elif self.path.startswith("/metrics"):
+            with self.lock:
+                body = registry.render_prometheus()
+            self._reply(200, body)
+        else:
+            self._reply(404, {})
+"""
+
+
+def test_probe_purity_fires_on_blocking_probe_branches(tmp_path):
+    """Satellite (ISSUE 8): /healthz taking a lock + pulling live
+    status, /readyz fetching over the network — every blocking shape
+    fires; the hint points at the cached-verdict contract."""
+    findings = lint_src(tmp_path, _PROBE_BAD, select=["probe-purity"])
+    assert set(rule_ids(findings)) == {"probe-purity"}
+    messages = " | ".join(f.message for f in findings)
+    assert "context-managed" in messages       # the with-lock
+    assert "'status'" in messages              # the live state pull
+    assert "'urlopen'" in messages             # the network fetch
+    assert len(findings) >= 3
+
+
+def test_probe_purity_quiet_on_cached_reads_and_other_routes(tmp_path):
+    """The compliant shape — probe branches read the monitor's cached
+    verdict — is quiet, and a with-lock in a NON-probe branch
+    (/metrics) is out of scope for this rule."""
+    assert lint_src(tmp_path, _PROBE_GOOD,
+                    select=["probe-purity"]) == []
+
+
+def test_probe_purity_pragma_suppresses(tmp_path):
+    src = """\
+class Handler:
+    def do_GET(self):
+        if self.path == "/healthz":
+            with self.lock:  # zlint: disable=probe-purity (test rig)
+                doc = dict(self.cache)
+            self._reply(200, doc)
+"""
+    assert lint_src(tmp_path, src, select=["probe-purity"]) == []
+
+
 # -- hygiene: bare-except / unused-import / unused-variable ------------
 
 

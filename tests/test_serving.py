@@ -388,7 +388,9 @@ def test_http_predict_round_trip(mnist_artifact):
         reg.load("mnist", mnist_artifact["archive"])
         front = ServingFrontend(reg, port=0)
         base = "http://127.0.0.1:%d" % front.port
-        assert _get(base + "/healthz") == {"status": "ok"}
+        assert _get(base + "/healthz")["status"] == "ok"
+        # a loaded warm model on a closed-breaker registry is READY
+        assert _get(base + "/readyz")["ready"] is True
         models = _get(base + "/v1/models")["models"]
         assert [m["name"] for m in models] == ["mnist"]
 

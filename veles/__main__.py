@@ -39,7 +39,15 @@ pulls the flight-recorder postmortem surfaces (``/debug/events``,
 ``/debug/trace``) off a LIVE web-status dashboard or serving
 frontend — recent structured events printed as a table, the retained
 span window written as Perfetto JSON. Works on a degraded cluster
-that was never started with ``--trace-out``.
+that was never started with ``--trace-out``;
+
+    python -m veles top http://host:port [...] [--json]
+
+the live fleet dashboard (``veles/fleet.py``): polls every target's
+``/healthz`` + ``/readyz`` + ``/metrics`` + status surfaces, merges
+the master's per-slave timing, and renders a refreshing terminal
+view — ``--json`` emits one machine-readable snapshot (the artifact
+a router/autoscaler consumes).
 """
 
 import argparse
@@ -157,6 +165,11 @@ def build_argparser():
                    metavar="PORT",
                    help="serve the status dashboard on this port "
                         "(0 = pick a free one)")
+    p.add_argument("--slo-config", default=None, metavar="PATH",
+                   help="JSON list of SLO objectives for the health "
+                        "monitor (veles/health.py): burn-rate alerts "
+                        "land in /readyz, /debug/events and the "
+                        "veles_slo_* gauges on --web-status")
     p.add_argument("--export-inference", default=None, metavar="DIR",
                    help="after the run, export the C++-engine archive "
                         "(contents.json + .npy) to DIR")
@@ -271,7 +284,8 @@ class Main:
             slave_options=slave_options,
             checkpoint_every=args.checkpoint_every,
             grad_codec=args.grad_codec,
-            grad_topk_percent=args.grad_topk_percent)
+            grad_topk_percent=args.grad_topk_percent,
+            slo_config=args.slo_config)
         if args.graphics_dir and not getattr(
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):
@@ -687,6 +701,11 @@ def main(argv=None):
         # flight-recorder postmortem: /debug/events + /debug/trace
         # off a live web-status or serving endpoint
         return debug_main(argv[1:])
+    if argv and argv[0] == "top":
+        # live fleet dashboard / --json snapshot over N processes'
+        # health + metrics surfaces (veles/fleet.py)
+        from veles.fleet import top_main
+        return top_main(argv[1:])
     m = Main(argv)
     if getattr(m.args, "background", False):
         if not daemonize(m.args.log_file):

@@ -29,6 +29,9 @@ rule id                   checks
                           explaining why the state is ephemeral)
 ``telemetry-hygiene``     instrument families created inside loops;
                           unbounded label values minted from ids
+``probe-purity``          ``/healthz``/``/readyz`` handler branches
+                          read cached state only — no locks, no
+                          network, no live state pulls
 ``thread-lifecycle``      threads must be daemons or have a join path
 ``bare-except``           ``except:`` swallows ``KeyboardInterrupt``
 ``unused-import``         dead module-level imports

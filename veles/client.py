@@ -300,14 +300,19 @@ class SlaveClient(Logger):
         ctx = telemetry.TraceContext.from_wire(resp[4]) \
             if len(resp) > 4 else None
         spans = []
-        t0 = time.perf_counter()
-        self.registry.apply_job(payload)
-        t1 = time.perf_counter()
-        self._job_span(spans, ctx, "slave.apply", t0, t1 - t0, job_id)
-        self._run_iteration()
-        t2 = time.perf_counter()
-        self._job_span(spans, ctx, "slave.compute", t1, t2 - t1,
-                       job_id)
+        # bind the job's trace for the whole local iteration: log
+        # lines emitted while computing on its behalf carry the ids
+        # (JSONL sink — veles/logger.py) and join /debug/trace spans
+        with telemetry.context(ctx):
+            t0 = time.perf_counter()
+            self.registry.apply_job(payload)
+            t1 = time.perf_counter()
+            self._job_span(spans, ctx, "slave.apply", t0, t1 - t0,
+                           job_id)
+            self._run_iteration()
+            t2 = time.perf_counter()
+            self._job_span(spans, ctx, "slave.compute", t1, t2 - t1,
+                           job_id)
         # count the job BEFORE building the pushed state: the state
         # rides the update that completes this very job, so the master
         # sees N jobs after N accepted updates (post-ack counting

@@ -1,0 +1,321 @@
+"""Fleet aggregator + ``velescli top``: one view over N processes.
+
+The health plane (``veles/health.py``) gives every process probes,
+metrics history and SLO alerts; this module is the CLUSTER side — a
+scraper that polls N targets' ``/healthz`` + ``/readyz`` +
+``/metrics`` + ``/status.json`` + ``/metrics.json`` surfaces, merges
+the per-slave timing the master already reports in
+``MasterServer.status()``, and renders either a live refreshing
+terminal dashboard (``velescli top URL...``) or one machine-readable
+snapshot (``--json``) — the artifact a router tier or autoscaler
+consumes (ROADMAP item 2).
+
+Every fetch is best-effort per endpoint: a serving frontend has no
+``/status.json``, an old process has no ``/readyz`` — missing
+surfaces degrade the row, never kill the scrape. Non-200 probe
+answers (a 503 ``/readyz`` carries the reason JSON) are read, not
+treated as transport errors.
+"""
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+#: one Prometheus exposition sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+_ESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _unescape(value):
+    # ONE left-to-right pass: sequential str.replace mis-decodes
+    # values like 'C:\\\\new' (an escaped backslash followed by a
+    # literal n must not become a newline)
+    return _ESCAPE_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), value)
+
+
+def parse_prometheus(text):
+    """Prometheus text exposition -> ``{(name, label_items): value}``
+    with ``label_items`` a sorted tuple of (key, value) pairs.
+    Comment/HELP/TYPE lines and malformed rows are skipped — a scrape
+    must survive whatever a half-written exposition contains."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, labels, value = m.groups()
+        try:
+            v = float(value)
+        except ValueError:
+            continue
+        items = tuple(sorted(
+            (k, _unescape(raw))
+            for k, raw in _LABEL_RE.findall(labels or "")))
+        out[(name, items)] = v
+    return out
+
+
+def metric_total(metrics, name, **match):
+    """Sum of ``name`` samples whose labels contain every ``match``
+    item (the scrape-side sibling of ``Registry.counter_total``)."""
+    want = {(k, str(v)) for k, v in match.items()}
+    total, hit = 0.0, False
+    for (n, items), v in metrics.items():
+        if n == name and want <= set(items):
+            total += v
+            hit = True
+    return total if hit else None
+
+
+def _fetch(url, timeout):
+    """(status_code, body_bytes) — HTTP error codes are ANSWERS here
+    (a 503 /readyz carries the reason payload), only transport
+    failures raise."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def _fetch_json(url, timeout):
+    code, body = _fetch(url, timeout)
+    return code, json.loads(body)
+
+
+def scrape_target(base, timeout=5.0):
+    """Poll one process's health surfaces; -> its merged row dict.
+    ``base`` is ``http://host:port`` of a web-status dashboard or a
+    serving frontend."""
+    base = base.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    row = {"url": base, "reachable": False}
+    try:
+        code, body = _fetch(base + "/healthz", timeout)
+    except Exception as exc:
+        row["error"] = "%s: %s" % (type(exc).__name__, exc)
+        return row
+    # ANY HTTP answer proves the process is up — a pre-health-plane
+    # dashboard 404s /healthz with a text body, and must degrade the
+    # row (live=False, no probe doc), never read as DOWN
+    row["reachable"] = True
+    row["live"] = code == 200
+    try:
+        row["healthz"] = json.loads(body)
+    except ValueError:
+        row["healthz"] = None
+    try:
+        code, doc = _fetch_json(base + "/readyz", timeout)
+        row["ready"] = code == 200
+        row["reasons"] = list(doc.get("reasons", ()))
+        row["checks"] = doc.get("checks", {})
+        row["slos"] = doc.get("slos", {})
+    except Exception:
+        row["ready"] = None          # pre-health-plane process
+        row["reasons"] = []
+        row["slos"] = {}
+    try:
+        _, body = _fetch(base + "/metrics", timeout)
+        metrics = parse_prometheus(body.decode("utf-8", "replace"))
+    except Exception:
+        metrics = {}
+    row["firing"] = sorted(
+        dict(items).get("objective", "?")
+        for (name, items), v in metrics.items()
+        if name == "veles_slo_alert_firing" and v > 0)
+    summary = {}
+    tx = metric_total(metrics, "veles_wire_bytes_total",
+                      direction="tx")
+    if tx is not None:
+        summary["wire_tx_bytes"] = tx
+    for key, name in (("serving_requests",
+                       "veles_serving_requests_total"),
+                      ("serving_rejected",
+                       "veles_serving_rejected_total"),
+                      ("serving_queue_rows",
+                       "veles_serving_queue_rows"),
+                      ("cluster_slaves", "veles_cluster_slaves"),
+                      ("cluster_faults",
+                       "veles_cluster_faults_total")):
+        v = metric_total(metrics, name)
+        if v is not None:
+            summary[key] = v
+    row["metrics"] = summary
+    # serving side: the per-model JSON view (rps, p99, queue, shed)
+    try:
+        code, doc = _fetch_json(base + "/metrics.json", timeout)
+        if code == 200 and isinstance(doc, dict) \
+                and isinstance(doc.get("models"), dict):
+            row["serving"] = doc["models"]
+    except Exception:
+        pass
+    # training side: the dashboard's status providers — the master's
+    # row carries cluster topology + per-slave last-job timing
+    try:
+        code, doc = _fetch_json(base + "/status.json", timeout)
+        if code == 200 and isinstance(doc, dict):
+            row["status"] = doc
+            for st in doc.values():
+                if isinstance(st, dict) and "slaves" in st:
+                    row["master"] = {
+                        "epoch": st.get("epoch"),
+                        "max_epochs": st.get("max_epochs"),
+                        "n_slaves": st.get("n_slaves"),
+                        "complete": st.get("complete"),
+                        "faults": st.get("faults"),
+                        "slaves": st.get("slaves"),
+                    }
+    except Exception:
+        pass
+    row["role"] = "master" if "master" in row else (
+        "serving" if "serving" in row else "process")
+    return row
+
+
+def fleet_snapshot(targets, timeout=5.0):
+    """Scrape every target; -> the merged fleet document (what
+    ``velescli top --json`` prints and an autoscaler consumes)."""
+    rows = [scrape_target(t, timeout=timeout) for t in targets]
+    firing = sorted({name for r in rows
+                     for name in r.get("firing", ())})
+    degraded = sorted(
+        r["url"] for r in rows
+        if not r.get("reachable") or r.get("ready") is False)
+    return {
+        "ts": round(time.time(), 3),
+        "targets": rows,
+        "fleet": {
+            "targets": len(rows),
+            "reachable": sum(1 for r in rows if r.get("reachable")),
+            "ready": sum(1 for r in rows if r.get("ready")),
+            "firing_slos": firing,
+            "degraded": degraded,
+            "slaves": int(sum(
+                r.get("metrics", {}).get("cluster_slaves", 0)
+                for r in rows)),
+        },
+    }
+
+
+# -- rendering ----------------------------------------------------------
+
+
+def _fmt_ready(row):
+    if not row.get("reachable"):
+        return "DOWN"
+    if row.get("ready") is None:
+        return "live"
+    return "ready" if row["ready"] else "NOT-READY"
+
+
+def render_snapshot(snap):
+    """The terminal dashboard body for one fleet snapshot."""
+    lines = []
+    fleet = snap["fleet"]
+    lines.append(
+        "veles fleet — %d target(s), %d reachable, %d ready, "
+        "%d slave(s)%s" % (
+            fleet["targets"], fleet["reachable"], fleet["ready"],
+            fleet["slaves"],
+            "  !! SLO firing: %s" % ", ".join(fleet["firing_slos"])
+            if fleet["firing_slos"] else ""))
+    lines.append("")
+    lines.append("%-28s %-9s %-8s %s"
+                 % ("TARGET", "STATE", "ROLE", "DETAIL"))
+    for row in snap["targets"]:
+        detail = []
+        if not row.get("reachable"):
+            detail.append(row.get("error", "unreachable"))
+        master = row.get("master")
+        if master:
+            detail.append("epoch %s/%s, %s slave(s)"
+                          % (master.get("epoch"),
+                             master.get("max_epochs"),
+                             master.get("n_slaves")))
+            faults = master.get("faults") or {}
+            busy = {k: v for k, v in faults.items()
+                    if v and k != "joins"}
+            if busy:
+                detail.append("faults " + ",".join(
+                    "%s=%s" % kv for kv in sorted(busy.items())))
+        for model, m in sorted((row.get("serving") or {}).items()):
+            detail.append(
+                "%s v%s: %s rps, p99 %sms, queue %s, shed %s"
+                % (model, m.get("version"),
+                   m.get("requests_per_sec"),
+                   m.get("latency_ms_p99", "-"),
+                   m.get("queue_depth"), m.get("shed_total")))
+        if row.get("firing"):
+            detail.append("SLO firing: " + ",".join(row["firing"]))
+        if row.get("ready") is False:
+            detail.extend(row.get("reasons", ()))
+        lines.append("%-28s %-9s %-8s %s"
+                     % (row["url"].replace("http://", ""),
+                        _fmt_ready(row), row.get("role", "-"),
+                        "; ".join(str(d) for d in detail) or "-"))
+        for sid, srow in sorted(
+                ((master or {}).get("slaves") or {}).items()):
+            lines.append(
+                "%-28s %-9s %-8s jobs %s, rtt %ss, compute %ss, "
+                "wire %ss, idle %ss"
+                % ("  slave %s (%s)" % (sid, srow.get("name")),
+                   "", "", srow.get("jobs"), srow.get("last_rtt_s"),
+                   srow.get("last_job_s"), srow.get("last_wire_s"),
+                   srow.get("idle_s")))
+    return "\n".join(lines)
+
+
+def top_main(argv=None):
+    """``velescli top URL [URL...]`` — live fleet dashboard; with
+    ``--json`` print ONE snapshot document and exit (0 when every
+    target is reachable, 2 when none is)."""
+    p = argparse.ArgumentParser(
+        prog="velescli top",
+        description="Live cluster dashboard over /healthz + /readyz "
+                    "+ /metrics + status surfaces of web-status "
+                    "dashboards and serving frontends")
+    p.add_argument("targets", nargs="+",
+                   help="base URLs (http://host:port) of web-status "
+                        "dashboards and/or serving frontends")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds (live mode)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-request HTTP timeout")
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable snapshot and "
+                        "exit (the autoscaler/router artifact)")
+    p.add_argument("--once", action="store_true",
+                   help="render one dashboard frame and exit")
+    args = p.parse_args(argv)
+    if args.json or args.once:
+        snap = fleet_snapshot(args.targets, timeout=args.timeout)
+        if args.json:
+            print(json.dumps(snap, indent=2))
+        else:
+            print(render_snapshot(snap))
+        return 0 if snap["fleet"]["reachable"] else 2
+    try:
+        while True:
+            snap = fleet_snapshot(args.targets, timeout=args.timeout)
+            # clear + home, then one frame (same trick real top uses)
+            sys.stdout.write("\x1b[2J\x1b[H")
+            sys.stdout.write(render_snapshot(snap) + "\n")
+            sys.stdout.write(
+                "\n[%s] refreshing every %gs — ^C to quit\n"
+                % (time.strftime("%H:%M:%S"), args.interval))
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0

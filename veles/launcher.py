@@ -37,7 +37,8 @@ class Launcher(Logger):
                  graphics_dir=None, web_status_port=None,
                  profile_dir=None, slave_timeout=None,
                  slave_options=None, checkpoint_every=None,
-                 grad_codec=None, grad_topk_percent=None):
+                 grad_codec=None, grad_topk_percent=None,
+                 slo_config=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
@@ -61,6 +62,10 @@ class Launcher(Logger):
         self.grad_codec = grad_codec or "none"
         self.grad_topk_percent = 1.0 if grad_topk_percent is None \
             else float(grad_topk_percent)
+        #: path to a JSON list of SLO objectives for the in-process
+        #: health monitor (veles/health.py): burn-rate alerts land in
+        #: /readyz, /debug/events and the veles_slo_* gauges
+        self.slo_config = slo_config
         self.workflow = None
         self.interrupted = False
         #: True once SIGTERM asked for a preemption shutdown: the run
@@ -136,6 +141,11 @@ class Launcher(Logger):
             self.web_status = WebStatus(port=self.web_status_port)
             self.web_status.register(
                 workflow.name, workflow_status(workflow, self.mode))
+        if self.slo_config:
+            from veles import health
+            n = health.get_monitor().load_slo_file(self.slo_config)
+            self.info("%d SLO objective(s) loaded from %s", n,
+                      self.slo_config)
         return workflow
 
     # -- resume --------------------------------------------------------
@@ -314,6 +324,9 @@ class Launcher(Logger):
             # cluster topology on the dashboard: connected slaves and
             # their job counts straight from the server registry
             self.web_status.register("cluster", server.status)
+        # /healthz + /readyz on the dashboard reflect THIS master:
+        # lease table serving, snapshot-store breaker closed
+        server.register_health()
         server.serve_forever()
 
     def _run_slave(self):

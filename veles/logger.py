@@ -57,6 +57,19 @@ class _JsonlHandler(logging.Handler):
                 "name": record.name,
                 "msg": record.getMessage(),
             }
+            # trace correlation: when the emitting thread works on
+            # behalf of a traced request/job (telemetry.context), the
+            # line carries the ids so /debug/trace spans and JSONL
+            # rows join on one key. Imported lazily — logging must
+            # never depend on telemetry import order.
+            try:
+                from veles import telemetry
+                ctx = telemetry.current_context()
+            except Exception:
+                ctx = None
+            if ctx is not None:
+                doc["trace_id"] = ctx.trace_id
+                doc["span_id"] = ctx.span_id
             if record.exc_info:
                 # serialize the formatted traceback: structured logs
                 # must be usable for postmortems, and exc_info itself

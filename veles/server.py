@@ -623,6 +623,44 @@ class MasterServer(Logger):
         self.checkpoint_store = None
         self._stop_serving.set()
 
+    # -- health (veles/health.py) --------------------------------------
+
+    def register_health(self, monitor=None):
+        """Attach this master's readiness to the process health
+        monitor (the Launcher does this in master mode; ``/readyz``
+        on the web-status dashboard serves the cached verdict):
+
+        * ``master:lease_table`` — the listener is bound and the
+          serving loop has not stopped (completed or aborted runs
+          report not-ready so a supervisor stops routing to them);
+        * ``master:snapshot_store`` — the checkpoint store's circuit
+          breaker is closed (persistence is not fast-failing).
+
+        The checks run on the MONITOR thread and read plain
+        attributes — never the master request lock."""
+        from veles import health
+        monitor = monitor or health.get_monitor()
+
+        def lease_table():
+            if self.done.is_set():
+                return False, "run complete"
+            if self._stop_serving.is_set():
+                return False, "serving stopped (preempted/killed)"
+            if not hasattr(self, "bound_address"):
+                return False, "listener not bound yet"
+            return True, None
+
+        monitor.add_check("master:lease_table", lease_table)
+        store = self.checkpoint_store
+        if store is not None and hasattr(store, "breaker_open"):
+            def snapshot_store():
+                if store.breaker_open():
+                    return False, ("snapshot-store circuit breaker "
+                                   "open (persists fast-failing)")
+                return True, None
+            monitor.add_check("master:snapshot_store", snapshot_store)
+        return monitor
+
     # -- telemetry -----------------------------------------------------
 
     def _count_fault(self, kind, n=1):
@@ -864,7 +902,11 @@ class MasterServer(Logger):
                     info["last_wire_s"] = wire
                 ctx = served["trace"]
                 t_merge = time.perf_counter()
-                merged = self.registry.apply_update(data, slave_id)
+                # merge under the job's trace context: any log line
+                # the merge emits joins the distributed trace (the
+                # JSONL sink stamps trace_id/span_id)
+                with telemetry.context(ctx):
+                    merged = self.registry.apply_update(data, slave_id)
                 if telemetry.tracer.active:
                     if wire is not None:
                         telemetry.tracer.add_complete(

@@ -13,7 +13,16 @@ Endpoints:
 * ``POST /v1/predict`` — ``{"model": name, "inputs": [[...], ...],
   "timeout_ms": 250}`` -> ``{"outputs": [...], "version": n}``;
   503 when shed (queue full), 504 when the deadline expired
-* ``GET  /healthz``      — liveness
+* ``GET  /healthz``      — liveness (cached, non-blocking probe)
+* ``GET  /readyz``       — readiness: 200 only while the registry
+  holds a warm model, no snapshot-store circuit breaker is open, the
+  batcher is not shedding above threshold and no SLO burn-rate alert
+  fires — 503 with a machine-readable reason list otherwise
+  (``veles/health.py``; checks run on the monitor thread, the probe
+  handler reads one cached attribute)
+* ``GET  /metrics/history`` — the health monitor's time-series ring
+  (``?window=SECS``): sampled latency percentiles, queue depth,
+  counters — what ``velescli top`` and an autoscaler trend on
 * ``GET  /metrics``      — Prometheus text exposition of the process
   telemetry registry (serving latency histograms, queue gauges, shed/
   expired counters — plus whatever else this process instruments)
@@ -41,9 +50,33 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy
 
-from veles import telemetry
+from veles import health, telemetry
 from veles.logger import Logger
 from veles.serving.batcher import DeadlineExceeded, QueueFull
+
+#: overload rejections by reason (satellite, ISSUE 8): "shed" = the
+#: micro-batcher's queue was full, "not_ready" = readiness was false
+#: (no warm model / breaker open / SLO firing) — both answer 503 +
+#: Retry-After instead of a generic failure
+_REJECTED = {
+    reason: telemetry.LazyChild(
+        lambda r=reason: telemetry.counter(
+            "veles_serving_rejected_total",
+            "Requests rejected with 503 before any forward compute, "
+            "by reason", ("reason",)).labels(r))
+    for reason in ("shed", "not_ready")}
+
+#: Retry-After (seconds) sent with 503s: shed queues drain within a
+#: batching window; readiness usually needs a reload/recovery cycle
+RETRY_AFTER_SHED = 1
+RETRY_AFTER_NOT_READY = 5
+
+#: batcher-shedding readiness threshold: the process reports NOT
+#: ready when more than this fraction of recent submissions (between
+#: two monitor ticks, with a minimum volume) was shed — a router can
+#: then drain it instead of hammering a saturated queue
+SHED_READY_RATIO = 0.9
+SHED_READY_MIN = 16
 
 
 class ServingFrontend(Logger):
@@ -73,8 +106,13 @@ class ServingFrontend(Logger):
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    self._reply(200, {"status": "ok"})
+                if self.path.startswith(("/healthz", "/readyz",
+                                         "/metrics/history")):
+                    # probe contract (zlint probe-purity): serve the
+                    # monitor's CACHED verdict — no locks, no
+                    # registry scans, no network on this path
+                    code, payload = health.health_endpoint(self.path)
+                    self._reply(code, payload)
                 elif self.path.startswith("/metrics.json"):
                     # the pre-registry JSON shape, now a view over
                     # the telemetry registry
@@ -118,16 +156,106 @@ class ServingFrontend(Logger):
                                 headers=tp_header)
                     return
                 code, reply = front.predict_request(doc, trace=trace)
-                self._reply(code, reply, headers=tp_header)
+                headers = tp_header
+                if code == 503:
+                    # overload/readiness rejection: tell the caller
+                    # WHEN to come back instead of a generic failure
+                    headers = tp_header + (
+                        ("Retry-After",
+                         str(reply.get("retry_after_s",
+                                       RETRY_AFTER_SHED))),)
+                self._reply(code, reply, headers=headers)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self.host = host
+        # health wiring BEFORE the listener thread: the first request
+        # may arrive the instant the port is served, and the predict
+        # gate reads self._monitor
+        self._check_names = ()
+        self._shed_seen = None
+        self.register_health()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="serving-http")
         self._thread.start()
         self.info("serving on http://%s:%d/", host, self.port)
+
+    # -- readiness (veles/health.py) -----------------------------------
+
+    def register_health(self, monitor=None):
+        """Wire this frontend's readiness into the health monitor.
+        The checks run on the MONITOR thread (they may take the
+        registry lock and read breaker state); ``/readyz`` serves the
+        cached verdict. Names carry the port so several frontends in
+        one process (tests) keep distinct checks."""
+        monitor = monitor or health.get_monitor()
+        self._monitor = monitor
+        prefix = "serving:%d" % self.port
+        self._check_names = (prefix + ":models",
+                             prefix + ":snapshot_store",
+                             prefix + ":shedding")
+        # one tick for the batch, not one per check
+        monitor.add_check(self._check_names[0], self._check_models,
+                          tick=False)
+        monitor.add_check(self._check_names[1], self._check_stores,
+                          tick=False)
+        monitor.add_check(self._check_names[2], self._check_shedding)
+        return monitor
+
+    def _check_models(self):
+        """Ready iff the registry serves at least one model and no
+        requested warmup is still compiling its bucket ladder."""
+        names = self.registry.names()
+        if not names:
+            return False, "no models loaded"
+        cold = [e.name for e in self._entries()
+                if not getattr(e, "warm", True)]
+        if cold:
+            return False, "warmup in progress: %s" % ", ".join(cold)
+        return True, None
+
+    def _entries(self):
+        out = []
+        for name in self.registry.names():
+            try:
+                out.append(self.registry.get(name))
+            except KeyError:       # unloaded between names() and get()
+                continue
+        return out
+
+    def _check_stores(self):
+        """Fail while any model's HTTP checkpoint store has its
+        circuit breaker open (refreshes are fast-failing)."""
+        broken = []
+        for entry in self._entries():
+            store = self.registry._checkpoint_store(entry.checkpoint)
+            if store is not None and store.breaker_open():
+                broken.append(entry.name)
+        if broken:
+            return False, ("snapshot-store breaker open for: %s"
+                           % ", ".join(broken))
+        return True, None
+
+    def _check_shedding(self):
+        """Fail while the micro-batcher shed more than
+        :data:`SHED_READY_RATIO` of the submissions since the last
+        tick (minimum :data:`SHED_READY_MIN` sheds — a lone 503 on an
+        idle process must not flip readiness)."""
+        reg = telemetry.get_registry()
+        shed = reg.counter_total("veles_serving_shed_total")
+        accepted = reg.counter_total("veles_serving_requests_total")
+        prev = self._shed_seen
+        self._shed_seen = (shed, accepted)
+        if prev is None:
+            return True, None
+        d_shed = shed - prev[0]
+        d_total = d_shed + max(accepted - prev[1], 0.0)
+        if d_shed >= SHED_READY_MIN \
+                and d_shed > SHED_READY_RATIO * d_total:
+            return False, ("shedding %d/%d recent submissions"
+                           % (int(d_shed), int(d_total)))
+        return True, None
 
     # -- request handling ----------------------------------------------
 
@@ -138,7 +266,11 @@ class ServingFrontend(Logger):
         through batcher and engine so queue wait and batched execution
         appear as spans of the caller's trace."""
         t0 = time.perf_counter()
-        code, reply = self._predict_request(doc, trace)
+        # bind the request's trace as the thread's active context so
+        # every log line emitted on its behalf carries the ids
+        # (structured-log/trace correlation — veles/logger.py)
+        with telemetry.context(trace):
+            code, reply = self._predict_request(doc, trace)
         if telemetry.tracer.active:
             args = {"code": code, "model": str(doc.get("model"))
                     if isinstance(doc, dict) else "?"}
@@ -149,6 +281,28 @@ class ServingFrontend(Logger):
         return code, reply
 
     def _predict_request(self, doc, trace):
+        ready, reasons = self._monitor.ready_state()
+        if not ready:
+            # reject BEFORE parsing/enqueueing: a not-ready process
+            # (cold registry, open breaker, firing SLO) must shed
+            # load with an honest retry hint, not half-serve it.
+            # EXCEPT shedding-only unreadiness: the batcher already
+            # sheds per-model via QueueFull — gating admission on the
+            # cached shed verdict would flap at the monitor interval
+            # (no admissions -> next tick sees zero sheds -> ready ->
+            # readmit the storm) and starve the models that are fine.
+            # /readyz still reports it, so a router can drain.
+            # drop ANY frontend's shedding reason (several frontends
+            # may share this process's monitor), keyed on the check
+            # NAME part of "name: reason"
+            blocking = [r for r in reasons
+                        if not r.split(": ", 1)[0]
+                        .endswith(":shedding")]
+            if blocking:
+                _REJECTED["not_ready"].get().inc()
+                return 503, {"error": "not ready",
+                             "reasons": blocking,
+                             "retry_after_s": RETRY_AFTER_NOT_READY}
         try:
             name = doc["model"]
             inputs = numpy.asarray(doc["inputs"], numpy.float32)
@@ -178,7 +332,9 @@ class ServingFrontend(Logger):
                                 timeout_ms=doc.get("timeout_ms"),
                                 trace=trace)
         except QueueFull as exc:
-            return 503, {"error": str(exc)}
+            _REJECTED["shed"].get().inc()
+            return 503, {"error": str(exc),
+                         "retry_after_s": RETRY_AFTER_SHED}
         except DeadlineExceeded as exc:
             return 504, {"error": str(exc)}
         except (ValueError, TypeError) as exc:
@@ -222,6 +378,11 @@ class ServingFrontend(Logger):
         web_status.register("serving:%d" % self.port, provider)
 
     def close(self):
+        for name in self._check_names:
+            self._monitor.remove_check(name, tick=False)
+        if self._check_names:
+            self._monitor.tick()
+        self._check_names = ()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -262,6 +423,11 @@ def build_serve_argparser():
                    help="default per-request deadline")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip bucket-ladder precompilation")
+    p.add_argument("--slo-config", default=None, metavar="PATH",
+                   help="JSON list of SLO objectives evaluated by "
+                        "the in-process health monitor (burn-rate "
+                        "alerts -> /readyz, /debug/events, "
+                        "veles_slo_* gauges; see veles/health.py)")
     p.add_argument("--web-status", type=int, default=None,
                    metavar="PORT",
                    help="also serve the status dashboard on this "
@@ -301,6 +467,10 @@ def serve_main(argv=None):
                       checkpoint=checkpoints.get(name),
                       warmup=not args.no_warmup)
     front = ServingFrontend(registry, port=args.port, host=args.host)
+    if args.slo_config:
+        n = health.get_monitor().load_slo_file(args.slo_config)
+        front.info("%d SLO objective(s) loaded from %s", n,
+                   args.slo_config)
     if args.web_status is not None:
         from veles.web_status import WebStatus
         status = WebStatus(port=args.web_status, host=args.host)

@@ -41,6 +41,12 @@ class ServedModel:
         self.checkpoint = checkpoint
         self.version = 1
         self.loaded_at = time.time()
+        #: readiness signal (veles/health.py): False only while a
+        #: REQUESTED warmup is still compiling the bucket ladder — a
+        #: model loaded without warmup compiles on first request and
+        #: must not wedge readiness (the probe would reject the very
+        #: request that warms it)
+        self.warm = True
 
     def predict(self, rows, timeout_ms=None, trace=None):
         return self.batcher.predict(rows, timeout_ms=timeout_ms,
@@ -130,7 +136,11 @@ class ModelRegistry(Logger):
             # owns the model's queue-gauge series now — don't zero it.
             old.batcher.close(zero_gauge=False)
         if warmup:
-            entry.engine.warmup()
+            entry.warm = False
+            try:
+                entry.engine.warmup()
+            finally:
+                entry.warm = True
         self.info("model %s v%d loaded from %s (%d units, backend "
                   "%s)", name, entry.version, source,
                   len(model.units), entry.engine.backend)

@@ -591,6 +591,38 @@ class TraceContext:
         return out
 
 
+#: thread-local holder of the ACTIVE trace context: the one the code
+#: currently executing on this thread works on behalf of. Set with
+#: :func:`context`; read by anything that wants to correlate its
+#: output with the distributed trace — most importantly the JSONL log
+#: handler (``veles/logger.py``), which stamps every structured log
+#: line with the active ``trace_id``/``span_id`` so ``/debug/trace``
+#: spans and log lines join on one key.
+_context_tls = threading.local()
+
+
+def current_context():
+    """The :class:`TraceContext` bound to THIS thread (via
+    :func:`context`), or None when the thread is not working on
+    behalf of any traced request/job."""
+    return getattr(_context_tls, "ctx", None)
+
+
+@contextmanager
+def context(ctx):
+    """``with telemetry.context(trace):`` — bind ``ctx`` as the
+    thread's active trace context for the duration of the block
+    (restoring whatever was active before, so nesting works). Log
+    lines emitted inside the block carry the ids (JSONL sink);
+    ``ctx`` may be None, which reads as "no active trace"."""
+    prev = getattr(_context_tls, "ctx", None)
+    _context_tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _context_tls.ctx = prev
+
+
 # -- span tracer -------------------------------------------------------
 
 

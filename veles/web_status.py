@@ -12,6 +12,18 @@ POSTed status JSON to, plus a JS frontend. The rebuild is a stdlib
                          histograms, compile/dispatch times, cluster
                          fault counters incl. aggregated slave-pushed
                          series — ``veles/telemetry.py``)
+* ``GET /healthz`` / ``GET /readyz``
+                       — liveness / readiness probes served from the
+                         health monitor's CACHED verdict
+                         (``veles/health.py``): the master registers
+                         lease-table and snapshot-store checks, SLO
+                         burn-rate alerts flip readiness; handlers
+                         never take the master lock or touch the
+                         network (zlint ``probe-purity``)
+* ``GET /metrics/history``
+                       — the monitor's time-series ring
+                         (``?window=SECS``): sampled percentiles,
+                         queue depths, fault counters over time
 * ``POST /update``     — remote launchers push their status dicts
                          (same-host launchers register a callable)
 
@@ -25,7 +37,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from veles import telemetry
+from veles import health, telemetry
 from veles.logger import Logger
 
 _PAGE = """<!DOCTYPE html>
@@ -77,6 +89,14 @@ class WebStatus(Logger):
                     body = json.dumps(status.snapshot(),
                                       indent=1).encode()
                     self._reply(200, body, "application/json")
+                elif self.path.startswith(("/healthz", "/readyz",
+                                           "/metrics/history")):
+                    # probe contract (zlint probe-purity): the
+                    # monitor's cached verdict only — no provider
+                    # pulls, no master lock, no network
+                    code, payload = health.health_endpoint(self.path)
+                    self._reply(code, json.dumps(payload).encode(),
+                                "application/json")
                 elif self.path.startswith("/metrics"):
                     reg = telemetry.get_registry()
                     self._reply(200,
@@ -115,6 +135,10 @@ class WebStatus(Logger):
                     status._pushed[name] = doc
                 self._reply(200, b"ok", "text/plain")
 
+        # the dashboard is the training side's health surface: make
+        # sure the monitor's sampler is running so /metrics/history
+        # accumulates and /readyz reflects registered checks
+        health.get_monitor()
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
