@@ -305,11 +305,28 @@ def _wire_tx_bytes():
                and ("direction", "tx") in items)
 
 
+def _slave_jobs_total():
+    """Cumulative ``veles_slave_jobs_done_total`` from the registry,
+    EXCLUDING slave-labelled absorbed copies (co-located master+slave
+    share one registry and the master re-absorbs each slave's pushed
+    state under a ``slave="<id>"`` label — counting those too would
+    double every job)."""
+    from veles import telemetry
+    state = telemetry.get_registry().counter_state(
+        exclude_label_keys=("slave",))
+    return sum(v for (name, items), v in state.items()
+               if name == "veles_slave_jobs_done_total")
+
+
 def _dist_wire_row(codec, n_slaves=1, max_epochs=2):
     """One co-located master + ``n_slaves`` run over real sockets on
     the numpy backend (the row measures the WIRE protocol, not
     compute — it runs, and means the same thing, with or without a
-    TPU); -> (wire bytes per served job, jobs per second)."""
+    TPU); -> (wire bytes per served job, jobs per second). Both
+    numerators come from the SAME registry counters the runtime
+    increments (``veles_wire_bytes_total`` /
+    ``veles_slave_jobs_done_total``), so the row and a /metrics
+    scrape of the run can never disagree."""
     import threading
     from veles.client import SlaveClient
     from veles.server import MasterServer
@@ -327,12 +344,12 @@ def _dist_wire_row(codec, n_slaves=1, max_epochs=2):
                           n_valid=100, max_epochs=max_epochs)
         wf.is_slave = True
         slaves.append(wf)
-    jobs = [0] * n_slaves
+    ok = [0] * n_slaves
     errors = []
 
     def pump(i):
         try:
-            jobs[i] = SlaveClient(
+            ok[i] = SlaveClient(
                 slaves[i], address, name="bench-%s-%d" % (codec, i),
                 grad_codec=codec).run_forever()
         except Exception as exc:       # surfaced below: a dead-slave
@@ -340,6 +357,7 @@ def _dist_wire_row(codec, n_slaves=1, max_epochs=2):
                                        # never a bogus data point
 
     before = _wire_tx_bytes()
+    jobs_before = _slave_jobs_total()
     threads = [threading.Thread(target=pump, args=(i,))
                for i in range(n_slaves)]
     t0 = time.perf_counter()
@@ -354,10 +372,10 @@ def _dist_wire_row(codec, n_slaves=1, max_epochs=2):
         server.request_stop()
     wall = time.perf_counter() - t0
     moved = _wire_tx_bytes() - before
-    total_jobs = sum(jobs)
+    total_jobs = _slave_jobs_total() - jobs_before
     if errors:
         raise RuntimeError("slave failed: %s" % errors[0])
-    if not total_jobs:
+    if not total_jobs or not sum(ok):
         raise RuntimeError("no jobs completed — nothing to measure")
     if server.faults["codec_fallbacks"]:
         raise RuntimeError("codec %r fell back to 'none' — the row "
@@ -385,6 +403,34 @@ def _grad_codec_rows(extra):
             extra[key] = round(steps_per_sec, 1)
         except Exception as exc:
             extra[key + "_error"] = str(exc)[:200]
+
+
+def _dist_scaling_rows(extra, codec="int8"):
+    """ROADMAP item 3's missing half-row: protocol-level scaling
+    efficiency at N=1/2/4/8 co-located slaves over the reactor wire
+    plane under the shipped ``int8`` codec —
+    ``dist_scaling_steps_per_sec_nN`` (jobs/s from the same
+    ``veles_slave_jobs_done_total`` registry counters the runtime
+    increments) plus the derived ``dist_scaling_efficiency_nN`` =
+    rate(N) / (N x rate(1)). Co-located numpy processes price the
+    wire + codec + dispatch path, not device scaling; efficiency
+    falling with N is the thread/GIL ceiling the reactor is meant to
+    lift, which is exactly why the trajectory is recorded.
+    Directional self-check: down = bad for BOTH key families (they
+    are throughput/efficiency figures, not byte counts)."""
+    rates = {}
+    for n in (1, 2, 4, 8):
+        key = "dist_scaling_steps_per_sec_n%d" % n
+        try:
+            _, steps_per_sec = _dist_wire_row(codec, n_slaves=n)
+            rates[n] = steps_per_sec
+            extra[key] = round(steps_per_sec, 1)
+        except Exception as exc:
+            extra[key + "_error"] = str(exc)[:200]
+    for n in (2, 4, 8):
+        if n in rates and rates.get(1):
+            extra["dist_scaling_efficiency_n%d" % n] = round(
+                rates[n] / (n * rates[1]), 3)
 
 
 def _xla_throughput(create_workflow, cfg, counter_kind, scale,
@@ -759,6 +805,7 @@ def main(argv=None):
         extra = {"device_error": detail[:300]}
         _serving_row(extra)
         _grad_codec_rows(extra)
+        _dist_scaling_rows(extra)
         return emit({
             "metric": "mnist_train_steps_per_sec",
             "value": 0.0,
@@ -785,6 +832,8 @@ def main(argv=None):
     })
     # ... and the MEASURED wire bytes per sync, per codec (ISSUE 7)
     _grad_codec_rows(extra)
+    # N-slave scaling over the reactor wire plane (ISSUE 9)
+    _dist_scaling_rows(extra)
     _record(extra, "cifar_conv_images_per_sec", xla_cifar_images_per_sec)
 
     def alexnet_row():

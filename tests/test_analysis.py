@@ -837,6 +837,98 @@ class Handler:
     assert lint_src(tmp_path, src, select=["probe-purity"]) == []
 
 
+# -- reactor-purity ----------------------------------------------------
+
+_REACTOR_BAD = """\
+import time
+from urllib.request import urlopen
+
+
+class Session:
+    def on_frame(self, obj):
+        time.sleep(0.1)                 # parks the whole loop
+        return self.sock.recv(4096)     # raw-socket wait
+
+
+class Plane:
+    def __init__(self, loop):
+        loop.call_soon(self._merge)
+        loop.every(1.0, self._sweep)
+        loop.call_later(0.5, lambda: self.done.wait())
+
+    def _merge(self):
+        self.worker.join()              # Thread.join shape
+        return urlopen("http://127.0.0.1:1/metrics")
+
+    def _sweep(self):
+        self.sock.sendall(b"tick")
+"""
+
+_REACTOR_GOOD = """\
+class Session:
+    def on_frame(self, obj):
+        resp = self.handle(obj)
+        with self.lock:                 # existing lock discipline: ok
+            self.counter += 1
+        self.send_obj(resp)
+        return ", ".join(str(x) for x in resp)   # str.join, not Thread
+
+
+class Plane:
+    def __init__(self, loop):
+        loop.call_soon(self._merge, 1)
+        loop.every(1.0, self._sweep)
+
+    def _merge(self, n):
+        self.pending.append(n)
+
+    def _sweep(self):
+        for conn in self.connections():
+            if conn.stale:
+                conn.close()
+
+    def off_loop_helper(self):
+        # NOT a reactor callback: blocking here is the worker
+        # thread's whole job
+        self.done.wait(2.0)
+"""
+
+
+def test_reactor_purity_fires_on_blocking_callbacks(tmp_path):
+    """Satellite (ISSUE 9): sleep + raw recv inside on_frame, and
+    join/urlopen/sendall/Event.wait inside call_soon/every/call_later
+    targets (incl. a lambda) all fire."""
+    findings = lint_src(tmp_path, _REACTOR_BAD,
+                        select=["reactor-purity"])
+    assert set(rule_ids(findings)) == {"reactor-purity"}
+    messages = " | ".join(f.message for f in findings)
+    for name in ("'sleep'", "'recv'", "'join'", "'urlopen'",
+                 "'sendall'", "'wait'"):
+        assert name in messages, (name, messages)
+    assert len(findings) >= 6
+
+
+def test_reactor_purity_quiet_on_pure_callbacks(tmp_path):
+    """The compliant shapes are quiet: locks (the existing handle()
+    discipline), str.join, queue appends, conn.close sweeps — and
+    blocking calls in methods that are NOT reactor callbacks are out
+    of scope."""
+    assert lint_src(tmp_path, _REACTOR_GOOD,
+                    select=["reactor-purity"]) == []
+
+
+def test_reactor_purity_pragma_suppresses(tmp_path):
+    src = """\
+import time
+
+
+class S:
+    def on_timer(self):
+        time.sleep(0.01)  # zlint: disable=reactor-purity (test rig)
+"""
+    assert lint_src(tmp_path, src, select=["reactor-purity"]) == []
+
+
 # -- hygiene: bare-except / unused-import / unused-variable ------------
 
 

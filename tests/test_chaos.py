@@ -548,6 +548,42 @@ def test_connect_rejects_bad_welcome():
     listener.close()
 
 
+def test_heartbeat_hammers_update_path_without_desync():
+    """Satellite (ISSUE 9): the heartbeat thread is SEND-ONLY and
+    whole-frame sends are serialized, so pings hammered at ~1kHz
+    against a live job/update loop can never interleave bytes
+    mid-frame or steal the main reader's responses. A run at this
+    ping rate completes with zero reconnects, zero fenced updates and
+    zero protocol desyncs — and the pongs owed to the pings are all
+    drained by the main reader."""
+    master_wf = make_wf("HbHammerMaster", max_epochs=None)
+    master_wf.decision.max_epochs = 2
+    server = MasterServer(master_wf, "127.0.0.1:0", max_epochs=2,
+                          slave_timeout=10.0)
+    server.start_background()
+    slave_wf = make_wf("HbHammerSlave")
+    slave_wf.is_slave = True
+    client = SlaveClient(slave_wf,
+                         "127.0.0.1:%d" % server.bound_address[1],
+                         name="hb-hammer", io_timeout=10.0,
+                         ping_interval=0.001)
+    jobs = client.run_forever()
+    assert server.done.is_set()
+    assert jobs > 0
+    assert client.pings_sent > 0, \
+        "the hammer never hammered — ping_interval not honored"
+    # no desync, no reconnect, no fencing: byte-interleaving or a
+    # stolen response would show up in every one of these
+    assert client.reconnects == 0
+    assert client.stale_resyncs == 0
+    st = server.status()
+    assert st["faults"]["fenced_updates"] == 0, st
+    assert st["faults"]["drops"] == 0, st
+    # every pong was either drained or is still owed for a ping the
+    # final bye cut off — never negative, never unsolicited
+    assert client._pending_pongs >= 0
+
+
 def test_backoff_is_capped_with_jitter():
     wf = make_wf("BackoffWf")
     wf.is_slave = True

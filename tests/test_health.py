@@ -529,6 +529,8 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                 "lm_57M_tokens_per_sec": 50000.0,
                 "lm_57M_tokens_per_sec_best": 60000.0,
                 "calibration_matmul8k_bf16_tflops": 150.0,
+                "dist_scaling_steps_per_sec_n2": 100.0,
+                "dist_scaling_efficiency_n2": 0.8,
                 "some_row_error": "boom",
             }}}
     path = tmp_path / "BENCH_r07.json"
@@ -539,6 +541,10 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
             "cifar_conv_images_per_sec": 195.0,       # -2.5%: fine
             "grad_sync_wire_bytes_per_step_int8": 150000,  # +50%: bad
             "lm_57M_tokens_per_sec": 55000.0,         # +10%: fine
+            # ISSUE 9: scaling rows are throughput/efficiency figures
+            # — DOWN is the bad direction for both families
+            "dist_scaling_steps_per_sec_n2": 50.0,    # -50%: bad
+            "dist_scaling_efficiency_n2": 0.4,        # -50%: bad
         }}
     regressed = bench.self_check(report, threshold_pct=10.0,
                                  baseline_path=str(path))
@@ -546,7 +552,9 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
     # throughput DOWN 20% and byte-count UP 50% regress; the small
     # dip, the improvement, _best and calibration keys don't
     assert set(regressed) == {"mnist_train_steps_per_sec",
-                              "grad_sync_wire_bytes_per_step_int8"}
+                              "grad_sync_wire_bytes_per_step_int8",
+                              "dist_scaling_steps_per_sec_n2",
+                              "dist_scaling_efficiency_n2"}
     assert "REGRESSION" in err and "warn-only" in err
     assert "_best" not in err.split("rows in baseline")[0]
     # no baseline -> a note, no crash, nothing regressed

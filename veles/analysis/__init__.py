@@ -32,6 +32,12 @@ rule id                   checks
 ``probe-purity``          ``/healthz``/``/readyz`` handler branches
                           read cached state only — no locks, no
                           network, no live state pulls
+``reactor-purity``        reactor callbacks (``on_frame``/
+                          ``on_timer``, ``call_soon``/``call_later``/
+                          ``every`` targets) must not call blocking
+                          primitives — raw-socket ``recv``/
+                          ``sendall``/``accept``, ``time.sleep``,
+                          ``Event.wait``/``Thread.join``, ``urlopen``
 ``thread-lifecycle``      threads must be daemons or have a join path
 ``bare-except``           ``except:`` swallows ``KeyboardInterrupt``
 ``unused-import``         dead module-level imports

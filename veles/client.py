@@ -18,8 +18,20 @@ survives master restarts and flaky networks; a run of successful work
 resets the budget. A background heartbeat thread sends ``("ping",)``
 every ``ping_interval`` whenever the socket is otherwise idle (both
 parked on ``("wait",)`` AND deep in a long local iteration), so the
-master's ``slave_timeout`` measures actual silence, not compute time,
-and the slave sees lease revocation early.
+master's ``slave_timeout`` measures actual silence, not compute time.
+
+Socket sharing discipline (ISSUE 9): the heartbeat thread is
+SEND-ONLY. Whole-frame sends are serialized by ``_io_lock`` — a ping
+can never interleave bytes mid-frame with an in-flight update send —
+and the MAIN thread is the only reader: requests and responses are
+FIFO on one TCP stream, so ``_roundtrip`` drains the ``("pong",)``
+replies owed to outstanding heartbeat pings (counted under the same
+lock) before taking its own response. The old design round-tripped
+the ping on the heartbeat thread, which serialized heartbeats behind
+whole request/response cycles; send-only pings flow even while a
+multi-MB update send is in flight. A ``("stale",)`` answered to a
+ping is read by the main loop as its own fencing — the correct
+outcome either way, since the lease is equally dead for both frames.
 """
 
 import os
@@ -99,12 +111,18 @@ class SlaveClient(Logger):
         self.slave_id = None
         self.lease_id = None
         self.jobs_done = 0
-        #: serializes whole request/response round-trips, so the
-        #: heartbeat thread can ping while the main thread computes
-        #: (socket idle) without ever interleaving half-frames
+        #: serializes whole-frame SENDS (and the pending-pong count):
+        #: the heartbeat thread can ping while the main thread
+        #: computes — or even between the main thread's send and
+        #: recv — without ever interleaving bytes mid-frame. Reads
+        #: are unserialized because the main thread is the ONLY
+        #: reader (see the module docstring).
         self._io_lock = threading.Lock()
         self._hb_stop = None
         self._last_io = 0.0
+        #: pings sent whose pongs the main reader has not yet drained
+        #: (guarded by _io_lock; reset per connection)
+        self._pending_pongs = 0
         #: per-request socket deadline — a silent master (or a dropped
         #: frame) unblocks here instead of hanging the slave forever
         self.io_timeout = float(io_timeout)
@@ -177,11 +195,12 @@ class SlaveClient(Logger):
             welcome[3] if len(welcome) > 3 else "none",
             welcome[4] if len(welcome) > 4 else None)
         # under the io lock: a previous connection's heartbeat thread
-        # may still be mid-round-trip and writes _last_io on exit —
-        # both writers hold the lock, so the fresher timestamp wins
+        # may still be mid-send and writes _last_io on exit — both
+        # writers hold the lock, so the fresher timestamp wins
         # deterministically instead of racing
         with self._io_lock:
             self._last_io = time.monotonic()
+            self._pending_pongs = 0
         self._start_heartbeat()
         return self
 
@@ -218,12 +237,14 @@ class SlaveClient(Logger):
         """Best-effort liveness pings whenever the socket has been
         idle for ``ping_interval`` — covers both ("wait",) parking and
         LONG LOCAL ITERATIONS, so the master's slave_timeout measures
-        silence, not compute time, and revocation is noticed early.
-        The thread is pinned to THIS connection's socket and does its
-        round-trip under the io lock, so it can never interleave
-        half-frames with the main loop or touch a reconnected socket.
-        Errors just stop the beat: the main loop's next round-trip
-        surfaces them with full reconnect handling."""
+        silence, not compute time. The thread is pinned to THIS
+        connection's socket and is SEND-ONLY: it emits the whole ping
+        frame under the io lock (never interleaving bytes mid-frame
+        with an in-flight update send) and NEVER reads — the main
+        thread is the sole reader and drains the owed pongs before
+        its own responses (see ``_roundtrip``). Errors just stop the
+        beat: the main loop's next round-trip surfaces them with full
+        reconnect handling."""
         if self.ping_interval <= 0:
             return
         self._hb_stop = stop = threading.Event()
@@ -240,10 +261,8 @@ class SlaveClient(Logger):
                             return
                         send_frame(sock, ("ping", self.slave_id,
                                           self.lease_id))
-                        resp = recv_frame(sock)
+                        self._pending_pongs += 1
                         self._last_io = time.monotonic()
-                    if resp is None or resp[0] != "pong":
-                        return
                     self.pings_sent += 1
                 except Exception:
                     return
@@ -264,11 +283,27 @@ class SlaveClient(Logger):
                 "initialize()")
 
     def _roundtrip(self, request):
+        sock = self.sock
         with self._io_lock:
-            send_frame(self.sock, request,
-                       legacy=self._legacy_frames)
-            resp = recv_frame(self.sock)
+            send_frame(sock, request, legacy=self._legacy_frames)
             self._last_io = time.monotonic()
+        # reads are lock-free: this thread is the ONLY reader.
+        # Responses arrive in request order, so any pongs owed to
+        # heartbeat pings sent BEFORE our request drain first; a pong
+        # we never paid for is a genuine desync.
+        while True:
+            resp = recv_frame(sock)
+            with self._io_lock:
+                self._last_io = time.monotonic()
+                if resp is not None and isinstance(resp, tuple) \
+                        and resp and resp[0] == "pong":
+                    if self._pending_pongs > 0:
+                        self._pending_pongs -= 1
+                        continue
+                    raise ProtocolDesync(
+                        "unsolicited pong (no heartbeat ping "
+                        "outstanding)")
+            break
         if resp is None:
             raise ConnectionError("master closed the connection")
         if resp == ("stale",):

@@ -140,6 +140,12 @@ def scrape_target(base, timeout=5.0):
                       direction="tx")
     if tx is not None:
         summary["wire_tx_bytes"] = tx
+    # reactor loop lag (ISSUE 9): the "is the shared loop healthy"
+    # number — sustained lag means a callback is blocking the wire
+    # plane and every probe behind it
+    lag = metric_total(metrics, "veles_reactor_loop_lag_seconds")
+    if lag is not None:
+        summary["reactor_lag_s"] = lag
     for key, name in (("serving_requests",
                        "veles_serving_requests_total"),
                       ("serving_rejected",
@@ -257,6 +263,9 @@ def render_snapshot(snap):
                    m.get("requests_per_sec"),
                    m.get("latency_ms_p99", "-"),
                    m.get("queue_depth"), m.get("shed_total")))
+        lag = row.get("metrics", {}).get("reactor_lag_s")
+        if lag is not None:
+            detail.append("reactor lag %.1fms" % (lag * 1e3))
         if row.get("firing"):
             detail.append("SLO firing: " + ",".join(row["firing"]))
         if row.get("ready") is False:

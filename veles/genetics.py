@@ -370,9 +370,9 @@ class GATaskServer(Logger):
         self._server = framed_server(
             self.address, self._handle, self.done_event,
             self.drop_slave, timeout=float(slave_timeout))
+        # accepting starts inside framed_server() on the shared
+        # reactor — no accept thread to spawn since ISSUE 9
         self.bound_address = self._server.server_address
-        threading.Thread(target=self._server.serve_forever,
-                         args=(0.05,), daemon=True).start()
 
     def _handle(self, request):
         kind = request[0]

@@ -69,6 +69,7 @@ DEFAULT_PREFIXES = (
     "veles_serving_", "veles_cluster_", "veles_master_",
     "veles_slave_", "veles_wire_", "veles_step_", "veles_loader_",
     "veles_checkpoint_", "veles_slo_", "veles_grad_",
+    "veles_reactor_",
 )
 
 #: sampler cadence (seconds) and ring capacity: 1 Hz x 900 samples =

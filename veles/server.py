@@ -71,12 +71,11 @@ import json
 import os
 import pickle
 import secrets
-import socketserver
 import struct
 import threading
 import time
 
-from veles import telemetry
+from veles import reactor, telemetry
 from veles.distributable import DistributionRegistry
 from veles.logger import Logger
 
@@ -293,68 +292,280 @@ def recv_raw_frame(sock, max_bytes=MAX_FRAME_BYTES):
     return _recv_exact(sock, size)
 
 
-def framed_server(address, handle_request, done_event, on_drop,
-                  timeout=None):
-    """The framed request loop shared by the training master and the
-    GA task master (``veles/genetics.py``): a ``ThreadingTCPServer``
-    whose per-connection handler pumps HMAC frames through
-    ``handle_request`` until ``done_event``, captures the slave id
-    from the hello exchange, and calls ``on_drop(slave_id, clean=...)``
-    when the connection ends — the drop->requeue elasticity hook;
-    ``clean=True`` marks a polite ``("bye",)`` completion so it can be
-    deregistered without counting as a fault. ``timeout``
-    (seconds) bounds a silent peer: a slave whose host vanishes
-    without FIN/RST would otherwise block its handler thread forever
-    and strand its in-flight work. The caller owns shutdown +
-    server_close (use ``with``)."""
+class FramedConnection(reactor.Connection):
+    """One HMAC-framed peer on the reactor: incremental assembly of
+    the ``length(4) | tag(32) | payload`` frames (both the PR-7
+    out-of-band buffer format and legacy bare pickles — the shared
+    :func:`decode_frame_payload` handles either), zero-copy payload
+    receive into one preallocated bytearray, and :meth:`send_obj`
+    emission through the bounded per-connection write queue. Loop
+    thread only. Subclasses implement ``on_frame(obj)``."""
 
-    class Handler(socketserver.BaseRequestHandler):
-        def handle(self):
-            if timeout:
-                self.request.settimeout(timeout)
-            slave_id = None
-            clean = False
-            # a 2-tuple hello marks a pre-OOB peer: every reply on
-            # this connection must stay a legacy monolithic frame or
-            # the first array-carrying job payload would crash the
-            # old recv_frame (see the protocol docstring)
-            legacy = False
+    def __init__(self, loop, sock, max_write_buffer=None):
+        self._headbuf = bytearray()     # length + tag accumulation
+        self._tag = None
+        self._blob = None               # preallocated payload buffer
+        self._got = 0
+        super().__init__(loop, sock, max_write_buffer=max_write_buffer)
+
+    def on_readable(self):
+        # phase-aware recv_into instead of the generic chunked read:
+        # multi-MB weight payloads land straight in their final
+        # buffer, which then backs zero-copy ndarray views (the same
+        # no-second-allocation contract _recv_exact_into gives the
+        # blocking path)
+        budget = reactor.READ_BUDGET
+        while budget > 0 and not self.closed:
+            if self._blob is None:
+                try:
+                    data = self.sock.recv(36 - len(self._headbuf))
+                except (BlockingIOError, InterruptedError):
+                    return
+                except OSError as exc:
+                    self.close(reason="recv: %s" % exc)
+                    return
+                if not data:
+                    self.close(reason="eof")
+                    return
+                budget -= len(data)
+                self.last_recv = time.monotonic()
+                self._headbuf += data
+                if len(self._headbuf) < 36:
+                    continue
+                size, = struct.unpack(">I", self._headbuf[:4])
+                if size > MAX_FRAME_BYTES:
+                    self.close(
+                        reason="frame header claims %d bytes (cap %d)"
+                               % (size, MAX_FRAME_BYTES))
+                    return
+                self._tag = bytes(self._headbuf[4:36])
+                del self._headbuf[:]
+                self._blob = bytearray(size)
+                self._got = 0
+                if size == 0:
+                    self._frame_done()
+                continue
+            want = min(len(self._blob) - self._got, budget)
             try:
-                # NOT `while not done_event.is_set()`: that slammed
-                # the connection between recv and response, so a slave
-                # whose request was in flight when done fired saw a
-                # reset instead of the ("bye",) both handle()s return
-                # once done — and would retry/requeue a finished run.
-                # done still bounds the loop: every post-done request
-                # is answered "bye", which breaks below.
-                while True:
-                    req = recv_frame(self.request)
-                    if req is None:
-                        break
-                    resp = handle_request(req)
-                    if req[0] == "hello" and resp[0] == "welcome":
-                        legacy = len(req) < 3
-                        if slave_id is not None and slave_id != resp[1]:
-                            # a duplicated hello frame minted a second
-                            # lease on this connection: revoke the one
-                            # we stop tracking or it leaks forever
-                            on_drop(slave_id)
-                        slave_id = resp[1]
-                    send_frame(self.request, resp, legacy=legacy)
-                    if resp[0] == "bye":
-                        clean = True
-                        break
-            except (ConnectionError, OSError):
-                pass               # socket.timeout is an OSError too
-            finally:
-                if slave_id is not None:
-                    on_drop(slave_id, clean=clean)
+                n = self.sock.recv_into(
+                    memoryview(self._blob)[self._got:], want)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as exc:
+                self.close(reason="recv: %s" % exc)
+                return
+            if not n:
+                self.close(reason="eof mid-frame")
+                return
+            self._got += n
+            budget -= n
+            self.last_recv = time.monotonic()
+            if self._got == len(self._blob):
+                self._frame_done()
 
-    class Server(socketserver.ThreadingTCPServer):
-        allow_reuse_address = True
-        daemon_threads = True
+    def _frame_done(self):
+        blob, tag = self._blob, self._tag
+        self._blob = self._tag = None
+        if not hmac.compare_digest(
+                tag, hmac.new(_secret(), blob,
+                              hashlib.sha256).digest()):
+            self.close(reason="frame failed HMAC authentication")
+            return
+        _WIRE_RX.get().inc(len(blob) + _FRAME_OVERHEAD)
+        try:
+            obj = decode_frame_payload(blob)
+        except Exception as exc:
+            self.close(reason="undecodable frame: %s" % exc)
+            return
+        self.on_frame(obj)
 
-    return Server(address, Handler)
+    def on_frame(self, obj):
+        raise NotImplementedError
+
+    def send_obj(self, obj, legacy=False):
+        """Encode + enqueue one reply frame (same wire bytes and
+        ``veles_wire_bytes_total`` accounting as :func:`send_frame`);
+        ``legacy`` pins a monolithic bare pickle for pre-OOB peers."""
+        parts = [pickle.dumps(obj, protocol=5)] if legacy \
+            else _frame_parts(obj)
+        size = sum(len(p) for p in parts)
+        mac = hmac.new(_secret(), digestmod=hashlib.sha256)
+        for part in parts:
+            mac.update(part)
+        self.send_parts(
+            [struct.pack(">I", size) + mac.digest()] + parts)
+        _WIRE_TX.get().inc(size + _FRAME_OVERHEAD)
+
+
+class _FramedSession(FramedConnection):
+    """framed_server's per-connection protocol state: hello capture
+    (slave id, legacy arity, duplicate-hello revocation), polite-bye
+    close, and the drop hook on teardown."""
+
+    def __init__(self, server, sock):
+        self._srv = server
+        self.slave_id = None
+        self.clean = False
+        # a 2-tuple hello marks a pre-OOB peer: every reply on this
+        # connection must stay a legacy monolithic frame or the first
+        # array-carrying job payload would crash the old recv_frame
+        # (see the protocol docstring)
+        self.legacy = False
+        super().__init__(server.reactor, sock,
+                         max_write_buffer=server.max_write_buffer)
+
+    def on_frame(self, req):
+        srv = self._srv
+        try:
+            resp = srv._handle(req)
+        except Exception as exc:
+            srv.warning("handler failed on %r frame: %s: %s",
+                        req[0] if isinstance(req, tuple) and req
+                        else type(req).__name__,
+                        type(exc).__name__, exc)
+            self.close(reason="handler error")
+            return
+        if isinstance(req, tuple) and req and req[0] == "hello" \
+                and resp and resp[0] == "welcome":
+            self.legacy = len(req) < 3
+            if self.slave_id is not None and self.slave_id != resp[1]:
+                # a duplicated hello frame minted a second lease on
+                # this connection: revoke the one we stop tracking or
+                # it leaks forever
+                srv._on_drop(self.slave_id)
+            self.slave_id = resp[1]
+        self.send_obj(resp, legacy=self.legacy)
+        if resp and resp[0] == "bye":
+            self.clean = True
+            self.close_when_drained()
+        elif resp == ("stale",) and isinstance(req, tuple) and req \
+                and req[0] == "ping":
+            # a fenced ping's sender may be a SEND-ONLY heartbeat
+            # (ISSUE 9) that cannot see this answer: sever once the
+            # reply drains, or a zombie's beat keeps inflating
+            # stale_pings once per interval for a whole long local
+            # compute. The main thread's next round-trip on the dead
+            # socket reconnects exactly as reading the fence would —
+            # and the lease behind this connection can never come
+            # back, so nothing of value is lost.
+            self.close_when_drained()
+
+    def on_closed(self, reason):
+        srv = self._srv
+        srv.untrack(self)
+        if reason == "overflow":
+            srv.warning(
+                "dropping peer %s: write queue exceeded %d bytes "
+                "(stalled reader — backpressure cap)", self.slave_id,
+                self.max_write_buffer)
+            if srv._on_overflow is not None:
+                try:
+                    srv._on_overflow(self.slave_id)
+                except Exception:
+                    pass
+        if self.slave_id is not None:
+            srv._on_drop(self.slave_id, clean=self.clean)
+
+
+class ReactorFramedServer(reactor.ListeningServer):
+    """The framed request plane on the shared reactor (see
+    :func:`framed_server` for the contract). Accepting starts at
+    construction; ``shutdown()``/``server_close()`` tear down the
+    listener and every live session — the listener/teardown plumbing
+    itself is the shared :class:`veles.reactor.ListeningServer`."""
+
+    def __init__(self, address, handle_request, done_event, on_drop,
+                 timeout=None, max_write_buffer=None,
+                 on_overflow=None):
+        self._handle = handle_request
+        self._on_drop = on_drop
+        self._on_overflow = on_overflow
+        self.done_event = done_event
+        self.timeout = None if not timeout else float(timeout)
+        self.max_write_buffer = max_write_buffer \
+            or reactor.DEFAULT_MAX_WRITE_BUFFER
+        self._shutdown_event = threading.Event()
+        self._sweep_timer = None
+        super().__init__(address, name="framed_server")
+        if self.timeout:
+            # the silent-peer bound: a host that vanishes without
+            # FIN/RST stops producing frames; the sweep closes it
+            # within ~timeout + interval so its work requeues
+            interval = max(min(self.timeout / 4.0, 1.0), 0.05)
+            self._sweep_timer = self.reactor.every(
+                interval, self._sweep_idle)
+
+    def build_connection(self, sock, _addr):
+        return _FramedSession(self, sock)
+
+    def write_queue_bytes(self):
+        """{slave_id: queued-unsent reply bytes} for hello'ed
+        sessions — the per-connection backpressure depth
+        ``MasterServer.status()`` surfaces per slave."""
+        out = {}
+        for session in self.connections():
+            if session.slave_id is not None and not session.closed:
+                out[session.slave_id] = int(session.write_queued)
+        return out
+
+    def _sweep_idle(self):
+        now = time.monotonic()
+        for session in self.connections():
+            if not session.closed \
+                    and now - session.last_recv > self.timeout:
+                session.close(
+                    reason="silent peer (> slave_timeout %.1fs)"
+                           % self.timeout)
+
+    def on_close_loop(self):
+        if self._sweep_timer is not None:
+            self._sweep_timer.cancel()
+
+    def serve_forever(self, poll_interval=0.5):
+        """Compat shim: accepting starts at construction — this just
+        parks until shutdown (callers historically ran the accept
+        loop on a thread)."""
+        self._shutdown_event.wait()
+
+    def shutdown(self):
+        self._shutdown_event.set()
+        self.close()
+
+    def server_close(self):
+        self.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.server_close()
+        return False
+
+
+def framed_server(address, handle_request, done_event, on_drop,
+                  timeout=None, max_write_buffer=None,
+                  on_overflow=None):
+    """The framed request plane shared by the training master and the
+    GA task master (``veles/genetics.py``): since ISSUE 9 a
+    :class:`ReactorFramedServer` on the process's shared selector
+    reactor (one loop thread total — previously a
+    ``ThreadingTCPServer`` burned a blocking thread per connection).
+    Frames pump through ``handle_request`` (which still runs under
+    the caller's own lock discipline); the slave id is captured from
+    the hello exchange and ``on_drop(slave_id, clean=...)`` fires
+    when the connection ends — the drop->requeue elasticity hook;
+    ``clean=True`` marks a polite ``("bye",)`` completion so it can
+    be deregistered without counting as a fault. ``timeout``
+    (seconds) bounds a silent peer: a slave whose host vanishes
+    without FIN/RST is swept and its in-flight work requeued.
+    ``max_write_buffer`` bounds each connection's reply queue — a
+    stalled reader is dropped at the cap (``on_overflow(slave_id)``
+    fires first) instead of ever blocking the loop or other peers.
+    The caller owns shutdown + server_close (use ``with``)."""
+    return ReactorFramedServer(address, handle_request, done_event,
+                               on_drop, timeout=timeout,
+                               max_write_buffer=max_write_buffer,
+                               on_overflow=on_overflow)
 
 
 #: default bound on a silent slave (seconds). Training jobs are one
@@ -362,6 +573,12 @@ def framed_server(address, handle_request, done_event, on_drop,
 #: master (veles/genetics.py), whose jobs are whole training runs,
 #: overrides this with hours.
 DEFAULT_SLAVE_TIMEOUT = 60.0
+
+#: reactor loop lag (seconds) above which the master:reactor
+#: readiness check reports NOT ready: probes still answer (the
+#: monitor caches verdicts) but a loop this far behind is not
+#: dispatching the wire plane at line rate
+REACTOR_LAG_READY_S = 1.0
 
 #: how long a COMPLETED master keeps its listener up answering
 #: ``("bye",)`` before tearing it down. A slave mid-compute or
@@ -381,7 +598,8 @@ class MasterServer(Logger):
                  checkpoint_store=None, checkpoint_every=None,
                  resume_state=None,
                  drain_timeout=DEFAULT_DRAIN_TIMEOUT,
-                 grad_codec="none", grad_topk_percent=1.0):
+                 grad_codec="none", grad_topk_percent=1.0,
+                 max_write_buffer=None):
         from veles import compression
         self.name = "MasterServer"
         self.workflow = workflow
@@ -438,7 +656,16 @@ class MasterServer(Logger):
         self.faults = {"drops": 0, "requeued_jobs": 0,
                        "fenced_updates": 0, "stale_jobs": 0,
                        "stale_pings": 0, "unmerged_updates": 0,
-                       "codec_fallbacks": 0, "joins": 0}
+                       "codec_fallbacks": 0,
+                       "backpressure_drops": 0, "joins": 0}
+        #: per-connection reply-queue cap (bytes): a slave that stops
+        #: reading its broadcasts accumulates bounded queue on the
+        #: reactor and is dropped at the cap with a counted fault —
+        #: it can never stall the merge path or other slaves
+        self.max_write_buffer = max_write_buffer \
+            or reactor.DEFAULT_MAX_WRITE_BUFFER
+        #: loop-lag threshold for the master:reactor readiness check
+        self.reactor_lag_ready_s = REACTOR_LAG_READY_S
         #: per-client-token (state, last_seen) of absorbed counter
         #: pushes (see _absorb_telemetry). One entry per SlaveClient
         #: instance; idle tokens are evicted after _TELE_TOKEN_TTL so
@@ -634,7 +861,11 @@ class MasterServer(Logger):
           serving loop has not stopped (completed or aborted runs
           report not-ready so a supervisor stops routing to them);
         * ``master:snapshot_store`` — the checkpoint store's circuit
-          breaker is closed (persistence is not fast-failing).
+          breaker is closed (persistence is not fast-failing);
+        * ``master:reactor`` — the shared reactor loop is alive,
+          accepting, and its loop lag is under
+          :data:`REACTOR_LAG_READY_S` (a loop parked behind a
+          blocking callback is not dispatching the wire plane).
 
         The checks run on the MONITOR thread and read plain
         attributes — never the master request lock."""
@@ -650,7 +881,31 @@ class MasterServer(Logger):
                 return False, "listener not bound yet"
             return True, None
 
-        monitor.add_check("master:lease_table", lease_table)
+        def reactor_loop():
+            # peek, never get_reactor(): the getter ensure_started()s
+            # as a side effect, which would resurrect a dead/stopped
+            # loop from inside a readiness CHECK and make the
+            # not-running branch unreachable
+            loop = reactor.peek_reactor()
+            if loop is None or not loop.alive:
+                return False, "reactor loop thread not running"
+            # current_lag, not loop_lag_s: a WEDGED loop cannot
+            # update its own self-measurement, but the overdue lag
+            # probe is observable from this (monitor) thread
+            lag = loop.current_lag()
+            if lag > self.reactor_lag_ready_s:
+                return False, ("reactor loop lag %.3fs over %.3fs "
+                               "threshold" % (lag,
+                                              self.reactor_lag_ready_s))
+            server = self._server
+            if server is None or not getattr(server, "accepting",
+                                             True):
+                return False, "wire listener not accepting"
+            return True, None
+
+        monitor.add_check("master:lease_table", lease_table,
+                          tick=False)
+        monitor.add_check("master:reactor", reactor_loop)
         store = self.checkpoint_store
         if store is not None and hasattr(store, "breaker_open"):
             def snapshot_store():
@@ -662,6 +917,18 @@ class MasterServer(Logger):
         return monitor
 
     # -- telemetry -----------------------------------------------------
+
+    def _on_backpressure(self, slave_id):
+        """framed_server overflow hook: a slave stopped reading its
+        replies and hit the write-queue cap — count the drop class
+        distinctly (the generic ``drops`` counter fires too, from the
+        on_drop path that follows)."""
+        with self.lock:
+            self._count_fault("backpressure_drops")
+        self.warning(
+            "slave %s dropped at the write-queue cap (%d bytes of "
+            "unread replies) — stalled reader", slave_id,
+            self.max_write_buffer)
 
     def _count_fault(self, kind, n=1):
         self.faults[kind] += n
@@ -975,6 +1242,12 @@ class MasterServer(Logger):
         §5.5): connected slaves with their served-job counts and lease
         liveness, master progress, plus the robustness counters."""
         now = time.monotonic()
+        server = self._server
+        # per-connection reply-queue depth (reactor backpressure):
+        # read OUTSIDE self.lock — the depths are display-grade and
+        # the server tracks sessions under its own small lock
+        depths = server.write_queue_bytes() \
+            if server is not None else {}
         with self.lock:
             slaves = {}
             for sid, info in self.slaves.items():
@@ -985,6 +1258,7 @@ class MasterServer(Logger):
                     # not a place to hand out whole fencing tokens
                     "lease": info["lease"][:6],
                     "outstanding": len(info["outstanding"]),
+                    "write_queue_bytes": depths.get(sid, 0),
                     "idle_s": round(now - info["last_seen"], 3)}
                 # last-job latency attribution (satellite: slow-slave
                 # skew is visible on the dashboard without a trace
@@ -1011,13 +1285,18 @@ class MasterServer(Logger):
     # -- socket plumbing ----------------------------------------------
 
     def serve_forever(self, poll=0.05):
+        # the wire plane lives on the process's shared reactor:
+        # accepting starts inside framed_server(), no per-connection
+        # threads exist, and handle() runs on the loop (still under
+        # self.lock — the same serialization the thread-per-connection
+        # design had, minus the thread scheduling ceiling)
         with framed_server(self.address, self.handle, self.done,
                            self.drop_slave,
-                           timeout=self.slave_timeout) as server:
+                           timeout=self.slave_timeout,
+                           max_write_buffer=self.max_write_buffer,
+                           on_overflow=self._on_backpressure) as server:
             self._server = server
             self.bound_address = server.server_address
-            threading.Thread(target=server.serve_forever,
-                             args=(poll,), daemon=True).start()
             if self.checkpoint_store is not None:
                 threading.Thread(target=self._persist_loop,
                                  daemon=True,

@@ -1,10 +1,14 @@
-"""Threaded HTTP/JSON serving frontend + ``velescli serve``.
+"""HTTP/JSON serving frontend + ``velescli serve``.
 
-Same zero-dependency stack as ``web_status.py``: a stdlib
-``ThreadingHTTPServer`` where each request thread parks inside the
-micro-batcher until its batch completes — the dynamic batching happens
-BETWEEN these threads, so concurrency on the socket side directly
-becomes batch fill on the device side.
+Same zero-dependency stack as ``web_status.py``: since ISSUE 9 the
+listener lives on the process's SHARED selector reactor
+(``veles/reactor.py``). Probe and metrics surfaces answer INLINE on
+the loop — no thread per request — while each ``POST /v1/predict``
+is handed to a worker thread that parks inside the micro-batcher
+until its batch completes: the dynamic batching still happens BETWEEN
+those threads, so concurrency on the socket side directly becomes
+batch fill on the device side (threads exist only where a request
+genuinely waits on the device).
 
 Endpoints:
 
@@ -46,11 +50,10 @@ of a train→serve deployment.
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy
 
-from veles import health, telemetry
+from veles import health, reactor, telemetry
 from veles.logger import Logger
 from veles.serving.batcher import DeadlineExceeded, QueueFull
 
@@ -86,100 +89,87 @@ class ServingFrontend(Logger):
     def __init__(self, registry, port=0, host="127.0.0.1"):
         self.name = "serving"
         self.registry = registry
-        front = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
-
-            def _reply(self, code, doc, headers=()):
-                self._reply_raw(code, json.dumps(doc).encode(),
-                                "application/json", headers=headers)
-
-            def _reply_raw(self, code, body, ctype, headers=()):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                for name, value in headers:
-                    self.send_header(name, value)
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path.startswith(("/healthz", "/readyz",
-                                         "/metrics/history")):
-                    # probe contract (zlint probe-purity): serve the
-                    # monitor's CACHED verdict — no locks, no
-                    # registry scans, no network on this path
-                    code, payload = health.health_endpoint(self.path)
-                    self._reply(code, payload)
-                elif self.path.startswith("/metrics.json"):
-                    # the pre-registry JSON shape, now a view over
-                    # the telemetry registry
-                    self._reply(200, front.metrics())
-                elif self.path.startswith("/metrics"):
-                    reg = telemetry.get_registry()
-                    self._reply_raw(
-                        200, reg.render_prometheus().encode(),
-                        reg.CONTENT_TYPE)
-                elif self.path.startswith("/debug/"):
-                    payload = telemetry.debug_endpoint(self.path)
-                    if payload is None:
-                        self._reply(404, {"error": "not found"})
-                    else:
-                        self._reply(200, payload)
-                elif self.path.startswith("/v1/models"):
-                    self._reply(200,
-                                {"models": front.registry.describe()})
-                else:
-                    self._reply(404, {"error": "not found"})
-
-            def do_POST(self):
-                if self.path != "/v1/predict":
-                    self._reply(404, {"error": "not found"})
-                    return
-                # join the caller's distributed trace, or root a new
-                # one: either way the response names the context so
-                # the caller can correlate
-                trace = telemetry.TraceContext.from_traceparent(
-                    self.headers.get("traceparent"))
-                if trace is None:
-                    trace = telemetry.TraceContext.new()
-                tp_header = (("traceparent", trace.to_traceparent()),)
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    doc = json.loads(self.rfile.read(n))
-                except ValueError:
-                    # the 400 carries the echo too: callers correlate
-                    # failures by the same header as successes
-                    self._reply(400, {"error": "bad json"},
-                                headers=tp_header)
-                    return
-                code, reply = front.predict_request(doc, trace=trace)
-                headers = tp_header
-                if code == 503:
-                    # overload/readiness rejection: tell the caller
-                    # WHEN to come back instead of a generic failure
-                    headers = tp_header + (
-                        ("Retry-After",
-                         str(reply.get("retry_after_s",
-                                       RETRY_AFTER_SHED))),)
-                self._reply(code, reply, headers=headers)
-
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_address[1]
+        # bind first (check names carry the port), wire health, THEN
+        # accept: the first request may arrive the instant the
+        # acceptor registers, and the predict gate reads self._monitor
+        self._server = reactor.HttpServer(host, port, self._route,
+                                          name="serving-http",
+                                          start=False)
+        self.port = self._server.port
         self.host = host
-        # health wiring BEFORE the listener thread: the first request
-        # may arrive the instant the port is served, and the predict
-        # gate reads self._monitor
         self._check_names = ()
         self._shed_seen = None
         self.register_health()
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="serving-http")
-        self._thread.start()
+        self._server.start()
         self.info("serving on http://%s:%d/", host, self.port)
+
+    # -- routing (reactor loop; inline routes must not block) ----------
+
+    def _route(self, request):
+        path = request.path
+        if request.method == "POST":
+            if path != "/v1/predict":
+                request.reply_json(404, {"error": "not found"})
+                return
+            # predict parks in the micro-batcher until its batch
+            # completes — exactly the wait that must NOT happen on
+            # the loop, so each predict gets a worker thread (that
+            # thread-count IS the batch fill, as before)
+            request.defer(self._serve_predict, request)
+            return
+        if path.startswith(("/healthz", "/readyz",
+                            "/metrics/history")):
+            # probe contract (zlint probe-purity): serve the
+            # monitor's CACHED verdict — no locks, no registry
+            # scans, no network, inline on the loop
+            code, payload = health.health_endpoint(path)
+            request.reply_json(code, payload)
+        elif path.startswith("/metrics.json"):
+            # the pre-registry JSON shape, now a view over the
+            # telemetry registry
+            request.reply_json(200, self.metrics())
+        elif path.startswith("/metrics"):
+            reg = telemetry.get_registry()
+            request.reply(200, reg.render_prometheus().encode(),
+                          reg.CONTENT_TYPE)
+        elif path.startswith("/debug/"):
+            payload = telemetry.debug_endpoint(path)
+            if payload is None:
+                request.reply_json(404, {"error": "not found"})
+            else:
+                request.reply_json(200, payload)
+        elif path.startswith("/v1/models"):
+            request.reply_json(200,
+                               {"models": self.registry.describe()})
+        else:
+            request.reply_json(404, {"error": "not found"})
+
+    def _serve_predict(self, request):
+        # join the caller's distributed trace, or root a new one:
+        # either way the response names the context so the caller
+        # can correlate
+        trace = telemetry.TraceContext.from_traceparent(
+            request.headers.get("traceparent"))
+        if trace is None:
+            trace = telemetry.TraceContext.new()
+        tp_header = (("traceparent", trace.to_traceparent()),)
+        try:
+            doc = json.loads(request.body)
+        except ValueError:
+            # the 400 carries the echo too: callers correlate
+            # failures by the same header as successes
+            request.reply_json(400, {"error": "bad json"},
+                               headers=tp_header)
+            return
+        code, reply = self.predict_request(doc, trace=trace)
+        headers = tp_header
+        if code == 503:
+            # overload/readiness rejection: tell the caller WHEN to
+            # come back instead of a generic failure
+            headers = tp_header + (
+                ("Retry-After",
+                 str(reply.get("retry_after_s", RETRY_AFTER_SHED))),)
+        request.reply_json(code, reply, headers=headers)
 
     # -- readiness (veles/health.py) -----------------------------------
 
@@ -383,8 +373,7 @@ class ServingFrontend(Logger):
         if self._check_names:
             self._monitor.tick()
         self._check_names = ()
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._server.close()
 
 
 # -- velescli serve -----------------------------------------------------

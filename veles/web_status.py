@@ -2,8 +2,11 @@
 
 Re-design of ``veles/web_status.py`` [U] (SURVEY.md §2.7 "Web status",
 §5.5): the reference ran a central tornado server that every Launcher
-POSTed status JSON to, plus a JS frontend. The rebuild is a stdlib
-``http.server`` with the same three surfaces and no frontend build:
+POSTed status JSON to, plus a JS frontend. The rebuild serves the same
+three surfaces with no frontend build — since ISSUE 9 hosted on the
+process's SHARED selector reactor (``veles/reactor.py``) instead of a
+``ThreadingHTTPServer``, so a probe or metrics scrape costs zero
+threads:
 
 * ``GET /``            — self-refreshing HTML dashboard
 * ``GET /status.json`` — machine-readable run status
@@ -16,10 +19,12 @@ POSTed status JSON to, plus a JS frontend. The rebuild is a stdlib
                        — liveness / readiness probes served from the
                          health monitor's CACHED verdict
                          (``veles/health.py``): the master registers
-                         lease-table and snapshot-store checks, SLO
-                         burn-rate alerts flip readiness; handlers
-                         never take the master lock or touch the
-                         network (zlint ``probe-purity``)
+                         lease-table, snapshot-store and reactor
+                         loop-lag checks, SLO burn-rate alerts flip
+                         readiness; handlers never take the master
+                         lock or touch the network (zlint
+                         ``probe-purity``), and answer INLINE on the
+                         reactor loop — no thread per request
 * ``GET /metrics/history``
                        — the monitor's time-series ring
                          (``?window=SECS``): sampled percentiles,
@@ -27,17 +32,17 @@ POSTed status JSON to, plus a JS frontend. The rebuild is a stdlib
 * ``POST /update``     — remote launchers push their status dicts
                          (same-host launchers register a callable)
 
-Status is PULLED live from registered providers at request time, so
-there is no background reporting thread on the training side — the
-dashboard costs nothing between page loads (off the hot path,
-SURVEY.md §5.8)."""
+Probe/metrics/debug routes answer on the loop from cached or
+registry-local state; the dashboard page and ``/status.json`` pull
+live providers (which may briefly take the master lock), so those two
+are handed to a worker thread — the loop never parks behind a
+provider."""
 
 import html
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from veles import health, telemetry
+from veles import health, reactor, telemetry
 from veles.logger import Logger
 
 _PAGE = """<!DOCTYPE html>
@@ -71,81 +76,70 @@ class WebStatus(Logger):
         self._providers = {}      # name -> callable() -> dict
         self._pushed = {}         # name -> dict (remote POSTs)
         self._lock = threading.Lock()
-        status = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):
-                pass
-
-            def _reply(self, code, body, ctype):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path.startswith("/status.json"):
-                    body = json.dumps(status.snapshot(),
-                                      indent=1).encode()
-                    self._reply(200, body, "application/json")
-                elif self.path.startswith(("/healthz", "/readyz",
-                                           "/metrics/history")):
-                    # probe contract (zlint probe-purity): the
-                    # monitor's cached verdict only — no provider
-                    # pulls, no master lock, no network
-                    code, payload = health.health_endpoint(self.path)
-                    self._reply(code, json.dumps(payload).encode(),
-                                "application/json")
-                elif self.path.startswith("/metrics"):
-                    reg = telemetry.get_registry()
-                    self._reply(200,
-                                reg.render_prometheus().encode(),
-                                reg.CONTENT_TYPE)
-                elif self.path.startswith("/debug/"):
-                    # flight-recorder surfaces: /debug/trace (Perfetto
-                    # JSON of the retained span window) and
-                    # /debug/events (recent structured events) — same
-                    # protocol as the serving frontend
-                    payload = telemetry.debug_endpoint(self.path)
-                    if payload is None:
-                        self._reply(404, b"not found", "text/plain")
-                    else:
-                        self._reply(
-                            200, json.dumps(payload).encode(),
-                            "application/json")
-                elif self.path == "/":
-                    self._reply(200, status.render_page().encode(),
-                                "text/html")
-                else:
-                    self._reply(404, b"not found", "text/plain")
-
-            def do_POST(self):
-                if self.path != "/update":
-                    self._reply(404, b"not found", "text/plain")
-                    return
-                n = int(self.headers.get("Content-Length", 0))
-                try:
-                    doc = json.loads(self.rfile.read(n))
-                    name = str(doc["name"])
-                except (ValueError, KeyError):
-                    self._reply(400, b"bad status json", "text/plain")
-                    return
-                with status._lock:
-                    status._pushed[name] = doc
-                self._reply(200, b"ok", "text/plain")
-
         # the dashboard is the training side's health surface: make
         # sure the monitor's sampler is running so /metrics/history
         # accumulates and /readyz reflects registered checks
         health.get_monitor()
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="web-status")
-        self._thread.start()
+        self._server = reactor.HttpServer(host, port, self._route,
+                                          name="web-status")
+        self.port = self._server.port
         self.info("dashboard on http://%s:%d/", host, self.port)
+
+    # -- routing (reactor loop; inline routes must not block) ----------
+
+    def _route(self, request):
+        path = request.path
+        if request.method == "POST":
+            if not path.startswith("/update"):
+                request.reply(404, b"not found")
+                return
+            try:
+                doc = json.loads(request.body)
+                name = str(doc["name"])
+            except (ValueError, KeyError):
+                request.reply(400, b"bad status json")
+                return
+            with self._lock:
+                self._pushed[name] = doc
+            request.reply(200, b"ok")
+            return
+        if path.startswith(("/healthz", "/readyz",
+                            "/metrics/history")):
+            # probe contract (zlint probe-purity): the monitor's
+            # cached verdict only — no provider pulls, no master
+            # lock, no network, answered inline on the loop
+            code, payload = health.health_endpoint(path)
+            request.reply_json(code, payload)
+        elif path.startswith("/metrics"):
+            reg = telemetry.get_registry()
+            request.reply(200, reg.render_prometheus().encode(),
+                          reg.CONTENT_TYPE)
+        elif path.startswith("/debug/"):
+            # flight-recorder surfaces: /debug/trace (Perfetto JSON
+            # of the retained span window) and /debug/events (recent
+            # structured events) — same protocol as the serving
+            # frontend
+            payload = telemetry.debug_endpoint(path)
+            if payload is None:
+                request.reply(404, b"not found")
+            else:
+                request.reply_json(200, payload)
+        elif path == "/" or path.startswith("/status.json"):
+            # provider pulls may take the master request lock or run
+            # arbitrary registered callables: off the loop
+            request.defer(self._serve_status, request)
+        else:
+            request.reply(404, b"not found")
+
+    def _serve_status(self, request):
+        if request.path == "/":
+            request.reply(200, self.render_page().encode(),
+                          "text/html")
+        else:
+            request.reply(200,
+                          json.dumps(self.snapshot(),
+                                     indent=1).encode(),
+                          "application/json")
 
     # -- providers -----------------------------------------------------
 
@@ -182,8 +176,7 @@ class WebStatus(Logger):
         return _PAGE % ("<table>%s</table>" % "".join(rows))
 
     def close(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        self._server.close()
 
 
 def workflow_status(workflow, mode="standalone"):
