@@ -205,9 +205,12 @@ def _train_counter(loader, kind="minibatches", scale=1.0):
     return read
 
 
-def numpy_steps_per_sec(n_steps=30):
+def _mnist_numpy_stepper(name="BenchNumpy"):
+    """(one_step, steps_done) for a freshly built numpy MNIST
+    workflow — shared by the baseline row and the profiler-overhead
+    row so both price the same training loop."""
     from veles.loader.base import CLASS_TRAIN
-    wf = _build_mnist("numpy", "BenchNumpy")
+    wf = _build_mnist("numpy", name)
     loader = wf.loader
     steps_done = _train_counter(loader)
 
@@ -221,12 +224,57 @@ def numpy_steps_per_sec(n_steps=30):
         for gd in reversed(wf.gds):
             gd.run()
 
+    return one_step, steps_done
+
+
+def numpy_steps_per_sec(n_steps=30):
+    one_step, steps_done = _mnist_numpy_stepper()
     one_step()  # warm caches
     c0 = steps_done()
     t0 = time.perf_counter()
     for _ in range(n_steps):
         one_step()
     return (steps_done() - c0) / (time.perf_counter() - t0)
+
+
+def profiler_overhead_pct(n_steps=60):
+    """ISSUE 10 satellite: percent slowdown of the numpy MNIST train
+    loop while the sampling profiler runs at its default rate
+    (veles/profiling.py; the acceptance bound is < 3%%). Measured
+    off-on-off so ambient host drift cancels: overhead = 1 -
+    rate(on) / mean(rate(off_before), rate(off_after)), floored at 0
+    (noise can make the profiled run the faster one)."""
+    from veles.profiling import SamplingProfiler
+    one_step, _ = _mnist_numpy_stepper("BenchProfOverhead")
+    one_step()  # warm caches
+
+    def rate():
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            one_step()
+        return n_steps / (time.perf_counter() - t0)
+
+    r_before = rate()
+    profiler = SamplingProfiler()
+    profiler.start()
+    try:
+        r_on = rate()
+    finally:
+        profiler.stop()
+    r_off = (r_before + rate()) / 2.0
+    return max((1.0 - r_on / r_off) * 100.0, 0.0)
+
+
+def _profiler_row(extra):
+    """Record the profiler-overhead bench guarded (device-independent
+    row: it runs, and means the same thing, with or without a TPU).
+    Directionality: the key says 'overhead', so the self-check flags
+    it when it goes UP."""
+    try:
+        extra["profiler_overhead_pct"] = round(
+            profiler_overhead_pct(), 2)
+    except Exception as exc:
+        extra["profiler_overhead_pct_error"] = str(exc)[:200]
 
 
 def _run_one_chunk(loader, step):
@@ -670,9 +718,10 @@ def _device_reachable(timeout_s=240):
 
 # -- self-check: the bench trajectory as a first-class diff ------------
 
-#: keys where SMALLER is better (wire bytes); everything else numeric
-#: in the report is a throughput/efficiency figure where bigger wins
-_LOWER_BETTER = ("bytes",)
+#: keys where SMALLER is better (wire bytes, profiler overhead);
+#: everything else numeric in the report is a throughput/efficiency
+#: figure where bigger wins
+_LOWER_BETTER = ("bytes", "overhead")
 
 #: keys that are environment stamps, not performance rows
 _SELF_CHECK_SKIP = ("calibration",)
@@ -712,7 +761,7 @@ def _flatten_rows(report):
 
 
 def self_check(report, threshold_pct=10.0, baseline_path=None,
-               stream=sys.stderr):
+               stream=None):
     """Compare this run's rows against the latest recorded bench
     artifact and print per-row deltas — WARN-ONLY (the trajectory was
     previously invisible without manually diffing BENCH_r*.json; this
@@ -720,6 +769,14 @@ def self_check(report, threshold_pct=10.0, baseline_path=None,
     it moves more than ``threshold_pct`` percent in its bad direction
     (down for throughput, up for byte counts); -> the regressed keys.
     """
+    # resolve the stream at CALL time, never as a parameter default: a
+    # def-time ``stream=sys.stderr`` binds whatever object sys.stderr
+    # was when this module FIRST imported — under pytest that is the
+    # importing test's capture buffer, and every later test's capsys
+    # then reads empty (the test_serving-before-test_health order
+    # flake, ISSUE 10 satellite)
+    if stream is None:
+        stream = sys.stderr
     path = baseline_path or _latest_bench_artifact()
     if path is None:
         print("self-check: no BENCH_r*.json baseline found — "
@@ -806,6 +863,7 @@ def main(argv=None):
         _serving_row(extra)
         _grad_codec_rows(extra)
         _dist_scaling_rows(extra)
+        _profiler_row(extra)
         return emit({
             "metric": "mnist_train_steps_per_sec",
             "value": 0.0,
@@ -853,6 +911,9 @@ def main(argv=None):
             lm_base_s8k_tokens_per_sec)
     _record(extra, "lm_345M_tokens_per_sec", lm_345m_tokens_per_sec)
     _serving_row(extra)
+    # sampling-profiler cost on the same MNIST loop (ISSUE 10; the
+    # acceptance bound is < 3% at the default 97 Hz)
+    _profiler_row(extra)
     # attention-aware MFU for every at-scale LM row (VERDICT r4 #2):
     # median tok/s x train-FLOPs/token over the v5e bf16 peak, shapes
     # read from the SAME LM_ROWS entry the throughput row used

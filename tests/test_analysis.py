@@ -929,6 +929,103 @@ class S:
     assert lint_src(tmp_path, src, select=["reactor-purity"]) == []
 
 
+# -- profiler-safety ---------------------------------------------------
+
+_PROFILER_BAD = """\
+from veles import profiling
+
+
+class Status:
+    def _route(self, request):
+        if request.path.startswith("/debug/profile"):
+            code, body, ctype = profiling.profile_endpoint(
+                request.path)
+            request.reply(code, body, ctype)
+
+
+class Wire:
+    def on_frame(self, obj):
+        self.profiler.start()
+        prof = profiling.capture_profile(2.0)
+        self.profiler.stop()
+        return prof
+
+
+class Plane:
+    def __init__(self, loop, profiler):
+        loop.every(1.0, self._tick)
+        self._profiler = profiler
+
+    def _tick(self):
+        self._profiler.capture()
+"""
+
+_PROFILER_GOOD = """\
+from veles import profiling
+
+
+class Status:
+    def _route(self, request):
+        if request.path.startswith("/debug/profile"):
+            request.defer(self._serve_profile, request)
+        elif request.path.startswith("/debug/"):
+            request.reply_json(200, {})
+
+    def _serve_profile(self, request):
+        # worker thread: blocking here is the whole point
+        code, body, ctype = profiling.profile_endpoint(request.path)
+        request.reply(code, body, ctype)
+
+
+def bench_row():
+    # NOT a reactor callback or route: a bench/CLI capture is fine
+    profiler = profiling.SamplingProfiler()
+    profiler.start()
+    profiler.stop()
+    return profiler.profile()
+
+
+class Wire:
+    def on_frame(self, obj):
+        # unrelated receivers named start/stop stay quiet
+        self.timer.start()
+        self.timer.stop()
+"""
+
+
+def test_profiler_safety_fires_on_inline_captures(tmp_path):
+    """Satellite (ISSUE 10): a /debug/profile branch answering inline
+    (no defer, direct profile_endpoint), profiler start/stop +
+    capture_profile inside on_frame, and .capture() inside an every()
+    target all fire."""
+    findings = lint_src(tmp_path, _PROFILER_BAD,
+                        select=["profiler-safety"])
+    assert set(rule_ids(findings)) == {"profiler-safety"}
+    messages = " | ".join(f.message for f in findings)
+    assert "'profile_endpoint'" in messages      # inline route call
+    assert "'capture_profile'" in messages       # on_frame capture
+    assert "profiler.start" in messages          # start on the loop
+    assert "_profiler.capture" in messages       # scheduled target
+    assert len(findings) >= 5
+
+
+def test_profiler_safety_quiet_on_deferred_and_offloop(tmp_path):
+    """The compliant shapes: the route branch defers to a worker (the
+    blocking body lives in the deferred method), a bench/CLI capture
+    off the loop, and non-profiler .start()/.stop() receivers."""
+    assert lint_src(tmp_path, _PROFILER_GOOD,
+                    select=["profiler-safety"]) == []
+
+
+def test_profiler_safety_pragma_suppresses(tmp_path):
+    src = """\
+class S:
+    def on_timer(self):
+        self.profiler.start()  # zlint: disable=profiler-safety (rig)
+"""
+    assert lint_src(tmp_path, src, select=["profiler-safety"]) == []
+
+
 # -- hygiene: bare-except / unused-import / unused-variable ------------
 
 

@@ -531,6 +531,7 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                 "calibration_matmul8k_bf16_tflops": 150.0,
                 "dist_scaling_steps_per_sec_n2": 100.0,
                 "dist_scaling_efficiency_n2": 0.8,
+                "profiler_overhead_pct": 1.0,
                 "some_row_error": "boom",
             }}}
     path = tmp_path / "BENCH_r07.json"
@@ -545,6 +546,8 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
             # — DOWN is the bad direction for both families
             "dist_scaling_steps_per_sec_n2": 50.0,    # -50%: bad
             "dist_scaling_efficiency_n2": 0.4,        # -50%: bad
+            # ISSUE 10: profiler overhead is a COST — UP is bad
+            "profiler_overhead_pct": 2.5,             # +150%: bad
         }}
     regressed = bench.self_check(report, threshold_pct=10.0,
                                  baseline_path=str(path))
@@ -554,7 +557,8 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
     assert set(regressed) == {"mnist_train_steps_per_sec",
                               "grad_sync_wire_bytes_per_step_int8",
                               "dist_scaling_steps_per_sec_n2",
-                              "dist_scaling_efficiency_n2"}
+                              "dist_scaling_efficiency_n2",
+                              "profiler_overhead_pct"}
     assert "REGRESSION" in err and "warn-only" in err
     assert "_best" not in err.split("rows in baseline")[0]
     # no baseline -> a note, no crash, nothing regressed

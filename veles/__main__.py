@@ -47,7 +47,16 @@ the live fleet dashboard (``veles/fleet.py``): polls every target's
 ``/healthz`` + ``/readyz`` + ``/metrics`` + status surfaces, merges
 the master's per-slave timing, and renders a refreshing terminal
 view — ``--json`` emits one machine-readable snapshot (the artifact
-a router/autoscaler consumes).
+a router/autoscaler consumes);
+
+    python -m veles profile http://host:port [--seconds N] [--out p.json]
+
+captures a live sampling-profiler window off a running master or
+serving process (``GET /debug/profile`` — ``veles/profiling.py``):
+speedscope JSON written to ``--out`` (load at speedscope.app), or a
+per-thread hot-function summary printed to the terminal. Like
+``velescli debug``, it works on a process that was never started
+with any profiling flag.
 """
 
 import argparse
@@ -680,6 +689,103 @@ def debug_main(argv):
     return 0
 
 
+def profile_main(argv):
+    """``velescli profile <url>``: capture a sampling-profiler window
+    off a LIVE process via ``GET /debug/profile`` and either save the
+    speedscope JSON (``--out``) or print a per-thread summary of the
+    hottest functions. Exit 0 on success, 2 when the endpoint is
+    unreachable or answers something that is not a speedscope
+    document (mirrors ``velescli debug``)."""
+    import urllib.request
+    p = argparse.ArgumentParser(
+        prog="velescli profile",
+        description="Sampling CPU profile of a live master/serving "
+                    "process via its /debug/profile endpoint "
+                    "(veles/profiling.py)")
+    p.add_argument("url",
+                   help="base URL of a --web-status dashboard or "
+                        "serving frontend (http://host:port)")
+    p.add_argument("--seconds", type=float, default=2.0,
+                   help="capture window (server clamps to its own "
+                        "bounds; default 2)")
+    p.add_argument("--hz", type=float, default=None,
+                   help="sampling rate (default: the server's 97)")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="write the speedscope JSON here (load at "
+                        "https://www.speedscope.app)")
+    p.add_argument("--top", type=int, default=5,
+                   help="hot functions listed per thread in the "
+                        "summary (default 5)")
+    args = p.parse_args(argv)
+    base = args.url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    url = base + "/debug/profile?seconds=%g" % args.seconds
+    if args.hz is not None:
+        url += "&hz=%g" % args.hz
+    try:
+        with urllib.request.urlopen(
+                url, timeout=args.seconds + 30) as resp:
+            doc = json.load(resp)
+        # shape validation INSIDE the guard (the checkpoints/debug CLI
+        # contract): a 200 from a non-profiling server must exit 2,
+        # never a traceback or a garbage artifact written to --out
+        frames = doc["shared"]["frames"]
+        profiles = doc["profiles"]
+        if not isinstance(frames, list) \
+                or not all(isinstance(f, dict) for f in frames) \
+                or not isinstance(profiles, list) \
+                or not all(isinstance(pr, dict)
+                           and isinstance(pr.get("samples"), list)
+                           and isinstance(pr.get("weights"), list)
+                           and len(pr["samples"]) == len(pr["weights"])
+                           and all(isinstance(w, (int, float))
+                                   for w in pr["weights"])
+                           and isinstance(pr.get("endValue", 0.0),
+                                          (int, float))
+                           for pr in profiles) \
+                or not all(isinstance(i, int) and 0 <= i < len(frames)
+                           for pr in profiles
+                           for sample in pr["samples"]
+                           for i in (sample if isinstance(sample, list)
+                                     else [None])):
+            # frame-index bounds checked HERE too: the summary loop
+            # below indexes frames[sample[-1]], and a shape-valid doc
+            # with garbage indices must exit 2, not traceback
+            raise ValueError("endpoint answered 200 but not a "
+                             "speedscope profile document")
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        print("error: %s: %s" % (type(exc).__name__, exc),
+              file=sys.stderr)
+        return 2
+    meta = doc.get("veles") or {}
+    print("profile: %d thread(s), %s tick(s) @ %sHz over %ss "
+          "(sampler overhead %.2f%%)"
+          % (len(profiles), meta.get("ticks", "?"),
+             meta.get("hz", "?"), meta.get("seconds", "?"),
+             float(meta.get("overhead_fraction", 0.0)) * 100.0))
+    for pr in profiles:
+        # leaf-frame self time: the "where is this thread" view
+        leaf = {}
+        for sample, weight in zip(pr["samples"], pr["weights"]):
+            if not sample:
+                continue
+            frame = frames[sample[-1]]
+            leaf[frame.get("name", "?")] = \
+                leaf.get(frame.get("name", "?"), 0.0) + float(weight)
+        hot = sorted(leaf.items(), key=lambda kv: -kv[1])[:args.top]
+        total = max(float(pr.get("endValue", 0.0)), 1e-9)
+        print("  %-24s %8.3fs  %s"
+              % (pr.get("name", "?"), float(pr.get("endValue", 0.0)),
+                 ", ".join("%s %.0f%%" % (name, 100.0 * w / total)
+                           for name, w in hot) or "-"))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        print("speedscope profile -> %s" % args.out)
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "serve":
@@ -706,6 +812,10 @@ def main(argv=None):
         # health + metrics surfaces (veles/fleet.py)
         from veles.fleet import top_main
         return top_main(argv[1:])
+    if argv and argv[0] == "profile":
+        # sampling-profiler capture off a live process's
+        # /debug/profile surface (veles/profiling.py)
+        return profile_main(argv[1:])
     m = Main(argv)
     if getattr(m.args, "background", False):
         if not daemonize(m.args.log_file):

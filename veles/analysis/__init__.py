@@ -38,6 +38,11 @@ rule id                   checks
                           primitives — raw-socket ``recv``/
                           ``sendall``/``accept``, ``time.sleep``,
                           ``Event.wait``/``Thread.join``, ``urlopen``
+``profiler-safety``       ``/debug/profile`` route branches must
+                          ``request.defer`` their capture; profiler
+                          ``start``/``stop``/``capture_profile`` are
+                          banned inside reactor callbacks (a capture
+                          blocks for its whole window)
 ``thread-lifecycle``      threads must be daemons or have a join path
 ``bare-except``           ``except:`` swallows ``KeyboardInterrupt``
 ``unused-import``         dead module-level imports

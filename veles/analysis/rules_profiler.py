@@ -1,0 +1,184 @@
+"""profiler-safety: profile captures must stay off the reactor loop.
+
+The sampling profiler (``veles/profiling.py``) BLOCKS for the whole
+requested capture window — ``capture_profile``/``profile_endpoint``
+sleep out ``seconds`` of wall time while the sampler thread walks
+stacks. Run on the shared reactor loop, one profile request would
+park every connection, probe and timer for seconds (exactly the
+failure the loop-lag gauge exists to catch). This rule statically
+checks the two places that could make that mistake:
+
+* **``/debug/profile`` route branches**: any ``if``/``elif`` branch
+  whose test mentions the ``"/debug/profile"`` string constant (the
+  routing convention in ``web_status.py`` and the serving frontend)
+  must hand the work to a worker thread — the branch has to contain a
+  ``.defer(...)`` call, and must not call a capture primitive
+  directly (``capture_profile``/``profile_endpoint``, or
+  ``.start()``/``.stop()``/``.capture()`` on a profiler-named
+  receiver). Calls inside a nested ``def``/``lambda`` are exempt:
+  that is the deferred body itself.
+* **reactor callbacks**: the same capture primitives are banned
+  inside ``on_frame``/``on_timer`` methods and
+  ``call_soon``/``call_later``/``every`` targets, reusing the
+  ``reactor-purity`` rule's target resolution.
+"""
+
+import ast
+
+from veles.analysis.core import Finding, register
+from veles.analysis.rules_reactor import (
+    _CALLBACK_METHODS, _SCHEDULE_CALLS, _call_name, _resolve_target,
+    _walk_scopes)
+
+#: module-level capture primitives (veles/profiling.py public API)
+_CAPTURE_CALLS = frozenset(("capture_profile", "profile_endpoint"))
+
+#: methods that start/stop/collect a capture when the receiver is
+#: profiler-shaped (``profiler.start()``, ``self._profiler.stop()``)
+_PROFILER_METHODS = frozenset(("start", "stop", "capture"))
+
+#: the route string this rule keys branch detection on (a module
+#: constant, not an inline literal: the rule must not fire on its own
+#: matcher)
+_ROUTE_MARK = "/debug" + "/profile"
+
+
+def _receiver_name(node):
+    """The rightmost name of a call receiver: ``a.b.profiler`` ->
+    'profiler', ``profiler`` -> 'profiler', else ''."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return _receiver_name(node.func)
+    return ""
+
+
+def _capture_call(node):
+    """The capture primitive ``node`` invokes, or None."""
+    name = _call_name(node)
+    if name in _CAPTURE_CALLS:
+        return name
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _PROFILER_METHODS \
+            and "profil" in _receiver_name(
+                node.func.value).lower():
+        return "%s.%s" % (_receiver_name(node.func.value),
+                          node.func.attr)
+    return None
+
+
+def _tests_profile_route(test):
+    """True when an if-test mentions the "/debug/profile" constant
+    (``==``, ``startswith``, tuple membership — any spelling)."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and _ROUTE_MARK in sub.value:
+            return True
+    return False
+
+
+def _walk_branch(nodes, on_call):
+    """Walk statement bodies without descending into nested function
+    or lambda definitions (a deferred closure's body runs on a worker
+    thread — the compliant escape, not a violation)."""
+    for node in nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            on_call(node)
+        _walk_branch(list(ast.iter_child_nodes(node)), on_call)
+
+
+def _scan_route_branch(mod, test, body, findings):
+    has_defer = []
+    captures = []
+
+    def on_call(call):
+        name = _call_name(call)
+        if name == "defer":
+            has_defer.append(call)
+        cap = _capture_call(call)
+        if cap is not None:
+            captures.append((call, cap))
+
+    _walk_branch(body, on_call)
+    for call, cap in captures:
+        findings.append(Finding(
+            mod.relpath, call.lineno, "profiler-safety", "error",
+            "capture primitive %r called directly in a "
+            "/debug/profile route branch — the capture blocks for "
+            "the whole requested window on the reactor loop" % cap,
+            "hand the capture to a worker thread: "
+            "request.defer(handler, request), reply from there"))
+    if not has_defer and not captures:
+        findings.append(Finding(
+            mod.relpath, test.lineno, "profiler-safety", "error",
+            "/debug/profile route branch contains no .defer(...) "
+            "call — the profile capture blocks for seconds and must "
+            "never answer inline on the reactor loop",
+            "route the branch through request.defer(...) and run "
+            "profile_endpoint on the worker thread"))
+
+
+def _scan_callback(mod, node, where, findings, seen):
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        cap = _capture_call(sub)
+        if cap is None:
+            continue
+        key = (mod.relpath, sub.lineno, cap)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(
+            mod.relpath, sub.lineno, "profiler-safety", "error",
+            "profiler capture %r inside reactor callback %s — the "
+            "capture blocks for its whole window and parks every "
+            "connection, probe and timer with it" % (cap, where),
+            "move the capture to a worker thread (request.defer / "
+            "a plain Thread) and reply via call_soon"))
+
+
+@register("profiler-safety", "error",
+          "/debug/profile route branches must request.defer their "
+          "capture, and profiler start/stop/capture_profile are "
+          "banned inside reactor callbacks — a capture blocks for "
+          "its whole window")
+def check_profiler_safety(project):
+    findings = []
+    seen = set()
+    for mod in project.modules:
+        # 1) /debug/profile route branches
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.If) \
+                    and _tests_profile_route(node.test):
+                _scan_route_branch(mod, node.test, node.body,
+                                   findings)
+        # 2) reactor callbacks (same contexts reactor-purity scans)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and item.name in _CALLBACK_METHODS:
+                        _scan_callback(
+                            mod, item,
+                            "%s.%s" % (node.name, item.name),
+                            findings, seen)
+        calls = []
+        _walk_scopes(mod.tree, None, [], calls)
+        for call, cls_node, func_stack in calls:
+            pos = _SCHEDULE_CALLS[_call_name(call)]
+            if len(call.args) <= pos:
+                continue
+            target, desc = _resolve_target(
+                call.args[pos], mod, cls_node, func_stack)
+            if target is not None:
+                _scan_callback(mod, target,
+                               "%s (scheduled at line %d)"
+                               % (desc, call.lineno), findings, seen)
+    return findings

@@ -146,6 +146,15 @@ def scrape_target(base, timeout=5.0):
     lag = metric_total(metrics, "veles_reactor_loop_lag_seconds")
     if lag is not None:
         summary["reactor_lag_s"] = lag
+    # memory accounting (ISSUE 10): host RSS rendered next to the
+    # loop lag — absent on pre-PR-10 targets, which must only degrade
+    # the row
+    rss = metric_total(metrics, "veles_host_rss_bytes")
+    if rss is not None:
+        summary["host_rss_bytes"] = rss
+    fds = metric_total(metrics, "veles_host_open_fds")
+    if fds is not None:
+        summary["host_open_fds"] = fds
     for key, name in (("serving_requests",
                        "veles_serving_requests_total"),
                       ("serving_rejected",
@@ -185,6 +194,17 @@ def scrape_target(base, timeout=5.0):
                     }
     except Exception:
         pass
+    # critical-path breakdown (ISSUE 10): where the step/request time
+    # goes, per leg — a 404 from a pre-PR-10 target degrades the row,
+    # never errors it
+    try:
+        code, doc = _fetch_json(
+            base + "/debug/critical_path?window=120", timeout)
+        if code == 200 and isinstance(doc, dict) \
+                and ("train" in doc or "serving" in doc):
+            row["critical_path"] = doc
+    except Exception:
+        pass
     row["role"] = "master" if "master" in row else (
         "serving" if "serving" in row else "process")
     return row
@@ -216,6 +236,34 @@ def fleet_snapshot(targets, timeout=5.0):
 
 
 # -- rendering ----------------------------------------------------------
+
+
+def _fmt_critical_path(cp):
+    """Per-target step/request breakdown lines out of a
+    ``/debug/critical_path`` document (ISSUE 10) — empty when the
+    target has no such surface or no attributed traces."""
+    if not isinstance(cp, dict):
+        return []
+    out = []
+    for side, label, order in (
+            ("train", "step", ("dispatch", "wire", "compute",
+                               "merge")),
+            ("serving", "serve", ("queue", "execute"))):
+        doc = cp.get(side)
+        if not isinstance(doc, dict) or not doc.get("jobs"):
+            continue
+        legs = doc.get("legs") or {}
+        parts = [
+            "%s %d%%" % (leg,
+                         round(100.0 * legs[leg].get("fraction", 0.0)))
+            for leg in order if isinstance(legs.get(leg), dict)]
+        line = "%s: %s" % (label, " | ".join(parts) or "-")
+        straggler = doc.get("straggler")
+        if isinstance(straggler, dict) and straggler.get("slave"):
+            line += " (straggler slave %s: %s)" \
+                % (straggler["slave"], straggler.get("leg", "?"))
+        out.append(line)
+    return out
 
 
 def _fmt_ready(row):
@@ -263,9 +311,20 @@ def render_snapshot(snap):
                    m.get("requests_per_sec"),
                    m.get("latency_ms_p99", "-"),
                    m.get("queue_depth"), m.get("shed_total")))
+        # host RSS and reactor lag side by side (ISSUE 10): one glance
+        # gives "how much memory, how healthy the loop" per target —
+        # either may be absent (pre-PR-9/10 process) without a row
+        # error
+        health_bits = []
+        rss = row.get("metrics", {}).get("host_rss_bytes")
+        if rss is not None:
+            health_bits.append("rss %.1fMB" % (rss / 1048576.0))
         lag = row.get("metrics", {}).get("reactor_lag_s")
         if lag is not None:
-            detail.append("reactor lag %.1fms" % (lag * 1e3))
+            health_bits.append("reactor lag %.1fms" % (lag * 1e3))
+        if health_bits:
+            detail.append(", ".join(health_bits))
+        detail.extend(_fmt_critical_path(row.get("critical_path")))
         if row.get("firing"):
             detail.append("SLO firing: " + ",".join(row["firing"]))
         if row.get("ready") is False:

@@ -70,6 +70,11 @@ DEFAULT_PREFIXES = (
     "veles_slave_", "veles_wire_", "veles_step_", "veles_loader_",
     "veles_checkpoint_", "veles_slo_", "veles_grad_",
     "veles_reactor_",
+    # memory accounting (ISSUE 10, veles/profiling.py): host RSS/fds,
+    # device allocator stats, perf-ledger + forward-cache estimates —
+    # ring-sampled so /metrics/history carries memory TRAJECTORIES
+    # and SLO objectives can fire on leaks
+    "veles_host_", "veles_device_", "veles_perf_",
 )
 
 #: sampler cadence (seconds) and ring capacity: 1 Hz x 900 samples =
@@ -348,6 +353,18 @@ class HealthMonitor(Logger):
     def _sample(self):
         """One flat ``{series_key: value}`` snapshot of the selected
         registry families (+ custom series fns)."""
+        # memory accounting rides the monitor tick (ISSUE 10): the
+        # veles_host_*/veles_device_*/veles_perf_* set_function gauges
+        # are (re-)registered against the ACTIVE registry here, so
+        # every monitored process exports them, registry swaps (test
+        # isolation) re-acquire them, and device kinds that only exist
+        # once jax finishes backend init still show up
+        try:
+            from veles import profiling
+            profiling.register_memory_gauges()
+        except Exception as exc:
+            self.warning("memory gauges unavailable: %s: %s",
+                         type(exc).__name__, exc)
         flat = {}
         prefixes = self.prefixes
         for fam in telemetry.get_registry().families():

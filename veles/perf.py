@@ -247,6 +247,21 @@ class PerfLedger:
             self._costs[key] = (ref, cost)
         return cost
 
+    def sizes(self):
+        """Memory-accounting view (``veles/profiling.py`` exports it
+        as ``veles_perf_ledger_*`` gauges): live cached programs and
+        their summed per-call I/O footprint estimate — a size proxy
+        for what the compiled-program cache pins, not an HBM meter."""
+        with self._lock:
+            entries = list(self._costs.values())
+        programs, est = 0, 0.0
+        for ref, cost in entries:
+            if ref is not None and ref() is None:
+                continue                 # program died; sweep pending
+            programs += 1
+            est += cost.io_bytes
+        return {"programs": programs, "est_bytes": est}
+
     def _children(self, kind):
         with self._lock:
             kids = self._kids.get(kind)

@@ -36,6 +36,12 @@ Endpoints:
 * ``GET  /debug/trace``  — Perfetto JSON of the flight-recorder
   window (``?window=SECS``); ``GET /debug/events`` — recent
   structured events. Live postmortem surfaces (``velescli debug``).
+* ``GET  /debug/critical_path`` — the flight-recorder window as a
+  per-leg request-time breakdown (queue → execute;
+  ``?window=SECS``); ``GET /debug/profile?seconds=N&hz=H`` — a live
+  sampling-profiler capture (speedscope JSON; captured on a worker
+  thread via ``request.defer``, ``velescli profile``). Both from
+  ``veles/profiling.py``.
 
 Tracing: ``POST /v1/predict`` honours an incoming W3C ``traceparent``
 header (or mints a fresh context) and returns ``traceparent`` on the
@@ -132,6 +138,10 @@ class ServingFrontend(Logger):
             reg = telemetry.get_registry()
             request.reply(200, reg.render_prometheus().encode(),
                           reg.CONTENT_TYPE)
+        elif path.startswith("/debug/profile"):
+            # the capture blocks for the requested window (zlint
+            # profiler-safety): worker thread, reply via call_soon
+            request.defer(self._serve_profile, request)
         elif path.startswith("/debug/"):
             payload = telemetry.debug_endpoint(path)
             if payload is None:
@@ -143,6 +153,11 @@ class ServingFrontend(Logger):
                                {"models": self.registry.describe()})
         else:
             request.reply_json(404, {"error": "not found"})
+
+    def _serve_profile(self, request):
+        from veles import profiling
+        code, body, ctype = profiling.profile_endpoint(request.path)
+        request.reply(code, body, ctype)
 
     def _serve_predict(self, request):
         # join the caller's distributed trace, or root a new one:

@@ -52,6 +52,25 @@ class ServedModel:
         return self.batcher.predict(rows, timeout_ms=timeout_ms,
                                     trace=trace)
 
+    def cache_bytes(self):
+        """Forward-cache memory ESTIMATE for this entry (ISSUE 10
+        memory accounting): the params pytree (host copy, plus the
+        device upload on the jit backend) and a per-compiled-bucket
+        input+output buffer guess. A size proxy the health ring can
+        trend, not an allocator meter."""
+        params = sum(a.nbytes for tree in self.model.params.values()
+                     for a in tree.values())
+        total = params * (2 if self.engine.backend == "jit" else 1)
+        sample = self.model.input_sample_shape
+        if sample:
+            row = 4
+            for d in sample:
+                row *= int(d)
+            # x2: the batch buffer in and a same-order output out
+            total += sum(b * row * 2
+                         for b in self.engine.compiled_buckets)
+        return total
+
     def describe(self):
         return {
             "name": self.name,
@@ -129,6 +148,16 @@ class ModelRegistry(Logger):
                 entry.version = old.version + 1
             self._models[name] = entry
         self._version_gauge(name).set(entry.version)
+        # scrape-time evaluation: buckets compile lazily and reloads
+        # swap entries, so a stored value would go stale immediately.
+        # Unloaded names read 0 (the series stays, the memory is gone).
+        telemetry.gauge(
+            "veles_serving_forward_cache_bytes",
+            "Estimated bytes held by the model's forward cache "
+            "(params + compiled bucket buffers; veles/profiling.py "
+            "memory accounting)", ("model",)).labels(
+                name).set_function(
+                    lambda n=name: self._entry_cache_bytes(n))
         if old is not None:
             # close OUTSIDE the lock: draining the old batcher can
             # block for seconds and must not stall get() for every
@@ -189,6 +218,11 @@ class ModelRegistry(Logger):
             self._models.clear()
         for entry in entries:
             entry.close()
+
+    def _entry_cache_bytes(self, name):
+        with self._lock:
+            entry = self._models.get(name)
+        return entry.cache_bytes() if entry is not None else 0
 
     @staticmethod
     def _version_gauge(name):

@@ -874,6 +874,19 @@ class Tracer:
                  "args": {"name": name}}
                 for pid, name in sorted(names.items())]
 
+    def flight_spans(self, window=None):
+        """The raw flight-recorder window as ``(wall, event)`` pairs
+        (newest-last, event dicts copied) — the feed the critical-path
+        analyzer (``veles/profiling.py``) consumes. ``window`` in
+        seconds, default :attr:`flight_window`."""
+        now = time.time()
+        window = self.flight_window if window is None \
+            else max(float(window), 0.0)
+        cutoff = now - window
+        with self._lock:
+            return [(w, dict(ev)) for w, ev in self._ring
+                    if w >= cutoff]
+
     def flight_doc(self, window=None):
         """Perfetto/Chrome-trace JSON document of the flight-recorder
         window: the newest spans within ``window`` seconds (default
@@ -948,7 +961,15 @@ def debug_endpoint(path):
 
     * ``/debug/trace[?window=SECS]`` — Perfetto JSON of the flight-
       recorder window;
-    * ``/debug/events[?limit=N]``    — recent structured events.
+    * ``/debug/events[?limit=N]``    — recent structured events;
+    * ``/debug/critical_path[?window=SECS]`` — the flight-recorder
+      window aggregated into the per-leg "where the step time goes"
+      document (``veles/profiling.py``).
+
+    ``/debug/profile`` is deliberately NOT here: its capture blocks
+    for the requested window, so both frontends route it through
+    ``request.defer`` to ``profiling.profile_endpoint`` instead of an
+    inline reply (zlint ``profiler-safety``).
     """
     from urllib.parse import parse_qs, urlparse
     parsed = urlparse(path)
@@ -964,4 +985,7 @@ def debug_endpoint(path):
         return tracer.flight_doc(_num("window"))
     if parsed.path == "/debug/events":
         return {"events": tracer.recent_events(_num("limit"))}
+    if parsed.path == "/debug/critical_path":
+        from veles import profiling
+        return profiling.critical_path_doc(_num("window"))
     return None

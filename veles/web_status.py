@@ -28,7 +28,17 @@ threads:
 * ``GET /metrics/history``
                        — the monitor's time-series ring
                          (``?window=SECS``): sampled percentiles,
-                         queue depths, fault counters over time
+                         queue depths, fault counters — and, since
+                         ISSUE 10, host/device memory trajectories
+* ``GET /debug/critical_path``
+                       — flight-recorder spans aggregated into the
+                         dispatch/wire/compute/merge step-time
+                         breakdown (``veles/profiling.py``)
+* ``GET /debug/profile``
+                       — live sampling-profiler capture
+                         (``?seconds=N&hz=H``, speedscope JSON;
+                         deferred to a worker thread — the capture
+                         blocks for the window)
 * ``POST /update``     — remote launchers push their status dicts
                          (same-host launchers register a callable)
 
@@ -114,10 +124,17 @@ class WebStatus(Logger):
             reg = telemetry.get_registry()
             request.reply(200, reg.render_prometheus().encode(),
                           reg.CONTENT_TYPE)
+        elif path.startswith("/debug/profile"):
+            # the sampling profiler BLOCKS for the requested capture
+            # window — the one /debug surface that must never answer
+            # on the loop (zlint profiler-safety): a worker thread
+            # captures and replies via call_soon
+            request.defer(self._serve_profile, request)
         elif path.startswith("/debug/"):
             # flight-recorder surfaces: /debug/trace (Perfetto JSON
-            # of the retained span window) and /debug/events (recent
-            # structured events) — same protocol as the serving
+            # of the retained span window), /debug/events (recent
+            # structured events) and /debug/critical_path (per-leg
+            # step-time breakdown) — same protocol as the serving
             # frontend
             payload = telemetry.debug_endpoint(path)
             if payload is None:
@@ -130,6 +147,13 @@ class WebStatus(Logger):
             request.defer(self._serve_status, request)
         else:
             request.reply(404, b"not found")
+
+    def _serve_profile(self, request):
+        # worker thread (request.defer): the capture sleeps out the
+        # requested window while the loop keeps serving probes
+        from veles import profiling
+        code, body, ctype = profiling.profile_endpoint(request.path)
+        request.reply(code, body, ctype)
 
     def _serve_status(self, request):
         if request.path == "/":
