@@ -677,6 +677,101 @@ def _serving_row(extra):
         extra["serving_throughput_rps_error"] = str(exc)[:200]
 
 
+def _lm_decode_export(tmp):
+    """Export a tiny LM archive (untrained — decode rows price the
+    serving machinery, not model quality) for the generate rows."""
+    import veles.prng as prng
+    prng.seed_all(99)
+    from veles.config import root
+    from veles.znicz_tpu.models import transformer_lm
+    saved_loader = root.lm.loader.to_dict()
+    saved_model = root.lm.model.to_dict()
+    root.lm.loader.update({"minibatch_size": 8, "n_train": 64,
+                           "n_valid": 16, "seq_len": 16, "vocab": 32,
+                           "max_period": 8})
+    root.lm.model.update({"dim": 64, "heads": 4, "layers": 2,
+                          "ffn_hidden": 128, "moe_experts": 0,
+                          "attn_block": None, "attn_impl": None,
+                          "stacked": False})
+    try:
+        wf = transformer_lm.create_workflow(name="BenchDecode")
+        wf.initialize(device="numpy")
+        wf.export_inference(tmp)
+    finally:
+        root.lm.loader.update(saved_loader)
+        root.lm.model.update(saved_model)
+
+
+def generate_decode_tokens_per_sec(streams=8, max_tokens=32,
+                                   prompt_len=8):
+    """ISSUE 11 acceptance rows: aggregate decode tokens/s for
+    ``streams`` concurrent generations through the CONTINUOUS batcher
+    (shared decode batch, KV slot per stream) vs the same requests
+    decoded SEQUENTIALLY one at a time (slot pool of 1 — the same
+    machinery, so batch fill is the only difference), plus the median
+    submit->first-token latency under the concurrent load. Both
+    engines warm one generation first so neither timed row pays an
+    XLA compile. -> (sequential tok/s, continuous tok/s, first-token
+    median seconds)."""
+    import tempfile
+    from veles.serving.decode import (ContinuousBatcher,
+                                      GenerativeEngine)
+    from veles.serving.model import ArchiveModel
+    with tempfile.TemporaryDirectory() as tmp:
+        _lm_decode_export(tmp)
+        model = ArchiveModel.from_dir(tmp)
+        prompts = [[(3 * i + j) % 32 for j in range(prompt_len)]
+                   for i in range(streams)]
+
+        def run(n_slots, concurrent):
+            engine = GenerativeEngine(model, n_slots=n_slots,
+                                      max_len=64)
+            batcher = ContinuousBatcher(
+                engine, max_queue=2 * streams,
+                model="bench-decode-%d" % n_slots)
+            try:
+                # warm: compiles the prompt bucket + the step program
+                batcher.generate(prompts[0], max_tokens=4,
+                                 wait_s=300)
+                t0 = time.perf_counter()
+                firsts = []
+                if concurrent:
+                    handles = [batcher.submit(
+                        p, max_tokens=max_tokens) for p in prompts]
+                    for h in handles:
+                        h.wait(600)
+                    firsts = sorted(h.t_first - h.t_submit
+                                    for h in handles)
+                else:
+                    for p in prompts:
+                        batcher.generate(p, max_tokens=max_tokens,
+                                         wait_s=600)
+                dt = time.perf_counter() - t0
+            finally:
+                batcher.close()
+            return streams * max_tokens / dt, firsts
+
+        seq_rate, _ = run(1, False)
+        cont_rate, firsts = run(streams, True)
+        return seq_rate, cont_rate, \
+            firsts[len(firsts) // 2] if firsts else None
+
+
+def _generate_rows(extra):
+    """The decode-plane trajectory (device-independent: numpy-export
+    + jax-CPU decode — runs, and means the same thing, with or
+    without a TPU). Directional self-check: tokens/s down = bad,
+    first-token latency up = bad ("latency" is in _LOWER_BETTER)."""
+    try:
+        seq, cont, first = generate_decode_tokens_per_sec()
+        extra["generate_tokens_per_sec_sequential"] = round(seq, 1)
+        extra["generate_tokens_per_sec_continuous"] = round(cont, 1)
+        if first is not None:
+            extra["generate_first_token_latency_s"] = round(first, 4)
+    except Exception as exc:
+        extra["generate_tokens_per_sec_error"] = str(exc)[:200]
+
+
 def _record(extra, key, fn):
     """Run one bench row; primary key = median, ``_best`` = fastest
     chunk (see the module docstring's key convention)."""
@@ -718,10 +813,10 @@ def _device_reachable(timeout_s=240):
 
 # -- self-check: the bench trajectory as a first-class diff ------------
 
-#: keys where SMALLER is better (wire bytes, profiler overhead);
-#: everything else numeric in the report is a throughput/efficiency
-#: figure where bigger wins
-_LOWER_BETTER = ("bytes", "overhead")
+#: keys where SMALLER is better (wire bytes, profiler overhead,
+#: first-token latency); everything else numeric in the report is a
+#: throughput/efficiency figure where bigger wins
+_LOWER_BETTER = ("bytes", "overhead", "latency")
 
 #: keys that are environment stamps, not performance rows
 _SELF_CHECK_SKIP = ("calibration",)
@@ -861,6 +956,7 @@ def main(argv=None):
         # report them so those trajectories survive tunnel outages
         extra = {"device_error": detail[:300]}
         _serving_row(extra)
+        _generate_rows(extra)
         _grad_codec_rows(extra)
         _dist_scaling_rows(extra)
         _profiler_row(extra)
@@ -911,6 +1007,9 @@ def main(argv=None):
             lm_base_s8k_tokens_per_sec)
     _record(extra, "lm_345M_tokens_per_sec", lm_345m_tokens_per_sec)
     _serving_row(extra)
+    # continuous-batching decode vs sequential per-request decode
+    # (ISSUE 11; the acceptance multiple at 8 concurrent streams)
+    _generate_rows(extra)
     # sampling-profiler cost on the same MNIST loop (ISSUE 10; the
     # acceptance bound is < 3% at the default 97 Hz)
     _profiler_row(extra)

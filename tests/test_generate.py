@@ -134,6 +134,42 @@ def test_generate_zero_tokens_and_compile_cache(lm):
     assert len(lm._generate_jit_cache) == n
 
 
+def test_generate_cache_keys_by_weakref_and_evicts_dead(lm):
+    """ISSUE 11 satellite: the compiled-decoder cache keys hold
+    WEAKREFS to the step units — a freed unit's reallocated id can
+    never alias a stale decoder — and entries whose units died are
+    evicted on the next generate() call."""
+    import gc
+    from veles.znicz_tpu.generate import _cache_key, _evict_dead
+
+    class U:
+        pass
+
+    live, doomed = U(), U()
+    cache = {_cache_key((1, 4, 4, 0.0, None, None),
+                        [("embed", live, None)]): "keep",
+             _cache_key((1, 4, 4, 0.0, None, None),
+                        [("embed", doomed, None)]): "drop"}
+    # same sig + same live units -> the SAME entry (weakrefs compare
+    # by referent identity), so repeated calls hit the cache
+    assert cache[_cache_key((1, 4, 4, 0.0, None, None),
+                            [("embed", live, None)])] == "keep"
+    del doomed
+    gc.collect()
+    _evict_dead(cache)
+    assert list(cache.values()) == ["keep"]
+    # a NEW unit object never matches the dead entry's key even if it
+    # reuses the freed id — and the real cache evicts as it runs
+    prompt = numpy.array([[1, 2, 3, 4]], numpy.int32)
+    generate(lm, prompt, 3)
+    n = len(lm._generate_jit_cache)
+    assert n >= 1
+    for key in lm._generate_jit_cache:
+        assert all(r() is not None for r in key[-1])
+    generate(lm, prompt, 3)
+    assert len(lm._generate_jit_cache) == n
+
+
 def test_generate_top_k_top_p(lm):
     """top_k=1 sampling must equal greedy whatever the temperature;
     top_p near 0 likewise (only the top token survives)."""

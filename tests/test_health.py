@@ -532,6 +532,8 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                 "dist_scaling_steps_per_sec_n2": 100.0,
                 "dist_scaling_efficiency_n2": 0.8,
                 "profiler_overhead_pct": 1.0,
+                "generate_tokens_per_sec_continuous": 4000.0,
+                "generate_first_token_latency_s": 0.01,
                 "some_row_error": "boom",
             }}}
     path = tmp_path / "BENCH_r07.json"
@@ -548,6 +550,10 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
             "dist_scaling_efficiency_n2": 0.4,        # -50%: bad
             # ISSUE 10: profiler overhead is a COST — UP is bad
             "profiler_overhead_pct": 2.5,             # +150%: bad
+            # ISSUE 11: decode throughput DOWN and first-token
+            # latency UP are the bad directions
+            "generate_tokens_per_sec_continuous": 2000.0,  # -50%: bad
+            "generate_first_token_latency_s": 0.05,        # +400%: bad
         }}
     regressed = bench.self_check(report, threshold_pct=10.0,
                                  baseline_path=str(path))
@@ -558,7 +564,9 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                               "grad_sync_wire_bytes_per_step_int8",
                               "dist_scaling_steps_per_sec_n2",
                               "dist_scaling_efficiency_n2",
-                              "profiler_overhead_pct"}
+                              "profiler_overhead_pct",
+                              "generate_tokens_per_sec_continuous",
+                              "generate_first_token_latency_s"}
     assert "REGRESSION" in err and "warn-only" in err
     assert "_best" not in err.split("rows in baseline")[0]
     # no baseline -> a note, no crash, nothing regressed

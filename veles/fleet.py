@@ -161,6 +161,15 @@ def scrape_target(base, timeout=5.0):
                        "veles_serving_rejected_total"),
                       ("serving_queue_rows",
                        "veles_serving_queue_rows"),
+                      # decode plane (ISSUE 11): cumulative tokens +
+                      # KV occupancy — absent on pre-PR-11 targets,
+                      # which must only degrade the row
+                      ("generated_tokens",
+                       "veles_serving_generated_tokens_total"),
+                      ("kv_slots_in_use",
+                       "veles_serving_kv_slots_in_use"),
+                      ("kv_pool_slots",
+                       "veles_serving_kv_pool_slots"),
                       ("cluster_slaves", "veles_cluster_slaves"),
                       ("cluster_faults",
                        "veles_cluster_faults_total")):
@@ -311,6 +320,17 @@ def render_snapshot(snap):
                    m.get("requests_per_sec"),
                    m.get("latency_ms_p99", "-"),
                    m.get("queue_depth"), m.get("shed_total")))
+            # decode plane (ISSUE 11): tokens/s + KV occupancy next
+            # to the predict figures — one glance per generative
+            # model; absent on non-generative / pre-PR-11 targets
+            dec = m.get("decode")
+            if isinstance(dec, dict):
+                detail.append(
+                    "%s decode: %s tok/s, kv %s/%s, queue %s"
+                    % (model, dec.get("tokens_per_sec"),
+                       dec.get("kv_slots_in_use"),
+                       dec.get("kv_pool_slots"),
+                       dec.get("queue_depth")))
         # host RSS and reactor lag side by side (ISSUE 10): one glance
         # gives "how much memory, how healthy the loop" per target —
         # either may be absent (pre-PR-9/10 process) without a row

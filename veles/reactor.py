@@ -25,7 +25,13 @@ socket through a :mod:`selectors` selector:
   cached state (no thread per request — the zlint ``probe-purity``
   contract), while routes that must block (``/v1/predict`` parking
   in the micro-batcher, dashboard provider pulls) are handed to a
-  worker thread which replies through ``call_soon``.
+  worker thread which replies through ``call_soon``. Chunked
+  transfer-encoding (``HttpRequest.begin_stream`` ->
+  :class:`HttpStream`) carries streaming responses — per-token
+  ``/v1/generate`` chunks — through the same bounded write queue, so
+  a stalled stream reader overflows and drops exactly like a stalled
+  weight-broadcast consumer, with an ``on_close`` hook telling the
+  producer to stop.
 
 The frame PROTOCOL stays in ``veles/server.py`` (``FramedConnection``
 there subclasses :class:`Connection`); this module knows nothing
@@ -686,6 +692,22 @@ class HttpRequest:
         self.reply(code, json.dumps(doc).encode(),
                    "application/json", headers)
 
+    def begin_stream(self, code, ctype="application/x-ndjson",
+                     headers=(), on_close=None):
+        """Start a chunked (``Transfer-Encoding: chunked``) response;
+        -> :class:`HttpStream` whose ``write``/``end`` may be called
+        from ANY thread (each chunk is posted onto the loop and rides
+        the connection's bounded write queue — a stalled reader
+        overflows it and is dropped like any other dead peer).
+        ``on_close(reason)`` fires ON THE LOOP if the connection dies
+        BEFORE :meth:`HttpStream.end` (client disconnect, write-queue
+        overflow) — the producer's cue to stop generating; it must
+        not block."""
+        conn = self.conn
+        conn.reactor.post(conn.start_stream, code, ctype,
+                          tuple(headers), on_close)
+        return HttpStream(conn)
+
     def defer(self, fn, *args):
         """Run ``fn(*args)`` on a fresh worker thread — the escape
         hatch for routes that must block (predict parking in the
@@ -701,6 +723,34 @@ class HttpRequest:
                          name="http-worker").start()
 
 
+class HttpStream:
+    """Thread-safe handle for one in-flight chunked response (see
+    :meth:`HttpRequest.begin_stream`). Writes after the peer dropped
+    are silently discarded — the producer learns of the death through
+    the ``on_close`` callback (or by reading :attr:`closed`)."""
+
+    __slots__ = ("conn",)
+
+    def __init__(self, conn):
+        self.conn = conn
+
+    @property
+    def closed(self):
+        return self.conn.closed
+
+    def write(self, data):
+        """Queue one chunk (bytes or str)."""
+        if isinstance(data, str):
+            data = data.encode()
+        if data:
+            self.conn.reactor.post(self.conn.send_chunk, data)
+
+    def end(self):
+        """Terminal chunk + drain + close (the normal finish — the
+        ``on_close`` callback does NOT fire for it)."""
+        self.conn.reactor.post(self.conn.finish_stream)
+
+
 class HttpConnection(Connection):
     """Incremental HTTP/1.1 request parsing on the loop; one request
     per connection (every response carries ``Connection: close`` —
@@ -713,11 +763,22 @@ class HttpConnection(Connection):
         self._head = None               # (method, path, headers)
         self._need_body = 0
         self._dispatched = False
+        #: fires on close while a chunked response is mid-stream —
+        #: cleared by finish_stream, so a NORMAL end never reports a
+        #: disconnect (see HttpRequest.begin_stream)
+        self._stream_on_close = None
         super().__init__(reactor, sock)
 
     def on_closed(self, reason):
         if self._server is not None:
             self._server.untrack(self)
+        cb = self._stream_on_close
+        self._stream_on_close = None
+        if cb is not None:
+            try:
+                cb(reason)
+            except Exception:
+                pass
 
     def data_received(self, data):
         if self._dispatched:
@@ -775,6 +836,41 @@ class HttpConnection(Connection):
         head.extend("%s: %s" % kv for kv in headers)
         self.send_parts([("\r\n".join(head) + "\r\n\r\n").encode(),
                          body])
+        self.close_when_drained()
+
+    # -- chunked streaming (loop thread; posted via HttpStream) --------
+
+    def start_stream(self, code, ctype, headers, on_close):
+        """Response head for a chunked-transfer body (streaming
+        decode). No Content-Length — chunks follow until
+        finish_stream's terminal chunk."""
+        if self.closed:
+            # born dead: tell the producer immediately
+            if on_close is not None:
+                try:
+                    on_close(self.close_reason or "closed")
+                except Exception:
+                    pass
+            return
+        self._stream_on_close = on_close
+        head = ["HTTP/1.1 %d %s" % (code, _REASONS.get(code, "OK")),
+                "Content-Type: %s" % ctype,
+                "Transfer-Encoding: chunked",
+                "Connection: close"]
+        head.extend("%s: %s" % kv for kv in headers)
+        self.send_parts([("\r\n".join(head) + "\r\n\r\n").encode()])
+
+    def send_chunk(self, data):
+        if self.closed or not data:
+            return
+        self.send_parts([b"%x\r\n" % len(data), data, b"\r\n"])
+
+    def finish_stream(self):
+        if self.closed:
+            return
+        # deliberate end: the close that follows is NOT a disconnect
+        self._stream_on_close = None
+        self.send_parts([b"0\r\n\r\n"])
         self.close_when_drained()
 
 

@@ -13,13 +13,20 @@ JAX-native serving half of the north star:
   forward cache (jax.jit, donated batch buffers, warmup);
 * :mod:`veles.serving.batcher`  — dynamic micro-batching with
   power-of-two buckets, per-request deadlines, backpressure shedding;
-* :mod:`veles.serving.frontend` — threaded HTTP/JSON frontend
-  (``/v1/models``, ``/v1/predict``, ``/healthz``, ``/metrics``) and
-  the ``velescli.py serve`` entry point.
+* :mod:`veles.serving.decode`   — the generative decode plane
+  (ISSUE 11): paged KV cache in preallocated bucketed slots,
+  continuous batching (admission into the in-flight decode batch at
+  step boundaries), per-token streaming callbacks;
+* :mod:`veles.serving.frontend` — reactor-hosted HTTP/JSON frontend
+  (``/v1/models``, ``/v1/predict``, streaming ``/v1/generate``,
+  ``/healthz``, ``/metrics``) and the ``velescli.py serve`` entry
+  point.
 """
 
 from veles.serving.batcher import (             # noqa: F401
     DeadlineExceeded, MicroBatcher, QueueFull)
+from veles.serving.decode import (              # noqa: F401
+    ContinuousBatcher, DecodePlan, GenerativeEngine, KVPool)
 from veles.serving.engine import InferenceEngine  # noqa: F401
 from veles.serving.model import ArchiveModel      # noqa: F401
 from veles.serving.registry import ModelRegistry  # noqa: F401

@@ -13,10 +13,24 @@ genuinely waits on the device).
 Endpoints:
 
 * ``GET  /v1/models``  — registry listing (name, version, shapes,
-  compiled buckets)
+  compiled buckets, generative flag)
 * ``POST /v1/predict`` — ``{"model": name, "inputs": [[...], ...],
   "timeout_ms": 250}`` -> ``{"outputs": [...], "version": n}``;
   503 when shed (queue full), 504 when the deadline expired
+* ``POST /v1/generate`` — the decode plane (ISSUE 11):
+  ``{"model": name, "prompt": [int, ...], "max_tokens": 32,
+  "temperature": 0.0, "eos": id, "stream": true}``. With
+  ``stream`` (the default) the response is ``Transfer-Encoding:
+  chunked`` ndjson written token by token THROUGH the reactor loop
+  as the continuous batcher decodes — one ``{"token": t}`` line per
+  token, then a ``{"done": true, "tokens": [...], "finish_reason":
+  ...}`` line; a client that disconnects (or stalls past the
+  write-queue bound) frees its KV slot mid-flight and counts
+  ``veles_serving_rejected_total{reason="disconnect"}``. With
+  ``stream: false`` one JSON reply carries the full token list.
+  503 + Retry-After when the decode queue is full, 400 when the
+  prompt/budget exceeds the KV slot geometry or the model is not
+  generative.
 * ``GET  /healthz``      — liveness (cached, non-blocking probe)
 * ``GET  /readyz``       — readiness: 200 only while the registry
   holds a warm model, no snapshot-store circuit breaker is open, the
@@ -65,15 +79,16 @@ from veles.serving.batcher import DeadlineExceeded, QueueFull
 
 #: overload rejections by reason (satellite, ISSUE 8): "shed" = the
 #: micro-batcher's queue was full, "not_ready" = readiness was false
-#: (no warm model / breaker open / SLO firing) — both answer 503 +
-#: Retry-After instead of a generic failure
+#: (no warm model / breaker open / SLO firing), "disconnect" = a
+#: streaming /v1/generate client dropped (or overflowed its write
+#: queue) mid-decode and its KV slot was reclaimed (ISSUE 11)
 _REJECTED = {
     reason: telemetry.LazyChild(
         lambda r=reason: telemetry.counter(
             "veles_serving_rejected_total",
             "Requests rejected with 503 before any forward compute, "
             "by reason", ("reason",)).labels(r))
-    for reason in ("shed", "not_ready")}
+    for reason in ("shed", "not_ready", "disconnect")}
 
 #: Retry-After (seconds) sent with 503s: shed queues drain within a
 #: batching window; readiness usually needs a reload/recovery cycle
@@ -114,14 +129,20 @@ class ServingFrontend(Logger):
     def _route(self, request):
         path = request.path
         if request.method == "POST":
-            if path != "/v1/predict":
+            if path == "/v1/predict":
+                # predict parks in the micro-batcher until its batch
+                # completes — exactly the wait that must NOT happen
+                # on the loop, so each predict gets a worker thread
+                # (that thread-count IS the batch fill, as before)
+                request.defer(self._serve_predict, request)
+            elif path == "/v1/generate":
+                # generate SUBMITS (non-blocking) and then streams
+                # from decode-thread callbacks, but the first-use
+                # decoder build and a non-streaming wait do block —
+                # worker thread, replies posted back to the loop
+                request.defer(self._serve_generate, request)
+            else:
                 request.reply_json(404, {"error": "not found"})
-                return
-            # predict parks in the micro-batcher until its batch
-            # completes — exactly the wait that must NOT happen on
-            # the loop, so each predict gets a worker thread (that
-            # thread-count IS the batch fill, as before)
-            request.defer(self._serve_predict, request)
             return
         if path.startswith(("/healthz", "/readyz",
                             "/metrics/history")):
@@ -159,6 +180,18 @@ class ServingFrontend(Logger):
         code, body, ctype = profiling.profile_endpoint(request.path)
         request.reply(code, body, ctype)
 
+    @staticmethod
+    def _reply_headers(code, reply, tp_header):
+        """Response headers for one JSON reply: the traceparent echo
+        always; on 503 also Retry-After — an overload/readiness
+        rejection tells the caller WHEN to come back instead of a
+        generic failure."""
+        if code == 503:
+            return tp_header + (
+                ("Retry-After",
+                 str(reply.get("retry_after_s", RETRY_AFTER_SHED))),)
+        return tp_header
+
     def _serve_predict(self, request):
         # join the caller's distributed trace, or root a new one:
         # either way the response names the context so the caller
@@ -177,14 +210,152 @@ class ServingFrontend(Logger):
                                headers=tp_header)
             return
         code, reply = self.predict_request(doc, trace=trace)
-        headers = tp_header
-        if code == 503:
-            # overload/readiness rejection: tell the caller WHEN to
-            # come back instead of a generic failure
-            headers = tp_header + (
-                ("Retry-After",
-                 str(reply.get("retry_after_s", RETRY_AFTER_SHED))),)
-        request.reply_json(code, reply, headers=headers)
+        request.reply_json(code, reply,
+                           headers=self._reply_headers(
+                               code, reply, tp_header))
+
+    # -- generative decode (ISSUE 11) ----------------------------------
+
+    def _serve_generate(self, request):
+        """Worker-thread half of ``POST /v1/generate``: validate +
+        submit to the continuous batcher, then either stream tokens
+        as chunked ndjson (written through the reactor loop by the
+        decode thread's callbacks) or wait and answer once."""
+        trace = telemetry.TraceContext.from_traceparent(
+            request.headers.get("traceparent"))
+        if trace is None:
+            trace = telemetry.TraceContext.new()
+        tp_header = (("traceparent", trace.to_traceparent()),)
+        try:
+            doc = json.loads(request.body)
+        except ValueError:
+            request.reply_json(400, {"error": "bad json"},
+                               headers=tp_header)
+            return
+        stream_mode = bool(doc.get("stream", True)) \
+            if isinstance(doc, dict) else True
+        if not stream_mode:
+            code, reply = self.generate_request(doc, trace=trace)
+            request.reply_json(code, reply,
+                               headers=self._reply_headers(
+                                   code, reply, tp_header))
+            return
+        code, reply, handle, entry = self._submit_generate(doc, trace)
+        if handle is None:
+            request.reply_json(code, reply,
+                               headers=self._reply_headers(
+                                   code, reply, tp_header))
+            return
+        stream = request.begin_stream(
+            200, "application/x-ndjson", headers=tp_header,
+            on_close=lambda reason: self._generate_disconnect(
+                handle, reason))
+        stream.write(json.dumps(
+            {"model": entry.name, "version": entry.version}) + "\n")
+
+        def on_token(tok):
+            stream.write(json.dumps({"token": int(tok)}) + "\n")
+
+        def on_done(req):
+            if req.error is not None:
+                stream.write(json.dumps(
+                    {"error": str(req.error)}) + "\n")
+            else:
+                stream.write(json.dumps(
+                    {"done": True, "n": len(req.tokens),
+                     "tokens": [int(t) for t in req.tokens],
+                     "finish_reason": req.finish_reason}) + "\n")
+            stream.end()
+
+        handle.set_on_token(on_token)
+        handle.set_on_done(on_done)
+
+    def _generate_disconnect(self, handle, reason):
+        """The stream's connection died before the terminal chunk
+        (client gone, or its bounded write queue overflowed): stop
+        decoding and give the KV slot back. Runs on the reactor loop
+        — flag flips and a counter only, nothing blocking."""
+        if handle.done.is_set():
+            return                   # raced a normal finish: no-op
+        _REJECTED["disconnect"].get().inc()
+        handle.cancel("disconnect")
+
+    def _submit_generate(self, doc, trace):
+        """Validate + submit one generation; -> (code, error_reply,
+        handle|None, entry|None). Shared by the streaming and
+        one-shot paths."""
+        blocking = self._admission_block((":shedding",))
+        if blocking:
+            _REJECTED["not_ready"].get().inc()
+            return 503, {"error": "not ready", "reasons": blocking,
+                         "retry_after_s": RETRY_AFTER_NOT_READY}, \
+                None, None
+        try:
+            name = doc["model"]
+            prompt = doc["prompt"]
+            if not isinstance(prompt, (list, tuple)):
+                raise TypeError("prompt must be a list of token ids")
+        except (KeyError, TypeError) as exc:
+            return 400, {"error": "bad request: %s" % exc}, \
+                None, None
+        try:
+            entry = self.registry.get(name)
+            decoder = self.registry.decoder(name)
+        except KeyError as exc:
+            return 404, {"error": str(exc)}, None, None
+        except ValueError as exc:
+            # loaded, but not an LM archive — client-fixable
+            return 400, {"error": str(exc)}, None, None
+        try:
+            handle = decoder.submit(
+                prompt, max_tokens=doc.get("max_tokens"),
+                temperature=float(doc.get("temperature", 0.0)),
+                eos=doc.get("eos"),
+                timeout_ms=doc.get("timeout_ms"), trace=trace)
+        except QueueFull as exc:
+            _REJECTED["shed"].get().inc()
+            return 503, {"error": str(exc),
+                         "retry_after_s": RETRY_AFTER_SHED}, \
+                None, None
+        except (ValueError, TypeError) as exc:
+            return 400, {"error": str(exc)}, None, None
+        return 200, None, handle, entry
+
+    def generate_request(self, doc, trace=None, wait_s=120.0):
+        """One-shot (non-streaming) generate: -> (code, reply dict).
+        Shared by the HTTP handler and tests (no socket needed)."""
+        t0 = time.perf_counter()
+        with telemetry.context(trace):
+            code, reply, handle, entry = self._submit_generate(
+                doc, trace)
+            if handle is not None:
+                try:
+                    tokens = handle.wait(wait_s)
+                    code, reply = 200, {
+                        "model": entry.name,
+                        "version": entry.version,
+                        "tokens": [int(t) for t in tokens],
+                        "n": len(tokens),
+                        "finish_reason": handle.finish_reason}
+                except DeadlineExceeded as exc:
+                    # the client hears failure — the generation must
+                    # not keep decoding into an answer nobody reads
+                    # (its KV slot frees at the next step boundary)
+                    handle.cancel("wait timeout")
+                    code, reply = 504, {"error": str(exc)}
+                except Exception as exc:
+                    handle.cancel("request failed")
+                    code, reply = 500, {"error": "%s: %s"
+                                        % (type(exc).__name__, exc)}
+        if telemetry.tracer.active:
+            args = {"code": code, "model": str(doc.get("model"))
+                    if isinstance(doc, dict) else "?"}
+            if trace is not None:
+                args.update(trace.span_args())
+            telemetry.tracer.add_complete(
+                "http.generate", t0, time.perf_counter() - t0,
+                **args)
+        return code, reply
 
     # -- readiness (veles/health.py) -----------------------------------
 
@@ -199,13 +370,16 @@ class ServingFrontend(Logger):
         prefix = "serving:%d" % self.port
         self._check_names = (prefix + ":models",
                              prefix + ":snapshot_store",
-                             prefix + ":shedding")
+                             prefix + ":shedding",
+                             prefix + ":decode")
         # one tick for the batch, not one per check
         monitor.add_check(self._check_names[0], self._check_models,
                           tick=False)
         monitor.add_check(self._check_names[1], self._check_stores,
                           tick=False)
-        monitor.add_check(self._check_names[2], self._check_shedding)
+        monitor.add_check(self._check_names[2], self._check_shedding,
+                          tick=False)
+        monitor.add_check(self._check_names[3], self._check_decode)
         return monitor
 
     def _check_models(self):
@@ -262,6 +436,23 @@ class ServingFrontend(Logger):
                            % (int(d_shed), int(d_total)))
         return True, None
 
+    def _check_decode(self):
+        """Fail while any model's decode loop is dead or wedged
+        (``ContinuousBatcher.healthy``): the worker thread must be
+        alive and, with sequences in flight, keep completing steps.
+        Models that never built a decoder (or aren't generative)
+        don't participate."""
+        bad = []
+        for entry in self._entries():
+            decoder = getattr(entry, "decoder", None)
+            if decoder is not None:
+                ok, why = decoder.healthy()
+                if not ok:
+                    bad.append("%s: %s" % (entry.name, why))
+        if bad:
+            return False, "; ".join(bad)
+        return True, None
+
     # -- request handling ----------------------------------------------
 
     def predict_request(self, doc, trace=None):
@@ -285,29 +476,30 @@ class ServingFrontend(Logger):
                 "http.predict", t0, time.perf_counter() - t0, **args)
         return code, reply
 
-    def _predict_request(self, doc, trace):
+    def _admission_block(self, exclude):
+        """Reasons that should 503 new admissions, or None. A
+        not-ready process (cold registry, open breaker, firing SLO)
+        must shed load with an honest retry hint, not half-serve it —
+        EXCEPT the ``exclude`` check suffixes: shedding-only
+        unreadiness would flap at the monitor interval (no admissions
+        -> next tick sees zero sheds -> ready -> readmit the storm),
+        and a wedged DECODE loop must not refuse plain predicts.
+        /readyz still reports everything, so a router can drain.
+        Reasons are keyed on the check NAME part of "name: reason"
+        (several frontends may share this process's monitor)."""
         ready, reasons = self._monitor.ready_state()
-        if not ready:
-            # reject BEFORE parsing/enqueueing: a not-ready process
-            # (cold registry, open breaker, firing SLO) must shed
-            # load with an honest retry hint, not half-serve it.
-            # EXCEPT shedding-only unreadiness: the batcher already
-            # sheds per-model via QueueFull — gating admission on the
-            # cached shed verdict would flap at the monitor interval
-            # (no admissions -> next tick sees zero sheds -> ready ->
-            # readmit the storm) and starve the models that are fine.
-            # /readyz still reports it, so a router can drain.
-            # drop ANY frontend's shedding reason (several frontends
-            # may share this process's monitor), keyed on the check
-            # NAME part of "name: reason"
-            blocking = [r for r in reasons
-                        if not r.split(": ", 1)[0]
-                        .endswith(":shedding")]
-            if blocking:
-                _REJECTED["not_ready"].get().inc()
-                return 503, {"error": "not ready",
-                             "reasons": blocking,
-                             "retry_after_s": RETRY_AFTER_NOT_READY}
+        if ready:
+            return None
+        return [r for r in reasons
+                if not r.split(": ", 1)[0].endswith(exclude)] or None
+
+    def _predict_request(self, doc, trace):
+        blocking = self._admission_block((":shedding", ":decode"))
+        if blocking:
+            _REJECTED["not_ready"].get().inc()
+            return 503, {"error": "not ready",
+                         "reasons": blocking,
+                         "retry_after_s": RETRY_AFTER_NOT_READY}
         try:
             name = doc["model"]
             inputs = numpy.asarray(doc["inputs"], numpy.float32)
@@ -427,6 +619,13 @@ def build_serve_argparser():
                    help="default per-request deadline")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip bucket-ladder precompilation")
+    p.add_argument("--decode-slots", type=int, default=8,
+                   help="KV pool slots = width of the shared "
+                        "continuous decode batch (/v1/generate)")
+    p.add_argument("--decode-max-len", type=int, default=256,
+                   help="per-slot KV length: prompt + max_tokens "
+                        "must fit (clamped to the exported "
+                        "positions table)")
     p.add_argument("--slo-config", default=None, metavar="PATH",
                    help="JSON list of SLO objectives evaluated by "
                         "the in-process health monitor (burn-rate "
@@ -465,7 +664,9 @@ def serve_main(argv=None):
     registry = ModelRegistry(
         backend=args.backend, max_batch=args.max_batch,
         max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
-        default_timeout_ms=args.timeout_ms)
+        default_timeout_ms=args.timeout_ms,
+        decode_slots=args.decode_slots,
+        decode_max_len=args.decode_max_len)
     for name, source in sorted(models.items()):
         registry.load(name, source,
                       checkpoint=checkpoints.get(name),

@@ -41,6 +41,13 @@ class ServedModel:
         self.checkpoint = checkpoint
         self.version = 1
         self.loaded_at = time.time()
+        #: lazy decode plane (ISSUE 11): built by
+        #: ModelRegistry.decoder() on the first /v1/generate for a
+        #: generative archive — a classifier-only registry never pays
+        #: for a KV pool
+        self.decoder = None
+        self._decoder_lock = threading.Lock()
+        self._closed = False
         #: readiness signal (veles/health.py): False only while a
         #: REQUESTED warmup is still compiling the bucket ladder — a
         #: model loaded without warmup compiles on first request and
@@ -69,10 +76,16 @@ class ServedModel:
             # x2: the batch buffer in and a same-order output out
             total += sum(b * row * 2
                          for b in self.engine.compiled_buckets)
+        decoder = self.decoder
+        if decoder is not None:
+            # the paged KV pool is preallocated forward-cache memory
+            # too (ISSUE 11): slots exist whether or not occupied
+            total += decoder.engine.pool.nbytes()
         return total
 
     def describe(self):
-        return {
+        from veles.serving.decode import DecodePlan
+        doc = {
             "name": self.name,
             "version": self.version,
             "workflow": self.model.workflow_name,
@@ -83,23 +96,49 @@ class ServedModel:
             "backend": self.engine.backend,
             "compiled_buckets": self.engine.compiled_buckets,
             "loaded_at": self.loaded_at,
+            "generative": DecodePlan.probe(self.model),
         }
+        decoder = self.decoder
+        if decoder is not None:
+            doc["decode"] = {
+                "kv_pool_slots": decoder.engine.pool.n_slots,
+                "max_len": decoder.engine.max_len,
+            }
+        return doc
 
-    def close(self):
-        self.batcher.close()
+    def close(self, zero_gauge=True):
+        """``zero_gauge=False`` is the hot-reload path (see
+        MicroBatcher.close). The decoder handoff happens under
+        _decoder_lock so an unload racing a first /v1/generate can
+        never leak a just-built decode plane: either close() takes
+        it here, or the builder sees _closed and refuses."""
+        with self._decoder_lock:
+            self._closed = True
+            decoder = self.decoder
+            self.decoder = None
+        if decoder is not None:
+            decoder.close()
+        self.batcher.close(zero_gauge=zero_gauge)
 
 
 class ModelRegistry(Logger):
     """Thread-safe name -> :class:`ServedModel` map."""
 
     def __init__(self, backend="auto", max_batch=64, max_queue=256,
-                 max_wait_ms=2.0, default_timeout_ms=1000.0):
+                 max_wait_ms=2.0, default_timeout_ms=1000.0,
+                 decode_slots=8, decode_max_len=256,
+                 decode_max_queue=64):
         self.name = "registry"
         self.backend = backend
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.max_wait_ms = float(max_wait_ms)
         self.default_timeout_ms = float(default_timeout_ms)
+        #: decode-plane geometry (ISSUE 11): KV pool width (the shared
+        #: decode batch) and per-slot sequence length
+        self.decode_slots = int(decode_slots)
+        self.decode_max_len = int(decode_max_len)
+        self.decode_max_queue = int(decode_max_queue)
         self._lock = threading.Lock()
         self._models = {}
         #: per-model count of failed hot reloads (checkpoint store
@@ -126,6 +165,12 @@ class ModelRegistry(Logger):
                 # cache and the running batcher
                 old.model = model
                 old.engine.set_model(model, params_only=True)
+                if old.decoder is not None:
+                    # decode programs keep too (params are runtime
+                    # args); in-flight sequences finish on whichever
+                    # tree their next step reads — same contract as
+                    # in-flight predict batches
+                    old.decoder.engine.set_params(model)
                 old.source = source
                 old.checkpoint = checkpoint
                 old.version += 1
@@ -159,11 +204,13 @@ class ModelRegistry(Logger):
                 name).set_function(
                     lambda n=name: self._entry_cache_bytes(n))
         if old is not None:
-            # close OUTSIDE the lock: draining the old batcher can
-            # block for seconds and must not stall get() for every
-            # other model's request threads. The replacement batcher
-            # owns the model's queue-gauge series now — don't zero it.
-            old.batcher.close(zero_gauge=False)
+            # close OUTSIDE the lock: draining the old batcher (and
+            # the old decode plane's worker + KV pool, when one was
+            # built) can block for seconds and must not stall get()
+            # for every other model's request threads. The
+            # replacement batcher owns the model's queue-gauge
+            # series now — don't zero it.
+            old.close(zero_gauge=False)
         if warmup:
             entry.warm = False
             try:
@@ -244,6 +291,37 @@ class ModelRegistry(Logger):
         with self._lock:
             return sorted(self._models)
 
+    def decoder(self, name):
+        """The model's continuous-batching decode plane, built on
+        first use (:class:`~veles.serving.decode.ContinuousBatcher`).
+        Raises :class:`KeyError` for unknown names and
+        :class:`ValueError` when the archive cannot generate (not an
+        LM: no leading embedding / non-causal attention)."""
+        entry = self.get(name)
+        decoder = entry.decoder
+        if decoder is not None:
+            return decoder
+        from veles.serving.decode import (ContinuousBatcher,
+                                          GenerativeEngine)
+        with entry._decoder_lock:
+            if entry._closed:
+                # raced an unload/replace: the entry will never be
+                # served again, so a decoder built now would leak
+                raise KeyError("model %r was unloaded" % name)
+            if entry.decoder is None:
+                engine = GenerativeEngine(
+                    entry.model, n_slots=self.decode_slots,
+                    max_len=self.decode_max_len,
+                    name="decode-engine-%s" % name)
+                entry.decoder = ContinuousBatcher(
+                    engine, max_queue=self.decode_max_queue,
+                    name="decode-%s" % name, model=name)
+                self.info(
+                    "decode plane for %s: %d KV slots x %d tokens "
+                    "(%.1f MB pool)", name, engine.pool.n_slots,
+                    engine.max_len, engine.pool.nbytes() / 1048576.0)
+            return entry.decoder
+
     def describe(self):
         with self._lock:
             entries = list(self._models.values())
@@ -261,6 +339,11 @@ class ModelRegistry(Logger):
             store = self._checkpoint_store(e.checkpoint)
             if store is not None:
                 m["checkpoint_store"] = store.metrics()
+            decoder = e.decoder
+            if decoder is not None:
+                # the decode plane's view: tokens/s, KV occupancy,
+                # queue — what velescli top renders per target
+                m["decode"] = decoder.metrics()
             out[name] = m
         return out
 

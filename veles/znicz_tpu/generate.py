@@ -30,11 +30,22 @@ constants), and the compiled prefill/decode pair is cached on the
 workflow per output signature — repeated generate() calls with the
 same shapes are compile-free and always use the current weights.
 
+The per-layer decode formulas (:func:`attn_decode`,
+:func:`block_decode`) take the position as a PER-SEQUENCE vector —
+the batch-joinable carry the serving decode plane
+(``veles/serving/decode.py``) needs: continuous batching packs
+sequences of different lengths into one decode step, each row
+writing its K/V at its own position and masking its own horizon. The
+offline path here simply passes a constant vector (every row at the
+same position).
+
 Supported unit types: Embedding, MultiHeadAttention (causal),
 LayerNorm, TransformerFFN, MoEFFN, TokenDense(+RELU),
 TransformerBlockStack, Dropout (identity at inference). Anything else
 raises — mirroring the C++ export contract.
 """
+
+import weakref
 
 import numpy
 
@@ -58,15 +69,24 @@ def _unit_params(workflow, unit):
     return out
 
 
-def _attn_decode(x, pos, kv, p, heads, include_bias, residual, dot):
+def attn_decode(x, pos, kv, p, heads, include_bias, residual,
+                dot=None):
     """One decode step through an attention layer: x (B,1,D), kv =
-    (K, V) buffers (B,H,max,dh). Returns (y, new_kv)."""
+    (K, V) buffers (B,H,max,dh). ``pos`` is a PER-SEQUENCE int32
+    vector (B,) — each row writes its K/V at its own position and
+    attends its own horizon (a scalar is broadcast). Returns
+    (y, new_kv). This is the batch-joinable carry the continuous
+    batcher rides: rows admitted at different times decode in one
+    step."""
+    import jax
     import jax.numpy as jnp
     from jax import lax
 
+    dot = dot or jnp.matmul
     b, _, d = x.shape
     dh = d // heads
     K, V = kv
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
     qkv = dot(x, p["weights"])
     if include_bias:
         qkv = qkv + p["bias"]
@@ -75,12 +95,17 @@ def _attn_decode(x, pos, kv, p, heads, include_bias, residual, dot):
     q = split(qkv[..., :d])
     k1 = split(qkv[..., d:2 * d])
     v1 = split(qkv[..., 2 * d:])
-    K = lax.dynamic_update_slice(K, k1, (0, 0, pos, 0))
-    V = lax.dynamic_update_slice(V, v1, (0, 0, pos, 0))
+    # per-row scatter at (b, :, pos[b], :) — vmap'd so every row of
+    # a joined batch lands at its own write position
+    upd = jax.vmap(
+        lambda buf, new, pb: lax.dynamic_update_slice(
+            buf, new, (0, pb, 0)))
+    K = upd(K, k1, pos)
+    V = upd(V, v1, pos)
     scale = numpy.float32(1.0 / numpy.sqrt(dh))
     scores = dot(q, K.transpose(0, 1, 3, 2))[:, :, 0, :] * scale
-    mask = jnp.arange(K.shape[2]) > pos
-    scores = jnp.where(mask[None, None, :], jnp.float32(-1e9), scores)
+    mask = jnp.arange(K.shape[2])[None, :] > pos[:, None]  # (B,max)
+    scores = jnp.where(mask[:, None, :], jnp.float32(-1e9), scores)
     probs = jnp.exp(scores - scores.max(-1, keepdims=True))
     probs = probs / probs.sum(-1, keepdims=True)
     ctx = dot(probs[:, :, None, :], V)             # (B,H,1,dh)
@@ -93,15 +118,18 @@ def _attn_decode(x, pos, kv, p, heads, include_bias, residual, dot):
     return y, (K, V)
 
 
-def _block_decode(x, pos, kv, lp, heads, eps, dot):
+def block_decode(x, pos, kv, lp, heads, eps, dot=None):
     """One decode step through a stacked transformer block (the
-    attention uses the cache; LN/FFN are the shared formulas)."""
+    attention uses the cache; LN/FFN are the shared formulas).
+    ``pos`` is the per-sequence position vector of
+    :func:`attn_decode`."""
     import jax.numpy as jnp
     from veles.znicz_tpu.ops import activations as A
     from veles.znicz_tpu.ops.layernorm import ln_fwd
     from veles.znicz_tpu.parallel.pipeline import ACT
 
-    a, kv = _attn_decode(
+    dot = dot or jnp.matmul
+    a, kv = attn_decode(
         x, pos, kv,
         {"weights": lp["weights"], "bias": lp["bias"],
          "weights_out": lp["weights_out"],
@@ -262,18 +290,20 @@ def _build_fns(workflow, steps, n_caches, maxlen, temperature,
         key, sub = jax.random.split(key)
         x = ptrees[0]["weights"][token][:, None, :]
         if positions is not None:
-            x = x + lax.dynamic_index_in_dim(
-                positions, pos, 0, keepdims=True)
+            # pos is a per-sequence vector (constant here, varying in
+            # the serving continuous batch): gather each row's own
+            # position embedding
+            x = x + positions[pos][:, None, :]
         kv = list(kv)
         for (kind, unit, slot), p in zip(steps[1:], ptrees[1:]):
             if kind == "attn":
-                x, kv[slot] = _attn_decode(
+                x, kv[slot] = attn_decode(
                     x, pos, kv[slot], p, unit.heads,
                     unit.include_bias, unit.residual, jnp.matmul)
             elif kind == "stack":
                 for l in range(unit.layers):
                     lp = {k2: p[k2][l] for k2 in unit.PARAMS}
-                    x, kv[slot + l] = _block_decode(
+                    x, kv[slot + l] = block_decode(
                         x, pos, kv[slot + l], lp, unit.heads,
                         unit.eps, jnp.matmul)
             else:
@@ -285,7 +315,9 @@ def _build_fns(workflow, steps, n_caches, maxlen, temperature,
         logits, kv = prefill(ptrees, ids)
         key, sub = jax.random.split(key)
         first = sample(logits, sub)
-        carry = (first, jnp.int32(ids.shape[1]), kv, key)
+        carry = (first,
+                 jnp.full((ids.shape[0],), ids.shape[1], jnp.int32),
+                 kv, key)
         if n_tokens > 1:
             _, rest = lax.scan(
                 lambda c, u: decode_step(ptrees, c, u), carry, None,
@@ -294,6 +326,28 @@ def _build_fns(workflow, steps, n_caches, maxlen, temperature,
         return first[:, None]
 
     return jax.jit(run)
+
+
+def _cache_key(sig, steps):
+    """Compiled-decoder cache key: the shape/sampling signature plus
+    a WEAKREF per step unit. ``id(u)`` keyed here once — but a freed
+    unit's reallocated id can alias a stale compiled decoder built
+    for different weights/architecture (the same hazard PerfLedger
+    fixed with weakrefs in veles/perf.py). Weakrefs compare by
+    referent identity while alive and never equal a new object after
+    death, and their hash is cached at insert time, so dead keys stay
+    safely hashable until evicted."""
+    return sig + (tuple(weakref.ref(u) for _, u, _ in steps),)
+
+
+def _evict_dead(cache):
+    """Drop cache entries holding a dead unit ref (the unit was
+    garbage-collected; its compiled decoder can never be hit again —
+    and must not linger while a reallocated id could have aliased
+    it)."""
+    for key in [k for k in cache
+                if any(r() is None for r in k[-1])]:
+        del cache[key]
 
 
 def generate(workflow, prompt_ids, n_tokens, temperature=0.0,
@@ -331,8 +385,10 @@ def generate(workflow, prompt_ids, n_tokens, temperature=0.0,
     # signature costs one XLA compile; callers with many prompt
     # lengths should pad to a few bucket sizes themselves
     cache = workflow.__dict__.setdefault("_generate_jit_cache", {})
-    sig = (b, p_len, n_tokens, float(temperature), top_k, top_p,
-           tuple(id(u) for _, u, _ in steps))
+    _evict_dead(cache)
+    sig = _cache_key(
+        (b, p_len, n_tokens, float(temperature), top_k, top_p),
+        steps)
     if sig not in cache:
         if len(cache) >= 16:
             cache.pop(next(iter(cache)))
