@@ -384,39 +384,43 @@ def _dist_wire_row(codec, n_slaves=1, max_epochs=2):
     server = MasterServer(master, "127.0.0.1:0",
                           max_epochs=max_epochs, grad_codec=codec)
     server.start_background()
-    address = "127.0.0.1:%d" % server.bound_address[1]
-    slaves = []
-    for i in range(n_slaves):
-        wf = _build_mnist("numpy", "BenchWireS%d%s-%d"
-                          % (n_slaves, codec, i), mb=50, n_train=500,
-                          n_valid=100, max_epochs=max_epochs)
-        wf.is_slave = True
-        slaves.append(wf)
-    ok = [0] * n_slaves
-    errors = []
+    try:
+        # guarded from the very first statement after the server is
+        # live: a slave-workflow build that raises here used to leak
+        # the master's serving thread, listener and workflow for the
+        # rest of the bench process (zlint resource-leak)
+        address = "127.0.0.1:%d" % server.bound_address[1]
+        slaves = []
+        for i in range(n_slaves):
+            wf = _build_mnist("numpy", "BenchWireS%d%s-%d"
+                              % (n_slaves, codec, i), mb=50,
+                              n_train=500, n_valid=100,
+                              max_epochs=max_epochs)
+            wf.is_slave = True
+            slaves.append(wf)
+        ok = [0] * n_slaves
+        errors = []
 
-    def pump(i):
-        try:
-            ok[i] = SlaveClient(
-                slaves[i], address, name="bench-%s-%d" % (codec, i),
-                grad_codec=codec).run_forever()
-        except Exception as exc:       # surfaced below: a dead-slave
-            errors.append(exc)         # row must be an _error entry,
+        def pump(i):
+            try:
+                ok[i] = SlaveClient(
+                    slaves[i], address,
+                    name="bench-%s-%d" % (codec, i),
+                    grad_codec=codec).run_forever()
+            except Exception as exc:   # surfaced below: a dead-slave
+                errors.append(exc)     # row must be an _error entry,
                                        # never a bogus data point
 
-    before = _wire_tx_bytes()
-    jobs_before = _slave_jobs_total()
-    threads = [threading.Thread(target=pump, args=(i,))
-               for i in range(n_slaves)]
-    t0 = time.perf_counter()
-    try:
+        before = _wire_tx_bytes()
+        jobs_before = _slave_jobs_total()
+        threads = [threading.Thread(target=pump, args=(i,))
+                   for i in range(n_slaves)]
+        t0 = time.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
             t.join()
     finally:
-        # a failed row must not leak the master's serving thread,
-        # listener and workflow for the rest of the bench process
         server.request_stop()
     wall = time.perf_counter() - t0
     moved = _wire_tx_bytes() - before
@@ -639,27 +643,33 @@ def serving_throughput_rps(duration=0.6, clients=8):
             wf.export_inference(tmp)
             registry = ModelRegistry(backend="numpy", max_batch=64,
                                      max_queue=4096, max_wait_ms=1.0)
-            entry = registry.load("mnist", tmp)
-            x = wf.loader.original_data.mem[:1].astype(numpy.float32)
-            entry.predict(x)                      # warm
-            stop = time.perf_counter() + duration
-            counts = [0] * clients
+            try:
+                # a failed warm/predict used to skip the close and
+                # leak the registry's batcher threads for the rest
+                # of the bench process (zlint resource-leak)
+                entry = registry.load("mnist", tmp)
+                x = wf.loader.original_data.mem[:1].astype(
+                    numpy.float32)
+                entry.predict(x)                  # warm
+                stop = time.perf_counter() + duration
+                counts = [0] * clients
 
-            def client(i):
-                while time.perf_counter() < stop:
-                    entry.predict(x, timeout_ms=10000)
-                    counts[i] += 1
+                def client(i):
+                    while time.perf_counter() < stop:
+                        entry.predict(x, timeout_ms=10000)
+                        counts[i] += 1
 
-            threads = [threading.Thread(target=client, args=(i,))
-                       for i in range(clients)]
-            t0 = time.perf_counter()
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            dt = time.perf_counter() - t0
-            fill = entry.batcher.metrics()["batch_fill_ratio"]
-            registry.close()
+                threads = [threading.Thread(target=client, args=(i,))
+                           for i in range(clients)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                dt = time.perf_counter() - t0
+                fill = entry.batcher.metrics()["batch_fill_ratio"]
+            finally:
+                registry.close()
         return sum(counts) / dt, fill
     finally:
         root.mnist.loader.update(saved)
@@ -772,6 +782,33 @@ def _generate_rows(extra):
         extra["generate_tokens_per_sec_error"] = str(exc)[:200]
 
 
+def lint_full_tree_seconds():
+    """Wall time of one full-tree zlint pass over the veles package —
+    the analyzer's own cost as a tracked trajectory (up = bad: the
+    key contains "seconds", which --self-check reads as
+    lower-is-better). The shared-engine refactor is held to < 2x the
+    pre-refactor wall time by this row."""
+    import veles
+    from veles.analysis import analyze_paths
+    pkg = os.path.dirname(os.path.abspath(veles.__file__))
+    t0 = time.perf_counter()
+    findings = analyze_paths([pkg], base=os.path.dirname(pkg))
+    dt = time.perf_counter() - t0
+    if findings:
+        raise RuntimeError(
+            "full-tree lint found %d violation(s) — the row would "
+            "time a dirty tree" % len(findings))
+    return dt
+
+
+def _lint_row(extra):
+    try:
+        extra["lint_full_tree_seconds"] = round(
+            lint_full_tree_seconds(), 3)
+    except Exception as exc:
+        extra["lint_full_tree_seconds_error"] = str(exc)[:200]
+
+
 def _record(extra, key, fn):
     """Run one bench row; primary key = median, ``_best`` = fastest
     chunk (see the module docstring's key convention)."""
@@ -814,9 +851,10 @@ def _device_reachable(timeout_s=240):
 # -- self-check: the bench trajectory as a first-class diff ------------
 
 #: keys where SMALLER is better (wire bytes, profiler overhead,
-#: first-token latency); everything else numeric in the report is a
-#: throughput/efficiency figure where bigger wins
-_LOWER_BETTER = ("bytes", "overhead", "latency")
+#: first-token latency, the analyzer's own wall time); everything
+#: else numeric in the report is a throughput/efficiency figure where
+#: bigger wins
+_LOWER_BETTER = ("bytes", "overhead", "latency", "seconds")
 
 #: keys that are environment stamps, not performance rows
 _SELF_CHECK_SKIP = ("calibration",)
@@ -960,6 +998,7 @@ def main(argv=None):
         _grad_codec_rows(extra)
         _dist_scaling_rows(extra)
         _profiler_row(extra)
+        _lint_row(extra)
         return emit({
             "metric": "mnist_train_steps_per_sec",
             "value": 0.0,
@@ -1013,6 +1052,8 @@ def main(argv=None):
     # sampling-profiler cost on the same MNIST loop (ISSUE 10; the
     # acceptance bound is < 3% at the default 97 Hz)
     _profiler_row(extra)
+    # the analyzer's own full-tree cost (ISSUE 12; up = bad)
+    _lint_row(extra)
     # attention-aware MFU for every at-scale LM row (VERDICT r4 #2):
     # median tok/s x train-FLOPs/token over the v5e bf16 peak, shapes
     # read from the SAME LM_ROWS entry the throughput row used

@@ -1026,6 +1026,426 @@ class S:
     assert lint_src(tmp_path, src, select=["profiler-safety"]) == []
 
 
+# -- wire-schema -------------------------------------------------------
+
+_WIRE_MISMATCH = """\
+def recv_frame(sock):
+    return sock
+
+
+class Master:
+    def handle(self, request):
+        if request[0] == "job":
+            return ("job", request, 1, 2, 3)
+        return ("ok",)
+
+
+def pump(sock):
+    resp = recv_frame(sock)
+    if resp[0] == "job":
+        _, payload, job_id, epoch = resp
+        return payload, job_id, epoch
+"""
+
+_WIRE_GUARDED = """\
+def recv_frame(sock):
+    return sock
+
+
+class Master:
+    def handle(self, request):
+        if request[0] == "job":
+            return ("job", request, 1, 2, 3)
+        return ("ok",)
+
+
+def pump(sock):
+    resp = recv_frame(sock)
+    if resp[0] != "job" or len(resp) < 4:
+        return None
+    _, payload, job_id, epoch = resp[:4]
+    trace = resp[4] if len(resp) > 4 else None
+    return payload, job_id, epoch, trace
+
+
+def pump_skew_tolerant(sock):
+    resp = recv_frame(sock)
+    if resp[0] == "job":
+        try:
+            _, payload, job_id = resp
+        except ValueError:
+            return None
+        return payload, job_id
+"""
+
+
+def test_wire_schema_arity_mismatch_fires(tmp_path):
+    """The seeded mismatch (ISSUE 12 satellite): producer ships a
+    5-tuple, consumer tuple-unpacks 4 without a slice guard."""
+    findings = lint_src(tmp_path, _WIRE_MISMATCH,
+                        select=["wire-schema"])
+    assert rule_ids(findings) == ["wire-schema"]
+    assert "5-tuple" in findings[0].message
+    assert "ValueError" in findings[0].message
+
+
+def test_wire_schema_index_past_producer_fires(tmp_path):
+    src = _WIRE_MISMATCH.replace(
+        "        _, payload, job_id, epoch = resp\n"
+        "        return payload, job_id, epoch",
+        "        return resp[5]")
+    findings = lint_src(tmp_path, src, select=["wire-schema"])
+    assert rule_ids(findings) == ["wire-schema"]
+    assert "element 5" in findings[0].message
+
+
+def test_wire_schema_quiet_on_guarded_consumers(tmp_path):
+    """Every mixed-version-safe spelling stays quiet: the early-exit
+    len guard + slice unpack, the conditional-expression len guard,
+    and the try/except ValueError skew handler."""
+    assert lint_src(tmp_path, _WIRE_GUARDED,
+                    select=["wire-schema"]) == []
+
+
+def test_wire_schema_directions_are_separate_namespaces(tmp_path):
+    # the request ("job", sid, lease) 3-tuple and the response
+    # ("job", payload, job_id, epoch, trace) 5-tuple share a kind;
+    # a response consumer must be judged against response producers
+    # only, or every protocol with symmetric kinds false-positives
+    src = """\
+def send_frame(sock, obj):
+    pass
+
+
+def recv_frame(sock):
+    return sock
+
+
+class Master:
+    def handle(self, request):
+        if request[0] == "job":
+            return ("job", request, 1, 2, 3)
+        return ("ok",)
+
+
+def pump(sock):
+    send_frame(sock, ("job", 7, "lease"))
+    resp = recv_frame(sock)
+    if resp[0] != "job" or len(resp) < 4:
+        return None
+    _, payload, job_id, epoch = resp[:4]
+    return payload, job_id, epoch
+"""
+    assert lint_src(tmp_path, src, select=["wire-schema"]) == []
+
+
+def test_wire_schema_no_producer_is_quiet(tmp_path):
+    # a kind the analyzer never sees produced (an external peer)
+    # cannot be judged — arbitrary [0] == "str" code must not fire
+    src = """\
+def route(argv):
+    if argv[0] == "serve":
+        return argv[1]
+"""
+    assert lint_src(tmp_path, src, select=["wire-schema"]) == []
+
+
+def test_wire_schema_floor_guard_excludes_short_producers(tmp_path):
+    # mixed-version producers (2-tuple and 4-tuple welcome): the
+    # canonical `len(resp) < 4: return` guard makes the short
+    # variant unreachable at the unpack, so the exact unpack of 4
+    # must be judged against the 4-tuple producer only
+    src = """\
+def recv_frame(sock):
+    return sock
+
+
+class Master:
+    def handle(self, request):
+        if len(request) < 3:
+            return ("welcome", 1)
+        return ("welcome", 1, 2, 3)
+
+
+def connect(sock):
+    resp = recv_frame(sock)
+    if resp[0] != "welcome" or len(resp) < 4:
+        return None
+    _, a, b, c = resp
+    return a, b, c
+"""
+    assert lint_src(tmp_path, src, select=["wire-schema"]) == []
+
+
+def test_wire_schema_pragma_suppresses(tmp_path):
+    src = _WIRE_MISMATCH.replace(
+        "        _, payload, job_id, epoch = resp",
+        "        _, payload, job_id, epoch = resp  "
+        "# zlint: disable=wire-schema (peer ships 4)")
+    assert lint_src(tmp_path, src, select=["wire-schema"]) == []
+
+
+# -- resource-leak -----------------------------------------------------
+
+_LEAK_ON_EXC = """\
+import socket
+
+
+def build():
+    return 1
+
+
+def fetch(addr):
+    sock = socket.create_connection(addr)
+    meta = build()
+    sock.close()
+    return meta
+"""
+
+_LEAK_SAFE = """\
+import socket
+
+
+def build():
+    return 1
+
+
+def fetch(addr):
+    sock = socket.create_connection(addr)
+    try:
+        meta = build()
+    finally:
+        sock.close()
+    return meta
+
+
+def fetch_handler(addr):
+    sock = socket.create_connection(addr)
+    try:
+        meta = build()
+    except OSError:
+        sock.close()
+        raise
+    sock.close()
+    return meta
+
+
+def stored(self, addr):
+    sock = socket.create_connection(addr)
+    self.sock = sock
+    return self
+
+
+def handed_off(addr, conns):
+    sock = socket.create_connection(addr)
+    conns.append(sock)
+"""
+
+
+def test_resource_leak_on_exception_path_fires(tmp_path):
+    """The leak-on-exception fixture (ISSUE 12 satellite): the bench
+    MasterServer class of bug — a risky call between acquire and
+    release with no try/finally."""
+    findings = lint_src(tmp_path, _LEAK_ON_EXC,
+                        select=["resource-leak"])
+    assert rule_ids(findings) == ["resource-leak"]
+    assert "build()" in findings[0].message
+    assert findings[0].line == 9          # anchored at the acquire
+
+
+def test_resource_leak_never_released_fires(tmp_path):
+    src = """\
+import socket
+
+
+def probe(addr):
+    sock = socket.create_connection(addr)
+    return sock.getpeername()[0]
+"""
+    findings = lint_src(tmp_path, src, select=["resource-leak"])
+    assert rule_ids(findings) == ["resource-leak"]
+    assert "never released" in findings[0].message
+
+
+def test_resource_leak_discarded_grant_fires(tmp_path):
+    src = """\
+def admit(pool):
+    pool.grant()
+"""
+    findings = lint_src(tmp_path, src, select=["resource-leak"])
+    assert rule_ids(findings) == ["resource-leak"]
+    assert "discarded" in findings[0].message
+
+
+def test_resource_leak_quiet_on_safe_shapes(tmp_path):
+    """try/finally, except-release-reraise, attribute store and
+    container hand-off all own the resource correctly."""
+    assert lint_src(tmp_path, _LEAK_SAFE,
+                    select=["resource-leak"]) == []
+
+
+def test_resource_leak_quiet_on_with_and_slot_store(tmp_path):
+    src = """\
+def read(path, pool, active, req):
+    with open(path) as f:
+        data = f.read()
+    req.slot = pool.grant()
+    active[req.slot] = req
+    return data
+"""
+    assert lint_src(tmp_path, src, select=["resource-leak"]) == []
+
+
+def test_resource_leak_sibling_branch_is_not_a_path(tmp_path):
+    # the else-arm of the acquiring if is mutually exclusive with
+    # the acquisition — its calls are not on any path where the
+    # resource is live
+    src = """\
+import socket
+
+
+def make_other():
+    return None
+
+
+def connect(addr, fast):
+    if fast:
+        sock = socket.create_connection(addr)
+    else:
+        sock = make_other()
+    try:
+        data = sock.recv(1)
+    finally:
+        sock.close()
+    return data
+"""
+    assert lint_src(tmp_path, src, select=["resource-leak"]) == []
+
+
+def test_resource_leak_pragma_suppresses(tmp_path):
+    src = _LEAK_ON_EXC.replace(
+        "    sock = socket.create_connection(addr)",
+        "    sock = socket.create_connection(addr)  "
+        "# zlint: disable=resource-leak (test rig)")
+    assert lint_src(tmp_path, src, select=["resource-leak"]) == []
+
+
+# -- loop-exception-safety ---------------------------------------------
+
+_LOOP_RAISE = """\
+class Session:
+    def on_frame(self, obj):
+        self.dispatch(obj)
+
+    def dispatch(self, obj):
+        if not obj:
+            raise ValueError("empty frame")
+        return obj
+"""
+
+_LOOP_SAFE = """\
+class Session:
+    def on_frame(self, obj):
+        try:
+            self.dispatch(obj)
+        except (ValueError, KeyError):
+            self.reply_error()
+
+    def dispatch(self, obj):
+        if not obj:
+            raise ValueError("empty frame")
+        return obj
+
+    def reply_error(self):
+        pass
+
+
+class Stub:
+    def on_frame(self, obj):
+        raise NotImplementedError
+
+
+class Fenced(ConnectionError):
+    pass
+
+
+class Plane:
+    def __init__(self, loop):
+        loop.every(1.0, self._tick)
+
+    def _tick(self):
+        try:
+            self.sync()
+        except OSError:
+            pass
+
+    def sync(self):
+        raise Fenced("lease revoked")
+"""
+
+
+def test_loop_exception_uncaught_chain_fires(tmp_path):
+    findings = lint_src(tmp_path, _LOOP_RAISE,
+                        select=["loop-exception-safety"])
+    assert rule_ids(findings) == ["loop-exception-safety"]
+    assert "ValueError" in findings[0].message
+    assert "Session.on_frame -> Session.dispatch" \
+        in findings[0].message
+
+
+def test_loop_exception_scheduled_target_fires(tmp_path):
+    src = """\
+class Plane:
+    def __init__(self, loop):
+        loop.every(1.0, self._tick)
+
+    def _tick(self):
+        raise RuntimeError("wedged")
+"""
+    findings = lint_src(tmp_path, src,
+                        select=["loop-exception-safety"])
+    assert rule_ids(findings) == ["loop-exception-safety"]
+    assert "RuntimeError" in findings[0].message
+
+
+def test_loop_exception_quiet_on_caught_chains(tmp_path):
+    """A try anywhere on the chain covers the raise — including
+    through the exception HIERARCHY (a ConnectionError subclass is
+    caught by except OSError) — and NotImplementedError stubs are
+    the abstract-method convention, not a loop hazard."""
+    assert lint_src(tmp_path, _LOOP_SAFE,
+                    select=["loop-exception-safety"]) == []
+
+
+def test_loop_exception_handler_body_is_outside_its_try(tmp_path):
+    # a raise INSIDE the except handler is not protected by the
+    # handler's own try — the classic error-path-raises bug
+    src = """\
+class Session:
+    def on_frame(self, obj):
+        try:
+            self.dispatch(obj)
+        except ValueError:
+            raise RuntimeError("bad frame")
+
+    def dispatch(self, obj):
+        return obj
+"""
+    findings = lint_src(tmp_path, src,
+                        select=["loop-exception-safety"])
+    assert rule_ids(findings) == ["loop-exception-safety"]
+    assert "RuntimeError" in findings[0].message
+
+
+def test_loop_exception_pragma_suppresses(tmp_path):
+    src = _LOOP_RAISE.replace(
+        '            raise ValueError("empty frame")',
+        '            raise ValueError("empty frame")  '
+        '# zlint: disable=loop-exception-safety (severing intended)')
+    assert lint_src(tmp_path, src,
+                    select=["loop-exception-safety"]) == []
+
+
 # -- hygiene: bare-except / unused-import / unused-variable ------------
 
 
@@ -1179,9 +1599,137 @@ def test_cli_list_rules_names_every_registered_rule(capsys):
     for rule_id in ("tracer-purity", "lock-order",
                     "unguarded-shared-state", "checkpoint-state",
                     "telemetry-hygiene", "thread-lifecycle",
+                    "wire-schema", "resource-leak",
+                    "loop-exception-safety",
                     "bare-except", "unused-import", "unused-variable"):
         assert rule_id in out
         assert rule_id in RULES
+
+
+def test_cli_sarif_shape_and_stability(tmp_path, capsys):
+    """--format sarif: a valid SARIF 2.1.0 skeleton (ruleId, level,
+    artifactLocation/region anchors, the rule table), byte-stable
+    across runs, exit-code contract unchanged."""
+    p = tmp_path / "m.py"
+    p.write_text("try:\n    pass\nexcept:\n    pass\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = lint_main(["--format", "sarif", str(p)])
+        first = capsys.readouterr().out
+        rc2 = lint_main(["--format", "sarif", str(p)])
+        second = capsys.readouterr().out
+    finally:
+        os.chdir(cwd)
+    assert rc == 1 and rc2 == 1
+    assert first == second, "SARIF must be byte-stable"
+    doc = json.loads(first)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "zlint"
+    assert [r["id"] for r in run["tool"]["driver"]["rules"]] \
+        == ["bare-except"]
+    result = run["results"][0]
+    assert result["ruleId"] == "bare-except"
+    assert result["level"] == "error"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "m.py"
+    assert loc["region"]["startLine"] == 3
+    assert "hint:" in result["message"]["text"]
+    # clean tree: rc 0, empty results, still valid SARIF
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    assert lint_main(["--format", "sarif", str(clean)]) == 0
+    empty = json.loads(capsys.readouterr().out)
+    assert empty["runs"][0]["results"] == []
+
+
+def test_cli_json_flag_is_format_alias(tmp_path, capsys):
+    p = tmp_path / "m.py"
+    p.write_text("try:\n    pass\nexcept:\n    pass\n")
+    assert lint_main(["--json", str(p)]) == 1
+    legacy = capsys.readouterr().out
+    assert lint_main(["--format", "json", str(p)]) == 1
+    assert capsys.readouterr().out == legacy
+
+
+def _git(tmp_path, *argv):
+    import subprocess
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+        + list(argv), cwd=tmp_path, check=True, capture_output=True)
+
+
+def test_cli_changed_only_lints_only_changed_files(tmp_path, capsys):
+    """--changed-only: the committed-but-unchanged violation is
+    skipped, the modified and the untracked files are linted; exit
+    codes keep the 0/1 contract."""
+    _git(tmp_path, "init", "-q")
+    a = tmp_path / "a.py"
+    a.write_text("try:\n    pass\nexcept:\n    pass\n")
+    b = tmp_path / "b.py"
+    b.write_text("X = 1\n")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    b.write_text("try:\n    pass\nexcept:\n    pass\n")
+    c = tmp_path / "c.py"                 # untracked
+    c.write_text("try:\n    pass\nexcept:\n    pass\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = lint_main([str(tmp_path), "--changed-only",
+                        "--select", "bare-except"])
+        out = capsys.readouterr().out
+        # with nothing changed vs HEAD the changed set is empty:
+        # clean exit, zero findings
+        _git(tmp_path, "add", ".")
+        _git(tmp_path, "commit", "-qm", "all dirty now clean")
+        rc_clean = lint_main([str(tmp_path), "--changed-only",
+                              "--select", "bare-except"])
+        out_clean = capsys.readouterr().out
+    finally:
+        os.chdir(cwd)
+    assert rc == 1
+    assert "b.py" in out and "c.py" in out
+    assert "a.py" not in out
+    assert rc_clean == 0 and "0 finding(s)" in out_clean
+
+
+def test_cli_changed_only_bad_ref_is_usage_error(tmp_path, capsys):
+    # a typo'd ref must hit the documented exit-2 contract, never
+    # silently degrade to a full-tree run
+    _git(tmp_path, "init", "-q")
+    p = tmp_path / "a.py"
+    p.write_text("X = 1\n")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = lint_main([str(tmp_path), "--changed-only",
+                        "no-such-ref"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 2
+    assert "cannot resolve ref" in capsys.readouterr().err
+
+
+def test_cli_changed_only_falls_back_without_git(tmp_path, capsys):
+    # outside any repository the fast mode degrades to the full
+    # tree, loudly
+    p = tmp_path / "m.py"
+    p.write_text("try:\n    pass\nexcept:\n    pass\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = lint_main([str(p), "--changed-only",
+                        "--select", "bare-except"])
+    finally:
+        os.chdir(cwd)
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "full tree" in captured.err
+    assert "bare-except" in captured.out
 
 
 def test_cli_select_runs_only_selected(tmp_path, capsys):

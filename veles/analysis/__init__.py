@@ -43,11 +43,34 @@ rule id                   checks
                           ``start``/``stop``/``capture_profile`` are
                           banned inside reactor callbacks (a capture
                           blocks for its whole window)
+``wire-schema``           producers and consumers of one wire-frame
+                          (direction, kind) must agree on arity:
+                          unguarded tuple unpacks, ``resp[:N]``
+                          slices and ``V[i]`` reads are checked
+                          against every tuple the other side ships
+                          (mixed-version ``len()`` guards count as
+                          safe)
+``resource-leak``         acquired resources (sockets, registries,
+                          KV slot grants, ``start_background``
+                          servers) must be released on every path,
+                          exception edges included
+``loop-exception-safety``  call chains reachable from reactor
+                          callbacks must not raise exception types
+                          no frame on the chain catches
 ``thread-lifecycle``      threads must be daemons or have a join path
 ``bare-except``           ``except:`` swallows ``KeyboardInterrupt``
 ``unused-import``         dead module-level imports
 ``unused-variable``       locals assigned and never read
 ========================  =============================================
+
+All rules resolve calls through ONE shared whole-program engine
+(``veles/analysis/engine.py``): an interprocedural call graph over
+the parsed project (``self.method``, attribute type bindings,
+module-alias and symbol-import resolution) plus a generic
+forward-dataflow fixpoint (``ForwardDataflow``) and the shared
+reactor-callback enumeration. Writing a new rule against the graph
+is ~50 lines: resolve calls with ``CallGraph.resolve``, or subclass
+``ForwardDataflow`` when a fact must flow caller→callee.
 
 Findings carry file:line, rule id, severity and a one-line fix hint.
 A finding is suppressed by a pragma comment on its line::
@@ -55,9 +78,11 @@ A finding is suppressed by a pragma comment on its line::
     self.reached = True   # zlint: disable=checkpoint-state (per-run)
 
 ``# zlint: disable=all`` silences every rule on that line. Run it as
-``velescli lint [--json] [paths...]`` (exit 0 clean / 1 findings /
-2 usage error); the tier-1 gate ``tests/test_analysis.py`` keeps the
-whole ``veles/`` package at zero findings.
+``velescli lint [--format text|json|sarif] [--changed-only [REF]]
+[paths...]`` (exit 0 clean / 1 findings / 2 usage error); the tier-1
+gate ``tests/test_analysis.py`` keeps the whole ``veles/`` package at
+zero findings, and ``bench.py`` tracks the analyzer's own full-tree
+wall time as ``lint_full_tree_seconds``.
 """
 
 from veles.analysis.core import (          # noqa: F401  (public API)

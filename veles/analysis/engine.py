@@ -1,0 +1,671 @@
+"""The shared whole-program analysis engine under every rule pack.
+
+Before this module, each rule pack grew its own call resolution —
+``rules_threads`` carried ~130 lines of ``self.method``/module-alias/
+symbol-import resolution, ``rules_reactor`` its own callback-target
+resolver, ``rules_purity`` a third copy specialized to ops helpers —
+and none of them could see ACROSS functions in a principled way. This
+module factors all of that into one place:
+
+* **name helpers** — :func:`call_name`, :func:`attr_chain`,
+  :func:`receiver_name`, :func:`assigned_name`,
+  :func:`canonical_import_prefixes`: the small AST spellings every
+  rule needs;
+* **statement traversal** — :func:`iter_stmt_children` /
+  :func:`walk_statements`: child iteration that descends the
+  structural carriers (``ExceptHandler``, ``match_case``) whose
+  bodies are exactly where retry/error paths live, so no rule grows a
+  blind spot there again;
+* **:class:`CallGraph`** — interprocedural call resolution over a
+  :class:`~veles.analysis.core.Project`: direct calls,
+  ``self.method`` (hierarchy-merged), ``self.attr.method`` through
+  ``__init__`` type bindings, module-alias and symbol-import calls,
+  constructor calls, and module-level instance methods. One resolver,
+  one behavior, every rule;
+* **reactor-context enumeration** — :func:`reactor_callbacks` /
+  :func:`schedule_sites` / :func:`resolve_callable`: the shared
+  answer to "which functions run ON the loop" (``on_frame``/
+  ``on_timer`` methods plus ``call_soon``/``call_later``/``every``/
+  ``post`` targets), used by ``reactor-purity``,
+  ``profiler-safety`` and ``loop-exception-safety`` alike;
+* **:class:`ForwardDataflow`** — a generic forward fixpoint over the
+  call graph: facts seed at entry functions and flow caller→callee
+  through a rule-supplied transfer function until no new
+  (function, fact) state appears. ``loop-exception-safety`` runs on
+  it with caught-exception sets as the lattice;
+* **graph utilities** — :func:`tarjan_sccs` (the lock-order cycle
+  detector), exception-hierarchy queries (:func:`exception_covered`)
+  shared by the dataflow rules.
+
+Everything here is pure AST work over the already-parsed project —
+the engine never re-reads a file.
+"""
+
+import ast
+
+#: bound on interprocedural walk depth — cycles are caught by the
+#: per-walk visited sets, this only caps pathological chains
+MAX_DEPTH = 40
+
+# -- name helpers -------------------------------------------------------
+
+
+def call_name(node):
+    """The rightmost simple name a call invokes (``a.b.f()`` -> 'f',
+    ``f()`` -> 'f'), or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def attr_chain(expr):
+    """Dotted name of an attribute chain (``a.b.c`` -> 'a.b.c'), or
+    None when the chain does not root in a plain Name."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def receiver_name(node):
+    """The rightmost name of a call receiver: ``a.b.profiler`` ->
+    'profiler', ``profiler`` -> 'profiler', else ''."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return receiver_name(node.func)
+    return ""
+
+
+def target_key(t):
+    """A comparable key for an assignment target: ``x`` -> "x",
+    ``self.x`` -> "self.x", else None."""
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+        return "%s.%s" % (t.value.id, t.attr)
+    return None
+
+
+def assigned_name(mod, call):
+    """The Name/self-attribute a constructor call is assigned to, as
+    a comparable key ("x" or "self.x"), or None for a bare call."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            return target_key(node.targets[0])
+    return None
+
+
+def canonical_import_prefixes(mod):
+    """local name -> canonical dotted path, resolving every import
+    style (``import numpy as np``, ``from numpy import random``,
+    ``from time import monotonic``) so namespace bans cannot be
+    dodged by how a module was imported."""
+    out = {}
+    for local, target in mod.imports.items():
+        if target[0] == "module":
+            dotted = target[1]
+            if "." in dotted and local == dotted.split(".")[0]:
+                # plain ``import numpy.random`` binds the TOP package
+                # name; the attribute chain spells out the rest
+                dotted = local
+        else:
+            dotted = "%s.%s" % (target[1], target[2])
+        out[local] = dotted
+    return out
+
+
+# -- statement traversal ------------------------------------------------
+
+
+def iter_stmt_children(node):
+    """Yield ``("stmt", s)`` / ``("expr", e)`` for the children of a
+    statement, descending structural nodes that are neither stmt nor
+    expr but CARRY statements (``ExceptHandler``, ``match_case``) —
+    their bodies are exactly where retry/error paths live, so
+    skipping them silently weakens every rule built on this."""
+    for field in ast.iter_child_nodes(node):
+        if isinstance(field, ast.stmt):
+            yield "stmt", field
+        elif isinstance(field, ast.expr):
+            yield "expr", field
+        else:
+            for sub in ast.iter_child_nodes(field):
+                if isinstance(sub, ast.stmt):
+                    yield "stmt", sub
+                elif isinstance(sub, ast.expr):
+                    yield "expr", sub
+
+
+def walk_statements(func):
+    """Every statement in ``func``'s body, in source order, WITHOUT
+    descending into nested function/class definitions (they execute
+    later, not here)."""
+    out = []
+
+    def walk(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            out.append(stmt)
+            for kind, child in iter_stmt_children(stmt):
+                if kind == "stmt":
+                    walk([child])
+    walk(func.body)
+    return out
+
+
+def scoped_nodes(node):
+    """Every node under ``node`` that executes in ITS scope — nested
+    function/lambda/class subtrees are skipped (they run later,
+    elsewhere). The shared spelling of the walk a half-dozen rules
+    used to hand-roll."""
+    out = []
+
+    def walk(cur):
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda,
+                                  ast.ClassDef)):
+                continue
+            out.append(child)
+            walk(child)
+    walk(node)
+    return out
+
+
+def iter_calls(node):
+    """Call nodes at or under ``node`` that execute in its scope
+    (nested def/lambda bodies excluded — a deferred closure's calls
+    run on whatever thread runs IT, not here)."""
+    out = [node] if isinstance(node, ast.Call) else []
+    out.extend(n for n in scoped_nodes(node)
+               if isinstance(n, ast.Call))
+    return out
+
+
+def novel_calls(mod, func, seen, classify):
+    """Yield ``(call, label)`` for each call in ``func`` that
+    ``classify`` recognizes and that has not been reported yet —
+    the shared dedup shell of every scan-a-callback rule. ``seen``
+    is keyed (relpath, lineno, label) across contexts, so a method
+    that is both a conventional callback and a scheduled target is
+    reported once."""
+    for sub in ast.walk(func):
+        if not isinstance(sub, ast.Call):
+            continue
+        label = classify(sub)
+        if label is None:
+            continue
+        key = (mod.relpath, sub.lineno, label)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield sub, label
+
+
+def test_mentions(test, markers):
+    """True when an if-test contains a string constant carrying any
+    of ``markers`` — the branch-detection convention route rules key
+    on (``==``, ``startswith``, tuple membership: any spelling)."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Constant) \
+                and isinstance(sub.value, str) \
+                and any(m in sub.value for m in markers):
+            return True
+    return False
+
+
+def nested_functions(func):
+    """{name: FunctionDef} of the function/async defs nested anywhere
+    inside ``func`` (excluding ``func`` itself)."""
+    out = {}
+    for node in ast.walk(func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not func:
+            out[node.name] = node
+    return out
+
+
+# -- the interprocedural call graph -------------------------------------
+
+
+class Target:
+    """One resolved callee: where it lives and what to call it in a
+    diagnostic chain."""
+
+    __slots__ = ("module", "cls", "func", "label")
+
+    def __init__(self, module, cls, func, label):
+        self.module = module    # Module the definition lives in
+        self.cls = cls          # ClassInfo or None
+        self.func = func        # FunctionDef / AsyncFunctionDef
+        self.label = label      # "Class.meth" / "alias.func" / name
+
+
+class CallGraph:
+    """Interprocedural call resolution over a Project.
+
+    One resolver for every rule pack: ``self.method(...)`` through
+    the hierarchy-merged method table, ``self.attr.method(...)``
+    through ``__init__`` type bindings (base-class bindings
+    included), module-alias calls (``telemetry.counter(...)``),
+    symbol imports (``from x import f``; also the ``from veles
+    import telemetry`` module-through-symbol form), module-level
+    functions, constructor calls (resolved to ``__init__``) and
+    methods on module-level typed instances. Unresolvable calls
+    return None — every rule on this graph is conservative by
+    construction."""
+
+    def __init__(self, project):
+        self.project = project
+
+    def _module_for(self, dotted):
+        return self.project.module_by_dotted(dotted)
+
+    def resolve(self, ctx_mod, ctx_cls, call):
+        """-> :class:`Target` or None for one ``ast.Call``."""
+        fn = call.func
+        # self.method(...)
+        if isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name):
+            base = fn.value.id
+            if base == "self" and ctx_cls is not None:
+                cls, meth = self.project.find_method(ctx_cls, fn.attr)
+                if meth is not None:
+                    return Target(cls.module, cls, meth,
+                                  "%s.%s" % (cls.name, fn.attr))
+                return None
+            # module_alias.func(...) / global_instance.method(...)
+            target = ctx_mod.imports.get(base)
+            if target and target[0] == "symbol":
+                # ``from veles import telemetry`` imports a MODULE
+                # through the symbol form — resolve it as one
+                if self._module_for("%s.%s" % (target[1], target[2])):
+                    target = ("module",
+                              "%s.%s" % (target[1], target[2]))
+            if target and target[0] == "module":
+                mod = self._module_for(target[1])
+                if mod and fn.attr in mod.functions:
+                    return Target(mod, None, mod.functions[fn.attr],
+                                  "%s.%s" % (base, fn.attr))
+                if mod and fn.attr in mod.classes:
+                    cls = mod.classes[fn.attr]
+                    ini = cls.methods.get("__init__")
+                    if ini is not None:
+                        return Target(mod, cls, ini,
+                                      "%s.__init__" % fn.attr)
+                return None
+            tname = ctx_mod.global_types.get(base)
+            if tname:
+                for cls in self.project.class_index.get(tname, ()):
+                    meth = cls.methods.get(fn.attr)
+                    if meth is not None:
+                        return Target(cls.module, cls, meth,
+                                      "%s.%s" % (tname, fn.attr))
+            return None
+        # self.attr.method(...) via __init__ type binding (the attr
+        # may be bound by a BASE class's __init__ — merge hierarchy)
+        if isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Attribute) \
+                and isinstance(fn.value.value, ast.Name) \
+                and fn.value.value.id == "self" and ctx_cls is not None:
+            tname = self.project.class_attr_types(ctx_cls) \
+                .get(fn.value.attr)
+            if tname:
+                for cls in self.project.class_index.get(tname, ()):
+                    meth = cls.methods.get(fn.attr)
+                    if meth is not None:
+                        return Target(cls.module, cls, meth,
+                                      "%s.%s" % (tname, fn.attr))
+            return None
+        if isinstance(fn, ast.Name):
+            name = fn.id
+            if name in ctx_mod.functions:
+                return Target(ctx_mod, None, ctx_mod.functions[name],
+                              name)
+            if name in ctx_mod.classes:
+                cls = ctx_mod.classes[name]
+                ini = cls.methods.get("__init__")
+                if ini is not None:
+                    return Target(ctx_mod, cls, ini,
+                                  "%s.__init__" % name)
+            target = ctx_mod.imports.get(name)
+            if target and target[0] == "symbol":
+                mod = self._module_for(target[1])
+                if mod:
+                    if target[2] in mod.functions:
+                        return Target(mod, None,
+                                      mod.functions[target[2]], name)
+                    if target[2] in mod.classes:
+                        cls = mod.classes[target[2]]
+                        ini = cls.methods.get("__init__")
+                        if ini is not None:
+                            return Target(mod, cls, ini,
+                                          "%s.__init__" % name)
+        return None
+
+    def iter_functions(self):
+        """Every (module, cls_or_None, funcdef, label) definition in
+        the project — the node set of the graph."""
+        for mod in self.project.modules:
+            for func in mod.functions.values():
+                yield mod, None, func, func.name
+            for cls in mod.classes.values():
+                for mname, meth in cls.methods.items():
+                    yield mod, cls, meth, "%s.%s" % (cls.name, mname)
+
+
+# -- reactor-context enumeration ----------------------------------------
+
+#: reactor scheduling API: the (position of the) callback argument
+SCHEDULE_CALLS = {"call_soon": 0, "call_later": 1, "every": 1,
+                  "post": 0}
+
+#: conventional reactor callback method names. on_readable/on_writable
+#: are excluded on purpose — they ARE the I/O layer (the one place
+#: recv/send on the non-blocking socket is the job).
+CALLBACK_METHODS = frozenset(("on_frame", "on_timer"))
+
+
+def schedule_sites(mod):
+    """[(call, enclosing ClassDef or None, enclosing function
+    stack)] for every ``call_soon``/``call_later``/``every``/``post``
+    call in the module, with scope tracked during the descent."""
+    out = []
+
+    def walk(node, cls_node, func_stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child, func_stack)
+                continue
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                walk(child, cls_node, func_stack + [child])
+                continue
+            if isinstance(child, ast.Call) \
+                    and call_name(child) in SCHEDULE_CALLS:
+                out.append((child, cls_node, list(func_stack)))
+            walk(child, cls_node, func_stack)
+
+    walk(mod.tree, None, [])
+    return out
+
+
+def resolve_callable(cb, mod, cls_node, func_stack):
+    """The FunctionDef/Lambda a callback REFERENCE names, resolved
+    conservatively: a lambda inline, a Name through the enclosing
+    function scopes then module functions, or a ``self.method`` on
+    the enclosing class; -> (func, description) or (None, None)."""
+    if isinstance(cb, ast.Lambda):
+        return cb, "<lambda>"
+    if isinstance(cb, ast.Name):
+        for enclosing in reversed(func_stack):
+            for sub in ast.walk(enclosing):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub.name == cb.id:
+                    return sub, cb.id
+        fn = mod.functions.get(cb.id)
+        if fn is not None:
+            return fn, cb.id
+        return None, None
+    if isinstance(cb, ast.Attribute) \
+            and isinstance(cb.value, ast.Name) \
+            and cb.value.id == "self" and cls_node is not None:
+        info = mod.classes.get(cls_node.name)
+        if info is not None and cb.attr in info.methods:
+            return (info.methods[cb.attr],
+                    "%s.%s" % (cls_node.name, cb.attr))
+    return None, None
+
+
+def reactor_callbacks(project):
+    """Every function that runs ON the reactor loop, with its class
+    context: ``on_frame``/``on_timer`` methods and the resolvable
+    targets of ``call_soon``/``call_later``/``every``/``post`` calls;
+    -> [(mod, cls_node_or_None, func, where-description)]. The same
+    function may appear more than once (a method that is also
+    scheduled) — consumers dedupe findings, not contexts."""
+    cached = getattr(project, "_reactor_callbacks_cache", None)
+    if cached is not None:
+        return cached
+    out = []
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and item.name in CALLBACK_METHODS:
+                    out.append((mod, node, item,
+                                "%s.%s" % (node.name, item.name)))
+        for call, cls_node, func_stack in schedule_sites(mod):
+            pos = SCHEDULE_CALLS[call_name(call)]
+            if len(call.args) <= pos:
+                continue
+            target, desc = resolve_callable(
+                call.args[pos], mod, cls_node, func_stack)
+            if target is not None:
+                out.append((mod, cls_node, target,
+                            "%s (scheduled at line %d)"
+                            % (desc, call.lineno)))
+    # memoized per Project: three rule packs enumerate the same
+    # loop contexts, and the project is immutable once built
+    project._reactor_callbacks_cache = out
+    return out
+
+
+# -- exception hierarchy ------------------------------------------------
+
+#: builtin exception -> direct base (enough of the stdlib tree for
+#: coverage queries; anything unknown is assumed rooted at Exception)
+_BUILTIN_BASES = {
+    "ConnectionError": "OSError",
+    "ConnectionResetError": "ConnectionError",
+    "ConnectionAbortedError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "BrokenPipeError": "ConnectionError",
+    "TimeoutError": "OSError",
+    "InterruptedError": "OSError",
+    "BlockingIOError": "OSError",
+    "FileNotFoundError": "OSError",
+    "FileExistsError": "OSError",
+    "PermissionError": "OSError",
+    "IsADirectoryError": "OSError",
+    "NotADirectoryError": "OSError",
+    "ChildProcessError": "OSError",
+    "ProcessLookupError": "OSError",
+    "IOError": "OSError",
+    "KeyError": "LookupError",
+    "IndexError": "LookupError",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "FloatingPointError": "ArithmeticError",
+    "UnicodeDecodeError": "UnicodeError",
+    "UnicodeEncodeError": "UnicodeError",
+    "UnicodeError": "ValueError",
+    "NotImplementedError": "RuntimeError",
+    "RecursionError": "RuntimeError",
+    "IndentationError": "SyntaxError",
+    "ModuleNotFoundError": "ImportError",
+    "OSError": "Exception",
+    "LookupError": "Exception",
+    "ArithmeticError": "Exception",
+    "ValueError": "Exception",
+    "RuntimeError": "Exception",
+    "SyntaxError": "Exception",
+    "ImportError": "Exception",
+    "TypeError": "Exception",
+    "AttributeError": "Exception",
+    "NameError": "Exception",
+    "StopIteration": "Exception",
+    "AssertionError": "Exception",
+    "MemoryError": "Exception",
+    "EOFError": "Exception",
+    "BufferError": "Exception",
+    "ReferenceError": "Exception",
+    "Exception": "BaseException",
+    "KeyboardInterrupt": "BaseException",
+    "SystemExit": "BaseException",
+    "GeneratorExit": "BaseException",
+}
+
+
+def exception_ancestors(name, project):
+    """The simple-name ancestor set of exception type ``name``
+    (itself included): project classes walk their ``bases`` into the
+    builtin table; unknown names conservatively root at Exception."""
+    out = set()
+    stack = [name]
+    while stack:
+        cur = stack.pop()
+        if cur in out:
+            continue
+        out.add(cur)
+        infos = project.class_index.get(cur, ())
+        if infos:
+            for info in infos:
+                stack.extend(info.bases)
+        elif cur in _BUILTIN_BASES:
+            stack.append(_BUILTIN_BASES[cur])
+        elif cur not in ("BaseException",):
+            stack.append("Exception")
+    return out
+
+
+def exception_covered(raised, caught_names, project):
+    """True when an exception of simple-name type ``raised`` is
+    caught by a handler naming any of ``caught_names`` ("" = a bare
+    ``except:``)."""
+    if "" in caught_names or "BaseException" in caught_names:
+        return True
+    return bool(exception_ancestors(raised, project) & caught_names)
+
+
+def handler_names(handler):
+    """The simple type names one ``except`` clause catches ("" for a
+    bare ``except:``; tuples are flattened)."""
+    t = handler.type
+    if t is None:
+        return {""}
+    out = set()
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        if isinstance(e, ast.Attribute):
+            out.add(e.attr)
+        elif isinstance(e, ast.Name):
+            out.add(e.id)
+    return out
+
+
+# -- generic forward dataflow -------------------------------------------
+
+
+class ForwardDataflow:
+    """Generic forward-dataflow fixpoint over the call graph.
+
+    Facts attach to (function, fact) states and flow caller→callee: a
+    rule seeds entry states (:meth:`entries`), and for each state the
+    rule's :meth:`transfer` walks the function body — recording any
+    findings it likes — and yields ``(call_node, fact)`` pairs for
+    the calls it wants followed. The driver resolves each call
+    through the shared :class:`CallGraph` and enqueues the callee
+    with the transferred fact; a (function, fact) pair is processed
+    at most once, so the iteration reaches a fixpoint whenever facts
+    are drawn from a finite lattice (frozensets of names, small
+    tuples). Each state carries the diagnostic ``chain`` of labels
+    that reached it.
+
+    Subclass hooks:
+
+    * ``entries()`` -> iterable of (mod, cls, func, fact, label)
+    * ``transfer(mod, cls, func, fact, chain)`` -> iterable of
+      (call_node, fact_for_callee)
+    """
+
+    def __init__(self, project):
+        self.project = project
+        self.graph = CallGraph(project)
+
+    def entries(self):
+        raise NotImplementedError
+
+    def transfer(self, mod, cls, func, fact, chain):
+        raise NotImplementedError
+
+    def run(self):
+        seen = set()
+        work = []
+        for mod, cls, func, fact, label in self.entries():
+            key = (id(func), fact)
+            if key not in seen:
+                seen.add(key)
+                work.append((mod, cls, func, fact, (label,)))
+        while work:
+            mod, cls, func, fact, chain = work.pop()
+            if len(chain) > MAX_DEPTH:
+                continue
+            for call, out_fact in self.transfer(mod, cls, func, fact,
+                                                chain):
+                target = self.graph.resolve(mod, cls, call)
+                if target is None:
+                    continue
+                key = (id(target.func), out_fact)
+                if key in seen:
+                    continue
+                seen.add(key)
+                work.append((target.module, target.cls, target.func,
+                             out_fact, chain + (target.label,)))
+
+
+# -- graph utilities ----------------------------------------------------
+
+
+def tarjan_sccs(edges):
+    """Strongly connected components with more than one node, over an
+    edge set/dict keyed ``(a, b)`` — the minimal cycle witness the
+    lock-order rule reports."""
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index, low, on, stack = {}, {}, set(), []
+    sccs, counter = [], [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in graph[v]:
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+    for v in list(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs

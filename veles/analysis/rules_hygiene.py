@@ -23,6 +23,7 @@ import ast
 import os
 import re
 
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
 
 _NOQA_RE = re.compile(r"#\s*noqa\b", re.IGNORECASE)
@@ -66,14 +67,8 @@ def check_unused_variable(project):
                    for n in ast.walk(func)):
                 continue
             assigns = {}           # name -> first-assign lineno
-            stack = list(ast.iter_child_nodes(func))
-            while stack:
-                node = stack.pop()
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef, ast.Lambda,
-                                     ast.ClassDef)):
-                    continue       # nested scopes scanned on their own
-                stack.extend(ast.iter_child_nodes(node))
+            # nested scopes are scanned on their own (shared walk)
+            for node in engine.scoped_nodes(func):
                 if not isinstance(node, ast.Assign):
                     continue
                 for t in node.targets:

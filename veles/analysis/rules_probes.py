@@ -25,6 +25,7 @@ flags blocking work inside them:
 
 import ast
 
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
 
 _PROBE_MARKERS = ("/healthz", "/readyz")
@@ -36,27 +37,6 @@ _BLOCKING_CALLS = frozenset((
     "getaddrinfo", "recv", "recv_into", "makefile", "open",
     "status", "snapshot", "metrics", "describe",
 ))
-
-
-def _mentions_probe_path(test):
-    """True when the branch test contains a probe-path string
-    constant (``self.path == "/healthz"``, a ``startswith`` tuple
-    including it, ...)."""
-    for node in ast.walk(test):
-        if isinstance(node, ast.Constant) \
-                and isinstance(node.value, str) \
-                and any(m in node.value for m in _PROBE_MARKERS):
-            return True
-    return False
-
-
-def _call_name(node):
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return None
 
 
 def _scan_branch(mod, body, findings):
@@ -73,7 +53,7 @@ def _scan_branch(mod, body, findings):
                     "(HealthMonitor.probe reads one attribute); do "
                     "the real work on the monitor's sampler thread"))
             elif isinstance(node, ast.Call):
-                name = _call_name(node)
+                name = engine.call_name(node)
                 if name in _BLOCKING_CALLS:
                     findings.append(Finding(
                         mod.relpath, node.lineno, "probe-purity",
@@ -96,6 +76,7 @@ def check_probe_purity(project):
     for mod in project.modules:
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.If) \
-                    and _mentions_probe_path(node.test):
+                    and engine.test_mentions(node.test,
+                                             _PROBE_MARKERS):
                 _scan_branch(mod, node.body, findings)
     return findings

@@ -18,17 +18,15 @@ checks the two places that could make that mistake:
   receiver). Calls inside a nested ``def``/``lambda`` are exempt:
   that is the deferred body itself.
 * **reactor callbacks**: the same capture primitives are banned
-  inside ``on_frame``/``on_timer`` methods and
-  ``call_soon``/``call_later``/``every`` targets, reusing the
-  ``reactor-purity`` rule's target resolution.
+  inside the shared :func:`veles.analysis.engine.reactor_callbacks`
+  contexts (``on_frame``/``on_timer`` methods,
+  ``call_soon``/``call_later``/``every``/``post`` targets).
 """
 
 import ast
 
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
-from veles.analysis.rules_reactor import (
-    _CALLBACK_METHODS, _SCHEDULE_CALLS, _call_name, _resolve_target,
-    _walk_scopes)
 
 #: module-level capture primitives (veles/profiling.py public API)
 _CAPTURE_CALLS = frozenset(("capture_profile", "profile_endpoint"))
@@ -43,68 +41,33 @@ _PROFILER_METHODS = frozenset(("start", "stop", "capture"))
 _ROUTE_MARK = "/debug" + "/profile"
 
 
-def _receiver_name(node):
-    """The rightmost name of a call receiver: ``a.b.profiler`` ->
-    'profiler', ``profiler`` -> 'profiler', else ''."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Call):
-        return _receiver_name(node.func)
-    return ""
-
-
 def _capture_call(node):
     """The capture primitive ``node`` invokes, or None."""
-    name = _call_name(node)
+    name = engine.call_name(node)
     if name in _CAPTURE_CALLS:
         return name
     if isinstance(node.func, ast.Attribute) \
             and node.func.attr in _PROFILER_METHODS \
-            and "profil" in _receiver_name(
+            and "profil" in engine.receiver_name(
                 node.func.value).lower():
-        return "%s.%s" % (_receiver_name(node.func.value),
+        return "%s.%s" % (engine.receiver_name(node.func.value),
                           node.func.attr)
     return None
 
 
-def _tests_profile_route(test):
-    """True when an if-test mentions the "/debug/profile" constant
-    (``==``, ``startswith``, tuple membership — any spelling)."""
-    for sub in ast.walk(test):
-        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
-                and _ROUTE_MARK in sub.value:
-            return True
-    return False
-
-
-def _walk_branch(nodes, on_call):
-    """Walk statement bodies without descending into nested function
-    or lambda definitions (a deferred closure's body runs on a worker
-    thread — the compliant escape, not a violation)."""
-    for node in nodes:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue
-        if isinstance(node, ast.Call):
-            on_call(node)
-        _walk_branch(list(ast.iter_child_nodes(node)), on_call)
-
-
 def _scan_route_branch(mod, test, body, findings):
+    """Calls inside nested def/lambda bodies are exempt via the
+    shared scoped walk: a deferred closure's body runs on a worker
+    thread — the compliant escape, not a violation."""
     has_defer = []
     captures = []
-
-    def on_call(call):
-        name = _call_name(call)
-        if name == "defer":
-            has_defer.append(call)
-        cap = _capture_call(call)
-        if cap is not None:
-            captures.append((call, cap))
-
-    _walk_branch(body, on_call)
+    for stmt in body:
+        for call in engine.iter_calls(stmt):
+            if engine.call_name(call) == "defer":
+                has_defer.append(call)
+            cap = _capture_call(call)
+            if cap is not None:
+                captures.append((call, cap))
     for call, cap in captures:
         findings.append(Finding(
             mod.relpath, call.lineno, "profiler-safety", "error",
@@ -124,16 +87,8 @@ def _scan_route_branch(mod, test, body, findings):
 
 
 def _scan_callback(mod, node, where, findings, seen):
-    for sub in ast.walk(node):
-        if not isinstance(sub, ast.Call):
-            continue
-        cap = _capture_call(sub)
-        if cap is None:
-            continue
-        key = (mod.relpath, sub.lineno, cap)
-        if key in seen:
-            continue
-        seen.add(key)
+    for sub, cap in engine.novel_calls(mod, node, seen,
+                                       _capture_call):
         findings.append(Finding(
             mod.relpath, sub.lineno, "profiler-safety", "error",
             "profiler capture %r inside reactor callback %s — the "
@@ -155,30 +110,11 @@ def check_profiler_safety(project):
         # 1) /debug/profile route branches
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.If) \
-                    and _tests_profile_route(node.test):
+                    and engine.test_mentions(node.test,
+                                             (_ROUTE_MARK,)):
                 _scan_route_branch(mod, node.test, node.body,
                                    findings)
-        # 2) reactor callbacks (same contexts reactor-purity scans)
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                for item in node.body:
-                    if isinstance(item, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)) \
-                            and item.name in _CALLBACK_METHODS:
-                        _scan_callback(
-                            mod, item,
-                            "%s.%s" % (node.name, item.name),
-                            findings, seen)
-        calls = []
-        _walk_scopes(mod.tree, None, [], calls)
-        for call, cls_node, func_stack in calls:
-            pos = _SCHEDULE_CALLS[_call_name(call)]
-            if len(call.args) <= pos:
-                continue
-            target, desc = _resolve_target(
-                call.args[pos], mod, cls_node, func_stack)
-            if target is not None:
-                _scan_callback(mod, target,
-                               "%s (scheduled at line %d)"
-                               % (desc, call.lineno), findings, seen)
+    # 2) reactor callbacks (the shared loop-context enumeration)
+    for mod, _cls, func, where in engine.reactor_callbacks(project):
+        _scan_callback(mod, func, where, findings, seen)
     return findings

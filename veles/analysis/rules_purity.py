@@ -27,6 +27,7 @@ legitimate and common.
 
 import ast
 
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
 
 #: method names that enter jax tracing (StepCompiler collects these)
@@ -52,25 +53,6 @@ _BANNED_PREFIXES = (
 )
 
 
-def _canonical_prefixes(mod):
-    """local name -> canonical dotted path, resolving every import
-    style (``import numpy as np``, ``from numpy import random``,
-    ``from time import monotonic``) so the bans cannot be dodged by
-    how the module was imported."""
-    out = {}
-    for local, target in mod.imports.items():
-        if target[0] == "module":
-            dotted = target[1]
-            if "." in dotted and local == dotted.split(".")[0]:
-                # plain ``import numpy.random`` binds the TOP package
-                # name; the attribute chain spells out the rest
-                dotted = local
-        else:
-            dotted = "%s.%s" % (target[1], target[2])
-        out[local] = dotted
-    return out
-
-
 def _banned(chain, prefixes):
     """(why, hint) when ``chain`` canonicalizes into a banned
     namespace, else None."""
@@ -82,18 +64,6 @@ def _banned(chain, prefixes):
     for prefix, why, hint in _BANNED_PREFIXES:
         if canonical == prefix or canonical.startswith(prefix + "."):
             return why, hint
-    return None
-
-
-def _attr_chain(expr):
-    """Dotted name of an attribute chain, or None."""
-    parts = []
-    while isinstance(expr, ast.Attribute):
-        parts.append(expr.attr)
-        expr = expr.value
-    if isinstance(expr, ast.Name):
-        parts.append(expr.id)
-        return ".".join(reversed(parts))
     return None
 
 
@@ -135,19 +105,19 @@ def _expr_touches(expr, tainted):
         if isinstance(sub, ast.Name) \
                 and (sub.id in tainted or sub.id == "ctx"):
             return True
-        chain = _attr_chain(sub) if isinstance(sub, ast.Attribute) \
-            else None
+        chain = engine.attr_chain(sub) \
+            if isinstance(sub, ast.Attribute) else None
         if chain and (chain == "ctx" or chain.startswith("ctx.")):
             return True
     return False
 
 
 def _scan_traced(mod, cls_name, func, findings, seen_funcs,
-                 project, depth=0):
+                 project, graph, depth=0):
     if id(func) in seen_funcs or depth > 20:
         return
     seen_funcs.add(id(func))
-    prefixes = _canonical_prefixes(mod)
+    prefixes = engine.canonical_import_prefixes(mod)
     tainted = _ctx_tainted_names(func)
     where = "%s.%s" % (cls_name, func.name) if cls_name else func.name
 
@@ -180,7 +150,7 @@ def _scan_traced(mod, cls_name, func, findings, seen_funcs,
                 "keep the value symbolic; reduce with jnp and let "
                 "the step return it"))
             continue
-        chain = _attr_chain(node.func) \
+        chain = engine.attr_chain(node.func) \
             if isinstance(node.func, ast.Attribute) else None
         # numpy.random.* / time.* under ANY import spelling
         if chain:
@@ -220,44 +190,20 @@ def _scan_traced(mod, cls_name, func, findings, seen_funcs,
                     "under jit" % (where, fname),
                     "keep the value symbolic (jnp ops) or read it "
                     "host-side after the step"))
-        # follow helper calls: self.m(...), same-module functions,
-        # module-alias calls (``A.relu(x)``, the dominant style in
-        # ops/) and symbol-imported functions
-        if isinstance(node.func, ast.Attribute) \
-                and isinstance(node.func.value, ast.Name):
-            base = node.func.value.id
-            if base == "self" and cls_name:
-                cls = mod.classes.get(cls_name)
-                if cls is not None:
-                    owner, meth = project.find_method(cls,
-                                                      node.func.attr)
-                    if meth is not None and _in_ops(owner.module):
-                        _scan_traced(owner.module, owner.name, meth,
-                                     findings, seen_funcs, project,
-                                     depth + 1)
-            else:
-                tmod = project.resolve_module_alias(mod, base)
-                if tmod is not None and _in_ops(tmod) \
-                        and node.func.attr in tmod.functions:
-                    _scan_traced(tmod, None,
-                                 tmod.functions[node.func.attr],
-                                 findings, seen_funcs, project,
-                                 depth + 1)
-        elif isinstance(node.func, ast.Name):
-            fname = node.func.id
-            if fname in mod.functions:
-                _scan_traced(mod, None, mod.functions[fname],
-                             findings, seen_funcs, project, depth + 1)
-            else:
-                target = mod.imports.get(fname)
-                if target is not None and target[0] == "symbol":
-                    tmod = project.module_by_dotted(target[1])
-                    if tmod is not None and _in_ops(tmod) \
-                            and target[2] in tmod.functions:
-                        _scan_traced(tmod, None,
-                                     tmod.functions[target[2]],
-                                     findings, seen_funcs, project,
-                                     depth + 1)
+        # follow helper calls through the shared call graph —
+        # self.m(...), same-module functions, module-alias calls
+        # (``A.relu(x)``, the dominant style in ops/), symbol imports
+        # and constructors — staying inside the traced-op modules
+        cls = mod.classes.get(cls_name) if cls_name else None
+        target = graph.resolve(mod, cls, node)
+        # constructors stay unfollowed: trace-time attribute setup on
+        # a FRESH object is not persistent-state mutation
+        if target is not None and _in_ops(target.module) \
+                and target.func.name != "__init__":
+            _scan_traced(target.module,
+                         target.cls.name if target.cls else None,
+                         target.func, findings, seen_funcs, project,
+                         graph, depth + 1)
 
 
 @register("tracer-purity", "error",
@@ -268,6 +214,7 @@ def check_tracer_purity(project):
     # ONE project-wide seen set: a shared helper (conv_math etc.) is
     # scanned once, not re-reported per calling module
     seen = set()
+    graph = engine.CallGraph(project)
     for mod in project.modules:
         if not _in_ops(mod):
             continue
@@ -276,5 +223,5 @@ def check_tracer_purity(project):
                 meth = cls.methods.get(mname)
                 if meth is not None:
                     _scan_traced(mod, cls.name, meth, findings, seen,
-                                 project)
+                                 project, graph)
     return findings

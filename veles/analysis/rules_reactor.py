@@ -7,10 +7,11 @@ timer with it — the exact failure the thread-per-connection design
 could hide (one stuck thread stalled one slave; one stuck callback
 stalls the cluster's whole control surface).
 
-This rule finds code that runs ON the loop — methods named
-``on_frame``/``on_timer`` (the reactor callback convention) and the
-function targets of ``call_soon``/``call_later``/``every`` — and
-flags blocking primitives inside them:
+This rule finds code that runs ON the loop — via the shared
+:func:`veles.analysis.engine.reactor_callbacks` enumeration (methods
+named ``on_frame``/``on_timer`` and the function targets of
+``call_soon``/``call_later``/``every``/``post``) — and flags blocking
+primitives inside them:
 
 * raw-socket waits: ``recv``/``recv_into``/``recvfrom``/``sendall``/
   ``accept``/``create_connection`` (loop callbacks hand bytes to the
@@ -27,31 +28,13 @@ thread-per-connection design had, and the ``lock-order`` rule already
 polices the discipline itself.
 """
 
-import ast
-
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
-
-#: reactor scheduling API: the (position of the) callback argument
-_SCHEDULE_CALLS = {"call_soon": 0, "call_later": 1, "every": 1}
-
-#: conventional reactor callback method names. on_readable/on_writable
-#: are excluded on purpose — they ARE the I/O layer (the one place
-#: recv/send on the non-blocking socket is the job).
-_CALLBACK_METHODS = frozenset(("on_frame", "on_timer"))
 
 _BLOCKING = frozenset((
     "recv", "recv_into", "recvfrom", "sendall", "accept",
     "create_connection", "sleep", "wait", "urlopen", "urlretrieve",
 ))
-
-
-def _call_name(node):
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return None
 
 
 def _blocking_name(node):
@@ -60,7 +43,7 @@ def _blocking_name(node):
     no positional args while ``str.join`` always takes exactly one —
     the 1-positional-arg spelling is left alone (documented gap:
     ``t.join(5)``)."""
-    name = _call_name(node)
+    name = engine.call_name(node)
     if name in _BLOCKING:
         return name
     if name == "join" and not node.args:
@@ -69,16 +52,8 @@ def _blocking_name(node):
 
 
 def _scan_callback(mod, node, where, findings, seen):
-    for sub in ast.walk(node):
-        if not isinstance(sub, ast.Call):
-            continue
-        name = _blocking_name(sub)
-        if name is None:
-            continue
-        key = (mod.relpath, sub.lineno, name)
-        if key in seen:
-            continue
-        seen.add(key)
+    for sub, name in engine.novel_calls(mod, node, seen,
+                                        _blocking_name):
         findings.append(Finding(
             mod.relpath, sub.lineno, "reactor-purity", "error",
             "blocking call %r inside reactor callback %s — one "
@@ -89,52 +64,6 @@ def _scan_callback(mod, node, where, findings, seen):
             "sockets, threads own waiting"))
 
 
-def _resolve_target(cb, mod, cls_node, func_stack):
-    """The FunctionDef/Lambda a scheduling call's callback argument
-    names, resolved conservatively: a lambda inline, a Name through
-    the enclosing function scopes then module functions, or a
-    ``self.method`` on the enclosing class."""
-    if isinstance(cb, ast.Lambda):
-        return cb, "<lambda>"
-    if isinstance(cb, ast.Name):
-        for enclosing in reversed(func_stack):
-            for sub in ast.walk(enclosing):
-                if isinstance(sub, (ast.FunctionDef,
-                                    ast.AsyncFunctionDef)) \
-                        and sub.name == cb.id:
-                    return sub, cb.id
-        fn = mod.functions.get(cb.id)
-        if fn is not None:
-            return fn, cb.id
-        return None, None
-    if isinstance(cb, ast.Attribute) \
-            and isinstance(cb.value, ast.Name) \
-            and cb.value.id == "self" and cls_node is not None:
-        info = mod.classes.get(cls_node.name)
-        if info is not None and cb.attr in info.methods:
-            return (info.methods[cb.attr],
-                    "%s.%s" % (cls_node.name, cb.attr))
-    return None, None
-
-
-def _walk_scopes(node, cls_node, func_stack, out):
-    """Collect (call, enclosing class, enclosing function stack) for
-    every scheduling call, tracking scope as we descend."""
-    for child in ast.iter_child_nodes(node):
-        if isinstance(child, ast.ClassDef):
-            _walk_scopes(child, child, func_stack, out)
-            continue
-        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-            _walk_scopes(child, cls_node, func_stack + [child], out)
-            continue
-        if isinstance(child, ast.Call):
-            name = _call_name(child)
-            if name in _SCHEDULE_CALLS:
-                out.append((child, cls_node, list(func_stack)))
-        _walk_scopes(child, cls_node, func_stack, out)
-
-
 @register("reactor-purity", "error",
           "reactor callbacks (on_frame/on_timer, call_soon/call_later"
           "/every targets) must not call blocking primitives — no "
@@ -143,27 +72,6 @@ def _walk_scopes(node, cls_node, func_stack, out):
 def check_reactor_purity(project):
     findings = []
     seen = set()
-    for mod in project.modules:
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.ClassDef):
-                for item in node.body:
-                    if isinstance(item, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)) \
-                            and item.name in _CALLBACK_METHODS:
-                        _scan_callback(
-                            mod, item,
-                            "%s.%s" % (node.name, item.name),
-                            findings, seen)
-        calls = []
-        _walk_scopes(mod.tree, None, [], calls)
-        for call, cls_node, func_stack in calls:
-            pos = _SCHEDULE_CALLS[_call_name(call)]
-            if len(call.args) <= pos:
-                continue
-            target, desc = _resolve_target(
-                call.args[pos], mod, cls_node, func_stack)
-            if target is not None:
-                _scan_callback(mod, target,
-                               "%s (scheduled at line %d)"
-                               % (desc, call.lineno), findings, seen)
+    for mod, _cls, func, where in engine.reactor_callbacks(project):
+        _scan_callback(mod, func, where, findings, seen)
     return findings

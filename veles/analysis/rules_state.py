@@ -17,21 +17,22 @@ ephemeral::
 
 import ast
 
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
 
 _STATE_METHODS = ("get_state", "checkpoint_state")
 
 
-def _run_mutations(project, cls):
+def _run_mutations(project, graph, cls):
     """Attributes ``run()`` assigns on self, following ``self.*``
-    helper calls within the class (bounded depth)."""
+    helper calls through the shared call graph (bounded depth)."""
     run = cls.methods.get("run")
     if run is None:
         return []
     writes = []
     seen = set()
 
-    def scan(func, depth):
+    def scan(mod, owner, func, depth):
         if id(func) in seen or depth > 8:
             return
         seen.add(id(func))
@@ -48,12 +49,16 @@ def _run_mutations(project, cls):
                     and isinstance(node.func, ast.Attribute) \
                     and isinstance(node.func.value, ast.Name) \
                     and node.func.value.id == "self":
-                owner, meth = project.find_method(cls, node.func.attr)
-                if meth is not None and meth.name not in (
-                        "run", "initialize", "stop"):
-                    scan(meth, depth + 1)
+                # only self.helper() calls: another object's method
+                # writes ITS state, not this unit's
+                target = graph.resolve(mod, owner, node)
+                if target is not None \
+                        and target.func.name not in (
+                            "run", "initialize", "stop"):
+                    scan(target.module, target.cls, target.func,
+                         depth + 1)
 
-    scan(run, 0)
+    scan(cls.module, cls, run, 0)
     return writes
 
 
@@ -62,13 +67,14 @@ def _run_mutations(project, cls):
           "implement get_state/checkpoint_state")
 def check_checkpoint_state(project):
     findings = []
+    graph = engine.CallGraph(project)
     for mod in project.modules:
         for cls in mod.classes.values():
             if not project.is_subclass_of(cls, "Unit"):
                 continue
             if "run" not in cls.methods:
                 continue           # inherited run: the definer owns it
-            writes = _run_mutations(project, cls)
+            writes = _run_mutations(project, graph, cls)
             if not writes:
                 continue
             has_state = any(

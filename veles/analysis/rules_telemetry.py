@@ -24,6 +24,7 @@ runtime:
 
 import ast
 
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
 
 _FACTORIES = ("counter", "gauge", "histogram")
@@ -60,9 +61,11 @@ def _is_factory_call(node, telemetry_aliases, registry_handles):
 
 
 def _telemetry_aliases(mod):
-    return {local for local, target in mod.imports.items()
-            if target in (("module", "veles.telemetry"),
-                          ("symbol", "veles", "telemetry"))}
+    """Local names the telemetry module is imported under, through
+    any import spelling (the shared canonicalization)."""
+    return {local for local, dotted
+            in engine.canonical_import_prefixes(mod).items()
+            if dotted == "veles.telemetry"}
 
 
 def _registry_handles(mod):

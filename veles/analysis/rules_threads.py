@@ -4,12 +4,13 @@ thread-lifecycle.
 ``lock-order`` builds an inter-procedural lock-acquisition graph: a
 ``with self._lock:`` (or a module-global lock) puts that lock on the
 held stack, and every lock acquired while another is held records an
-ordering edge. Calls are followed through cheap type inference —
-``self.method()``, ``self.attr.method()`` when ``__init__`` bound the
-attr to a project class, module-level functions, imported symbols and
-constructor calls — so a nesting like ``MasterServer.persist_state
-(holds _persist_lock) -> checkpoint_state (takes lock)`` shows up as
-the edge ``_persist_lock -> lock`` even though no single function
+ordering edge. Calls are followed through the shared
+:class:`veles.analysis.engine.CallGraph` — ``self.method()``,
+``self.attr.method()`` when ``__init__`` bound the attr to a project
+class, module-level functions, imported symbols and constructor
+calls — so a nesting like ``MasterServer.persist_state (holds
+_persist_lock) -> checkpoint_state (takes lock)`` shows up as the
+edge ``_persist_lock -> lock`` even though no single function
 acquires both. Cycles in the merged graph are potential deadlocks;
 re-entering a non-reentrant ``threading.Lock`` (directly or through
 calls) is reported even without a cycle. ``threading.Condition(lock)``
@@ -27,16 +28,19 @@ hang on a forgotten worker.
 
 import ast
 
+from veles.analysis import engine
 from veles.analysis.core import Finding, register
 
-_MAX_DEPTH = 40
+_MAX_DEPTH = engine.MAX_DEPTH
 
 
 class _LockWalker:
-    """Inter-procedural walk collecting lock-ordering edges."""
+    """Inter-procedural walk collecting lock-ordering edges; call
+    resolution is the shared engine CallGraph."""
 
     def __init__(self, project):
         self.project = project
+        self.graph = engine.CallGraph(project)
         #: (lock_a, lock_b) -> (module, lineno, "Class.meth -> ...")
         self.edges = {}
         #: re-entry of a non-reentrant lock: [(lock, module, lineno,
@@ -44,8 +48,6 @@ class _LockWalker:
         self.reentries = []
         self._active = []      # call-stack guard: (id(func), lockset)
         self._cls_locks = {}   # id(ClassInfo) -> (locks, aliases)
-
-    # -- resolution helpers -------------------------------------------
 
     def _locks_for(self, cls):
         """Hierarchy-merged (locks, aliases) for a class, cached."""
@@ -78,89 +80,6 @@ class _LockWalker:
                 and expr.id in ctx_mod.global_locks:
             return (("module:" + ctx_mod.relpath, expr.id),
                     ctx_mod.global_locks[expr.id])
-        return None
-
-    def _module_for(self, dotted):
-        return self.project.module_by_dotted(dotted)
-
-    def _resolve_call(self, ctx_mod, ctx_cls, call):
-        """-> (module, classinfo_or_None, funcdef, label) or None."""
-        fn = call.func
-        # self.method(...)
-        if isinstance(fn, ast.Attribute) \
-                and isinstance(fn.value, ast.Name):
-            base = fn.value.id
-            if base == "self" and ctx_cls is not None:
-                cls, meth = self.project.find_method(ctx_cls, fn.attr)
-                if meth is not None:
-                    return (cls.module, cls, meth,
-                            "%s.%s" % (cls.name, fn.attr))
-                return None
-            # module_alias.func(...) / global_instance.method(...)
-            target = ctx_mod.imports.get(base)
-            if target and target[0] == "symbol":
-                # ``from veles import telemetry`` imports a MODULE
-                # through the symbol form — resolve it as one
-                if self._module_for("%s.%s" % (target[1], target[2])):
-                    target = ("module",
-                              "%s.%s" % (target[1], target[2]))
-            if target and target[0] == "module":
-                mod = self._module_for(target[1])
-                if mod and fn.attr in mod.functions:
-                    return (mod, None, mod.functions[fn.attr],
-                            "%s.%s" % (base, fn.attr))
-                if mod and fn.attr in mod.classes:
-                    cls = mod.classes[fn.attr]
-                    ini = cls.methods.get("__init__")
-                    if ini is not None:
-                        return (mod, cls, ini,
-                                "%s.__init__" % fn.attr)
-                return None
-            tname = ctx_mod.global_types.get(base)
-            if tname:
-                for cls in self.project.class_index.get(tname, ()):
-                    meth = cls.methods.get(fn.attr)
-                    if meth is not None:
-                        return (cls.module, cls, meth,
-                                "%s.%s" % (tname, fn.attr))
-            return None
-        # self.attr.method(...) via __init__ type binding (the attr
-        # may be bound by a BASE class's __init__ — merge hierarchy)
-        if isinstance(fn, ast.Attribute) \
-                and isinstance(fn.value, ast.Attribute) \
-                and isinstance(fn.value.value, ast.Name) \
-                and fn.value.value.id == "self" and ctx_cls is not None:
-            tname = self.project.class_attr_types(ctx_cls) \
-                .get(fn.value.attr)
-            if tname:
-                for cls in self.project.class_index.get(tname, ()):
-                    meth = cls.methods.get(fn.attr)
-                    if meth is not None:
-                        return (cls.module, cls, meth,
-                                "%s.%s" % (tname, fn.attr))
-            return None
-        if isinstance(fn, ast.Name):
-            name = fn.id
-            if name in ctx_mod.functions:
-                return (ctx_mod, None, ctx_mod.functions[name], name)
-            if name in ctx_mod.classes:
-                cls = ctx_mod.classes[name]
-                ini = cls.methods.get("__init__")
-                if ini is not None:
-                    return (ctx_mod, cls, ini, "%s.__init__" % name)
-            target = ctx_mod.imports.get(name)
-            if target and target[0] == "symbol":
-                mod = self._module_for(target[1])
-                if mod:
-                    if target[2] in mod.functions:
-                        return (mod, None, mod.functions[target[2]],
-                                name)
-                    if target[2] in mod.classes:
-                        cls = mod.classes[target[2]]
-                        ini = cls.methods.get("__init__")
-                        if ini is not None:
-                            return (mod, cls, ini,
-                                    "%s.__init__" % name)
         return None
 
     # -- the walk ------------------------------------------------------
@@ -209,69 +128,21 @@ class _LockWalker:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return      # nested defs execute later, not here
-        for field in ast.iter_child_nodes(node):
-            if isinstance(field, ast.stmt):
-                self._walk_stmt(mod, cls, field, held, chain)
-            elif isinstance(field, ast.expr):
-                self._walk_expr(mod, cls, field, held, chain)
+        for kind, child in engine.iter_stmt_children(node):
+            if kind == "stmt":
+                self._walk_stmt(mod, cls, child, held, chain)
             else:
-                # structural nodes that are neither stmt nor expr but
-                # CARRY statements — ExceptHandler, match_case: their
-                # bodies are exactly where retry paths take locks, so
-                # skipping them would silently weaken the gate
-                for sub in ast.iter_child_nodes(field):
-                    if isinstance(sub, ast.stmt):
-                        self._walk_stmt(mod, cls, sub, held, chain)
-                    elif isinstance(sub, ast.expr):
-                        self._walk_expr(mod, cls, sub, held, chain)
+                self._walk_expr(mod, cls, child, held, chain)
 
     def _walk_expr(self, mod, cls, node, held, chain):
         for sub in ast.walk(node):
             if not isinstance(sub, ast.Call):
                 continue
-            resolved = self._resolve_call(mod, cls, sub)
-            if resolved is None:
+            target = self.graph.resolve(mod, cls, sub)
+            if target is None:
                 continue
-            cmod, ccls, cfunc, label = resolved
-            self.walk_function(cmod, ccls, cfunc, held,
-                               chain + [label])
-
-
-def _cycles(edges):
-    """Minimal cycle set of the ordering graph: strongly connected
-    components with more than one lock (Tarjan)."""
-    graph = {}
-    for (a, b) in edges:
-        graph.setdefault(a, set()).add(b)
-        graph.setdefault(b, set())
-    index, low, on, stack = {}, {}, set(), []
-    sccs, counter = [], [0]
-
-    def strongconnect(v):
-        index[v] = low[v] = counter[0]
-        counter[0] += 1
-        stack.append(v)
-        on.add(v)
-        for w in graph[v]:
-            if w not in index:
-                strongconnect(w)
-                low[v] = min(low[v], low[w])
-            elif w in on:
-                low[v] = min(low[v], index[w])
-        if low[v] == index[v]:
-            comp = []
-            while True:
-                w = stack.pop()
-                on.discard(w)
-                comp.append(w)
-                if w == v:
-                    break
-            if len(comp) > 1:
-                sccs.append(comp)
-    for v in list(graph):
-        if v not in index:
-            strongconnect(v)
-    return sccs
+            self.walk_function(target.module, target.cls, target.func,
+                               held, chain + [target.label])
 
 
 def _fmt_lock(lock):
@@ -300,7 +171,7 @@ def check_lock_order(project):
             % (_fmt_lock(lock), " -> ".join(chain)),
             "use threading.RLock, or split the locked region so the "
             "outer caller passes already-held state in"))
-    for comp in _cycles(walker.edges):
+    for comp in engine.tarjan_sccs(walker.edges):
         comp_set = set(comp)
         sites = []
         for (a, b), (mod, lineno, chain) in sorted(
@@ -358,15 +229,9 @@ def _self_writes(func, lock_attrs, alias_attrs):
                         and isinstance(t.value, ast.Name) \
                         and t.value.id == "self":
                     out.append((t.attr, node.lineno, locked))
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.stmt):
+        for kind, child in engine.iter_stmt_children(node):
+            if kind == "stmt":
                 walk(child, locked)
-            elif not isinstance(child, ast.expr):
-                # ExceptHandler / match_case: statement carriers —
-                # writes in error-recovery paths count too
-                for sub in ast.iter_child_nodes(child):
-                    if isinstance(sub, ast.stmt):
-                        walk(sub, locked)
 
     for stmt in func.body:
         walk(stmt, False)
@@ -383,10 +248,7 @@ def _thread_target_names(methods):
         for node in ast.walk(meth):
             if not isinstance(node, ast.Call):
                 continue
-            fn = node.func
-            name = fn.attr if isinstance(fn, ast.Attribute) else (
-                fn.id if isinstance(fn, ast.Name) else None)
-            if name != "Thread":
+            if engine.call_name(node) != "Thread":
                 continue
             # target may be the keyword OR the second positional arg
             # (Thread(group, target, ...))
@@ -402,15 +264,6 @@ def _thread_target_names(methods):
                 elif isinstance(v, ast.Name):
                     targets.add(v.id)
     return targets
-
-
-def _nested_functions(meth):
-    out = {}
-    for node in ast.walk(meth):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node is not meth:
-            out[node.name] = node
-    return out
 
 
 @register("unguarded-shared-state", "error",
@@ -436,7 +289,7 @@ def check_unguarded_shared_state(project):
             for mname, (owner, meth) in methods.items():
                 omod = owner.module
                 funcs = []
-                nested = _nested_functions(meth)
+                nested = engine.nested_functions(meth)
                 if mname in targets:
                     funcs.append(meth)
                 funcs.extend(f for n, f in nested.items()
@@ -482,34 +335,47 @@ def check_unguarded_shared_state(project):
 # -- thread-lifecycle --------------------------------------------------
 
 
-def _assigned_name(mod, call):
-    """The Name/self-attribute a constructor call is assigned to, as a
-    comparable key ("x" or "self.x"), or None for a bare call."""
-    for node in ast.walk(mod.tree):
-        if isinstance(node, ast.Assign) and node.value is call:
-            t = node.targets[0]
-            if isinstance(t, ast.Name):
-                return t.id
-            if isinstance(t, ast.Attribute) \
-                    and isinstance(t.value, ast.Name):
-                return "%s.%s" % (t.value.id, t.attr)
-    return None
-
-
 def _joined_names(mod):
-    """{key} of every ``<key>.join(...)`` call in the module."""
+    """{key} of every ``<key>.join(...)`` call in the module — plus
+    the ITERABLE's key when a for-loop joins its loop variable
+    (``for t in threads: t.join()`` marks ``threads``), so the
+    thread-pool idiom ``threads = [Thread(...) for ...]`` resolves."""
     out = set()
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute) \
                 and node.func.attr == "join":
-            v = node.func.value
-            if isinstance(v, ast.Name):
-                out.add(v.id)
-            elif isinstance(v, ast.Attribute) \
-                    and isinstance(v.value, ast.Name):
-                out.add("%s.%s" % (v.value.id, v.attr))
+            key = engine.target_key(node.func.value)
+            if key is not None:
+                out.add(key)
+        elif isinstance(node, ast.For) \
+                and isinstance(node.target, ast.Name):
+            var = node.target.id
+            iter_key = engine.target_key(node.iter)
+            if iter_key is None:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "join" \
+                        and isinstance(sub.func.value, ast.Name) \
+                        and sub.func.value.id == var:
+                    out.add(iter_key)
+                    break
     return out
+
+
+def _comprehension_target(mod, call):
+    """The name a comprehension-built pool is assigned to when
+    ``call`` is a constructor inside it (``threads =
+    [Thread(...) for ...]`` -> "threads"), or None."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, (ast.ListComp,
+                                            ast.GeneratorExp)) \
+                and any(sub is call for sub in ast.walk(node.value)):
+            return engine.target_key(node.targets[0])
+    return None
 
 
 def _daemonized_names(mod):
@@ -526,12 +392,9 @@ def _daemonized_names(mod):
             if not (isinstance(t, ast.Attribute)
                     and t.attr == "daemon"):
                 continue
-            v = t.value
-            if isinstance(v, ast.Name):
-                out.add(v.id)
-            elif isinstance(v, ast.Attribute) \
-                    and isinstance(v.value, ast.Name):
-                out.add("%s.%s" % (v.value.id, v.attr))
+            key = engine.target_key(t.value)
+            if key is not None:
+                out.add(key)
     return out
 
 
@@ -545,9 +408,7 @@ def check_thread_lifecycle(project):
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func
-            name = fn.attr if isinstance(fn, ast.Attribute) else (
-                fn.id if isinstance(fn, ast.Name) else None)
-            if name != "Thread":
+            if engine.call_name(node) != "Thread":
                 continue
             # only the real constructor: threading.Thread (under any
             # import alias) / a bare imported Thread
@@ -568,7 +429,9 @@ def check_thread_lifecycle(project):
                 continue           # daemon=True (or dynamic): fine
             # non-daemon at construction: the handle must be kept AND
             # either .daemon = True'd or .join()ed in this module
-            handle = _assigned_name(mod, node)
+            handle = engine.assigned_name(mod, node)
+            if handle is None:
+                handle = _comprehension_target(mod, node)
             if handle is not None:
                 if joined is None:
                     joined = _joined_names(mod) \

@@ -511,8 +511,14 @@ def ga_slave_loop(address, name="ga-slave", max_tasks=None,
 
     def connect(first=False):
         sock = socket.create_connection(addr, timeout=30)
-        send_frame(sock, ("hello", name))
-        welcome = recv_frame(sock)
+        try:
+            send_frame(sock, ("hello", name))
+            welcome = recv_frame(sock)
+        except (ConnectionError, OSError):
+            # a handshake that dies mid-frame must not leak the fd
+            # into the retry loop's next attempt
+            sock.close()
+            raise
         if welcome is None or welcome[0] != "welcome":
             sock.close()
             if first:

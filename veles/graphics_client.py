@@ -116,20 +116,26 @@ def serve(port, out_dir):
     os.makedirs(out_dir, exist_ok=True)
     sock = socket.create_connection(("127.0.0.1", port))
     index = {}
-    while True:
-        blob = recv_frame(sock)
-        if blob is None:
-            break
-        try:
-            meta, arrays = unpack_payload(blob)
-            path = render_payload(meta, arrays, out_dir)
-            index[meta["name"]] = {
-                "kind": meta["kind"], "file": os.path.basename(path),
-                "title": meta.get("title", "")}
-            with open(os.path.join(out_dir, "plots.json"), "w") as f:
-                json.dump(index, f, indent=1)
-        except Exception as exc:  # a bad frame must not kill the feed
-            print("render error: %s" % exc, file=sys.stderr)
+    try:
+        while True:
+            blob = recv_frame(sock)
+            if blob is None:
+                break
+            try:
+                meta, arrays = unpack_payload(blob)
+                path = render_payload(meta, arrays, out_dir)
+                index[meta["name"]] = {
+                    "kind": meta["kind"],
+                    "file": os.path.basename(path),
+                    "title": meta.get("title", "")}
+                with open(os.path.join(out_dir, "plots.json"),
+                          "w") as f:
+                    json.dump(index, f, indent=1)
+            except Exception as exc:
+                # a bad frame must not kill the feed
+                print("render error: %s" % exc, file=sys.stderr)
+    finally:
+        sock.close()
     return index
 
 

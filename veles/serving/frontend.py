@@ -667,31 +667,38 @@ def serve_main(argv=None):
         default_timeout_ms=args.timeout_ms,
         decode_slots=args.decode_slots,
         decode_max_len=args.decode_max_len)
-    for name, source in sorted(models.items()):
-        registry.load(name, source,
-                      checkpoint=checkpoints.get(name),
-                      warmup=not args.no_warmup)
-    front = ServingFrontend(registry, port=args.port, host=args.host)
-    if args.slo_config:
-        n = health.get_monitor().load_slo_file(args.slo_config)
-        front.info("%d SLO objective(s) loaded from %s", n,
-                   args.slo_config)
-    if args.web_status is not None:
-        from veles.web_status import WebStatus
-        status = WebStatus(port=args.web_status, host=args.host)
-        front.register_status(status)
-    print(json.dumps({
-        "serving": "http://%s:%d" % (front.host, front.port),
-        "models": [{"name": d["name"], "version": d["version"],
-                    "backend": d["backend"],
-                    "compiled_buckets": d["compiled_buckets"]}
-                   for d in registry.describe()],
-    }), flush=True)
+    front = None
     try:
-        threading.Event().wait()        # serve until ^C / SIGTERM
-    except KeyboardInterrupt:
-        pass
+        # inside the guard from the first load on: a bad --model
+        # archive (or a failing warmup) must not strand the
+        # registry's batcher threads behind the SystemExit
+        for name, source in sorted(models.items()):
+            registry.load(name, source,
+                          checkpoint=checkpoints.get(name),
+                          warmup=not args.no_warmup)
+        front = ServingFrontend(registry, port=args.port,
+                                host=args.host)
+        if args.slo_config:
+            n = health.get_monitor().load_slo_file(args.slo_config)
+            front.info("%d SLO objective(s) loaded from %s", n,
+                       args.slo_config)
+        if args.web_status is not None:
+            from veles.web_status import WebStatus
+            status = WebStatus(port=args.web_status, host=args.host)
+            front.register_status(status)
+        print(json.dumps({
+            "serving": "http://%s:%d" % (front.host, front.port),
+            "models": [{"name": d["name"], "version": d["version"],
+                        "backend": d["backend"],
+                        "compiled_buckets": d["compiled_buckets"]}
+                       for d in registry.describe()],
+        }), flush=True)
+        try:
+            threading.Event().wait()    # serve until ^C / SIGTERM
+        except KeyboardInterrupt:
+            pass
     finally:
-        front.close()
+        if front is not None:
+            front.close()
         registry.close()
     return 0
