@@ -675,6 +675,185 @@ def serving_throughput_rps(duration=0.6, clients=8):
         root.mnist.loader.update(saved)
 
 
+def _routed_http_hammer(base, payload, duration, clients):
+    """Hammer one HTTP predict endpoint from ``clients`` threads for
+    ``duration`` seconds; -> (requests/sec, sorted latencies). Only
+    COMPLETED requests count — a failure mid-window would otherwise
+    read as a latency win."""
+    import threading
+    import urllib.request
+    stop = time.perf_counter() + duration
+    lats = [[] for _ in range(clients)]
+
+    def client(i):
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            req = urllib.request.Request(
+                base + "/v1/predict", data=payload,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+            except Exception:
+                continue
+            lats[i].append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    flat = sorted(v for per in lats for v in per)
+    if not flat:
+        raise RuntimeError("no routed request completed")
+    return len(flat) / dt, flat
+
+
+def _p99(lats):
+    return lats[min(int(len(lats) * 0.99), len(lats) - 1)]
+
+
+def _wait_ready(url, timeout_s=90.0, path="/readyz"):
+    import urllib.request
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url + path, timeout=2):
+                return True
+        except Exception:
+            time.sleep(0.2)
+    raise RuntimeError("%s%s never answered 200" % (url, path))
+
+
+def routed_serving_rows(duration=1.0, clients=4):
+    """ISSUE 13 acceptance rows: requests/sec against ONE serving
+    replica hit directly over HTTP vs through ``velescli route``'s
+    proxy in front of it (proxy overhead, bounded by the >= 0.85x
+    acceptance ratio), plus routed p99 with a 2-replica fleet while
+    one replica is BROWNED OUT (BrownoutProxy latency + scrape
+    timeout -> ejection) next to the healthy-fleet p99 — the router
+    must keep the brownout p99 within 2x of healthy.
+
+    Topology is REAL: each replica is a ``velescli serve`` process
+    and the overhead row's router is a ``velescli route`` process
+    (numpy backend, forced-CPU jax) — co-located single-interpreter
+    measurement would price GIL contention between client, router
+    and replica threads, not the proxy hop. The brownout pair runs
+    the router in-process (identical topology on both sides of THAT
+    ratio) because it polls the controller's ejection state
+    directly."""
+    import tempfile
+    import veles.prng as prng
+    prng.seed_all(99)
+    from veles.chaos import BrownoutProxy
+    from veles.config import root
+    from veles.router import (FleetController, RouterFrontend,
+                              SubprocessExecutor)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("minibatch_size", "n_train", "n_valid")}
+    root.mnist.loader.update({"minibatch_size": 50, "n_train": 200,
+                              "n_valid": 50})
+    velescli = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "velescli.py")
+    closers = []
+    try:
+        wf = mnist.create_workflow(name="BenchRouted")
+        wf.initialize(device="numpy")
+        x = wf.loader.original_data.mem[:1].astype("float32")
+        payload = json.dumps({"model": "mnist",
+                              "inputs": x.tolist()}).encode()
+        with tempfile.TemporaryDirectory() as tmp:
+            wf.export_inference(tmp)
+            serve_exec = SubprocessExecutor(
+                [sys.executable, velescli, "serve", "--model",
+                 "mnist=%s" % tmp, "--backend", "numpy", "--port",
+                 "{port}", "--max-wait-ms", "1"],
+                start_timeout=120.0, env={"JAX_PLATFORMS": "cpu"})
+            closers.append(serve_exec.close)
+            url_a = serve_exec.launch()
+            url_b = serve_exec.launch()
+            if url_a is None or url_b is None:
+                raise RuntimeError("replica subprocess never became "
+                                   "healthy")
+            for url in (url_a, url_b):
+                _wait_ready(url)        # model warm, not just alive
+
+            # direct: the single-replica ceiling the proxy is priced
+            # against (warm each path before its timed window)
+            _routed_http_hammer(url_a, payload, 0.1, 1)
+            direct_rps, _ = _routed_http_hammer(
+                url_a, payload, duration, clients)
+
+            route_exec = SubprocessExecutor(
+                [sys.executable, velescli, "route", url_a, "--port",
+                 "{port}", "--interval", "0.3", "--scrape-timeout",
+                 "0.5"],
+                start_timeout=120.0, env={"JAX_PLATFORMS": "cpu"})
+            closers.append(route_exec.close)
+            router_url = route_exec.launch()
+            if router_url is None:
+                raise RuntimeError("router subprocess never became "
+                                   "healthy")
+            _wait_ready(router_url)     # >= 1 backend admitted
+            _routed_http_hammer(router_url, payload, 0.1, 1)
+            routed_rps, _ = _routed_http_hammer(
+                router_url, payload, duration, clients)
+
+            # 2-replica fleet, one browned out: p99 through the
+            # router after ejection vs the healthy-fleet p99
+            proxy = BrownoutProxy(
+                ("127.0.0.1", int(url_b.rsplit(":", 1)[1])))
+            closers.append(proxy.close)
+            fleet_ctl = FleetController(
+                [url_a, proxy.url], interval=0.3, scrape_timeout=0.5)
+            closers.append(fleet_ctl.close)
+            fleet_router = RouterFrontend(fleet_ctl, port=0)
+            closers.append(fleet_router.close)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not (
+                    # ticks >= 1: the INIT doc already lists both
+                    # backends as admitted before any scrape ran
+                    fleet_ctl.status_doc["ticks"] >= 1
+                    and fleet_ctl.status_doc["admitted"] == 2):
+                time.sleep(0.05)
+            _routed_http_hammer(fleet_router.url, payload, 0.1, 1)
+            _, healthy_lats = _routed_http_hammer(
+                fleet_router.url, payload, duration, clients)
+            proxy.brownout(2.0)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not any(
+                    b["state"] == "ejected"
+                    for b in fleet_ctl.status_doc["backends"]):
+                time.sleep(0.05)
+            _, brown_lats = _routed_http_hammer(
+                fleet_router.url, payload, duration, clients)
+        return {"routed_rps_direct": round(direct_rps, 1),
+                "routed_rps_via_router": round(routed_rps, 1),
+                "routed_p99_healthy_s": round(_p99(healthy_lats), 4),
+                "routed_p99_brownout_s": round(_p99(brown_lats), 4)}
+    finally:
+        for close in reversed(closers):
+            try:
+                close()
+            except Exception:
+                pass
+        root.mnist.loader.update(saved)
+
+
+def _routed_rows(extra):
+    """Record the router bench guarded (device-independent row).
+    Directionality: the rps keys read down = bad (throughput), the
+    p99 keys up = bad ("p99" is in _LOWER_BETTER)."""
+    try:
+        extra.update(routed_serving_rows())
+    except Exception as exc:
+        extra["routed_rps_error"] = str(exc)[:200]
+
+
 def _serving_row(extra):
     """Record the serving bench guarded: a failure lands in an _error
     key, never in the exit code (the row must not cost TPU-less runs
@@ -854,7 +1033,7 @@ def _device_reachable(timeout_s=240):
 #: first-token latency, the analyzer's own wall time); everything
 #: else numeric in the report is a throughput/efficiency figure where
 #: bigger wins
-_LOWER_BETTER = ("bytes", "overhead", "latency", "seconds")
+_LOWER_BETTER = ("bytes", "overhead", "latency", "seconds", "p99")
 
 #: keys that are environment stamps, not performance rows
 _SELF_CHECK_SKIP = ("calibration",)
@@ -994,6 +1173,7 @@ def main(argv=None):
         # report them so those trajectories survive tunnel outages
         extra = {"device_error": detail[:300]}
         _serving_row(extra)
+        _routed_rows(extra)
         _generate_rows(extra)
         _grad_codec_rows(extra)
         _dist_scaling_rows(extra)
@@ -1046,6 +1226,9 @@ def main(argv=None):
             lm_base_s8k_tokens_per_sec)
     _record(extra, "lm_345M_tokens_per_sec", lm_345m_tokens_per_sec)
     _serving_row(extra)
+    # direct vs routed RPS + brownout p99 through the router tier
+    # (ISSUE 13; proxy overhead and failover quality as trajectories)
+    _routed_rows(extra)
     # continuous-batching decode vs sequential per-request decode
     # (ISSUE 11; the acceptance multiple at 8 concurrent streams)
     _generate_rows(extra)

@@ -6,6 +6,7 @@ The build is cached in /tmp across test runs (ninja no-ops when
 nothing changed)."""
 
 import os
+import shutil
 import subprocess
 
 import numpy
@@ -16,6 +17,16 @@ from veles.config import root
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUILD_DIR = "/tmp/libveles-build-test"
+
+#: environmental gate (ISSUE 13 satellite): without the build tools
+#: every test here used to ERROR in the engine fixture on each tier-1
+#: run — an honest skip says "cannot build here", not "code broke"
+_missing = [tool for tool in ("cmake", "ninja")
+            if shutil.which(tool) is None]
+pytestmark = pytest.mark.skipif(
+    bool(_missing),
+    reason="C++ engine build unavailable: %s not installed "
+           "(environmental)" % ", ".join(_missing))
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,644 @@
+"""Fleet router/autoscaler tier (ISSUE 13, ``veles/router.py``).
+
+Unit level first (consistent-hash ring, least-queue selection,
+eject/half-open transitions, autoscaler policy — all driven with
+injected scrape rows, no sockets, no clock luck), then live HTTP:
+stub replicas on the shared reactor behind a real
+:class:`RouterFrontend`, including the end-to-end chaos acceptance
+run (brownout one replica via :class:`BrownoutProxy` + a ``/readyz``
+flip -> ejection within two control ticks, zero requests to the
+ejected replica, one trace_id spanning client -> router -> replica,
+half-open re-admission on recovery)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veles import fleet, reactor, telemetry
+from veles.chaos import BrownoutProxy
+from veles.router import (ADMITTED, DRAINING, EJECTED, HALF_OPEN,
+                          Autoscaler, DryRunExecutor, FleetController,
+                          HashRing, RouterFrontend)
+
+
+def wait_until(fn, timeout=15.0, interval=0.01, what="condition"):
+    """Poll ``fn`` until truthy; -> its value (asserts on timeout)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = fn()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.load(exc), dict(exc.headers)
+
+
+def _post(url, doc, headers=None, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.load(exc), dict(exc.headers)
+
+
+# -- stub replica -------------------------------------------------------
+
+
+class StubReplica:
+    """A minimal serving-replica HTTP surface on the shared reactor:
+    real sockets, controllable readiness / SLO firing / queue gauge /
+    token pacing — the deterministic backend the router tests brown
+    out and flip without touching a model."""
+
+    def __init__(self, name, tokens=4, token_interval=0.02):
+        self.model = name
+        self.ready = True
+        self.reasons = ["stub: flipped"]
+        self.firing = []
+        self.queue_rows = 0.0
+        self.tokens = tokens
+        self.token_interval = token_interval
+        self.predicts = 0
+        self.generates = 0
+        self.streams_completed = 0
+        self.last_headers = {}
+        self.server = reactor.HttpServer(
+            "127.0.0.1", 0, self._route, name="stub-" + name)
+        self.url = "http://127.0.0.1:%d" % self.server.port
+
+    def _route(self, request):
+        path = request.path
+        if path.startswith("/healthz"):
+            request.reply_json(200, {"status": "ok"})
+        elif path.startswith("/readyz"):
+            if self.ready:
+                request.reply_json(200, {"ready": True, "reasons": [],
+                                         "checks": {}, "slos": {}})
+            else:
+                request.reply_json(503, {"ready": False,
+                                         "reasons": list(self.reasons),
+                                         "checks": {}, "slos": {}})
+        elif path.startswith("/metrics"):
+            lines = ['veles_serving_queue_rows{model="%s"} %g'
+                     % (self.model, self.queue_rows)]
+            for obj in self.firing:
+                lines.append('veles_slo_alert_firing{objective="%s"} 1'
+                             % obj)
+            request.reply(200, ("\n".join(lines) + "\n").encode(),
+                          "text/plain")
+        elif path == "/v1/predict" and request.method == "POST":
+            self.predicts += 1
+            self.last_headers = dict(request.headers)
+            request.reply_json(200, {"replica": self.model,
+                                     "outputs": [[1.0]],
+                                     "version": 1})
+        elif path == "/v1/generate" and request.method == "POST":
+            self.generates += 1
+            self.last_headers = dict(request.headers)
+            stream = request.begin_stream(200,
+                                          "application/x-ndjson")
+            state = {"n": 0}
+
+            def emit():
+                if stream.closed:
+                    return
+                if state["n"] < self.tokens:
+                    stream.write(json.dumps(
+                        {"token": state["n"]}) + "\n")
+                    state["n"] += 1
+                    self.server.reactor.call_later(
+                        self.token_interval, emit)
+                else:
+                    stream.write(json.dumps(
+                        {"done": True, "replica": self.model}) + "\n")
+                    stream.end()
+                    self.streams_completed += 1
+
+            self.server.reactor.call_later(self.token_interval, emit)
+        else:
+            request.reply_json(404, {"error": "not found"})
+
+    def close(self):
+        self.server.close()
+
+
+def _row(url, reachable=True, ready=True, firing=(), queue=0.0,
+         reasons=()):
+    """One injected fleet-scrape row (the controller's sensor input)."""
+    return {"url": url, "reachable": reachable, "ready": ready,
+            "firing": list(firing), "reasons": list(reasons),
+            "metrics": {"serving_queue_rows": queue}}
+
+
+# -- unit: ring + selection + transitions -------------------------------
+
+
+def test_hash_ring_remaps_only_the_removed_backend():
+    urls = ["http://a:1", "http://b:1", "http://c:1"]
+    ring = HashRing(urls)
+    keys = ["session:%d" % i for i in range(200)]
+    before = {k: ring.lookup(k, set(urls)) for k in keys}
+    assert set(before.values()) == set(urls)  # all backends used
+    # ejection = ineligibility, NOT ring surgery: survivors keep
+    # every key they had
+    survivors = {"http://a:1", "http://c:1"}
+    after = {k: ring.lookup(k, survivors) for k in keys}
+    for k in keys:
+        if before[k] in survivors:
+            assert after[k] == before[k], k
+        else:
+            assert after[k] in survivors
+    # sticky under membership no-ops: same key, same answer
+    assert ring.lookup("session:x", set(urls)) \
+        == ring.lookup("session:x", set(urls))
+
+
+def test_controller_least_queue_and_eject_readmit_cycle():
+    a, b = "http://a:1", "http://b:1"
+    c = FleetController([a, b], interval=999.0)
+    try:
+        c.tick(rows=[_row(a, queue=5.0), _row(b, queue=0.0)])
+        assert c.select().url == b          # least queue wins
+        c.tick(rows=[_row(a, queue=0.0), _row(b, queue=5.0)])
+        assert c.select().url == a
+
+        # readiness flip ejects eagerly, requests drain to the other
+        c.tick(rows=[_row(a, ready=False, reasons=["models: none"]),
+                     _row(b)])
+        assert c._replicas[a].state == EJECTED
+        assert c.select().url == b
+        assert telemetry.get_registry().counter_total(
+            "veles_router_ejections_total", reason="not_ready") == 1
+
+        # SLO burn-rate firing ejects too
+        c.tick(rows=[_row(a, ready=False), _row(b, firing=["p99"])])
+        assert c._replicas[b].state == EJECTED
+        assert c.select() is None           # nothing admitted
+
+        # recovery -> half-open: exactly ONE probe slot
+        c.tick(rows=[_row(a), _row(b, firing=["p99"])])
+        assert c._replicas[a].state == HALF_OPEN
+        probe = c.select()
+        assert probe.url == a
+        assert c.select() is None           # trial slot taken
+        c.report_success(probe)
+        assert c._replicas[a].state == ADMITTED
+        assert c.select().url == a
+
+        # a failed probe re-ejects
+        c.tick(rows=[_row(a), _row(b, firing=["p99"])])
+        c.tick(rows=[_row(a), _row(b)])
+        probe = c.select(exclude={a})
+        assert probe.url == b and probe.state == HALF_OPEN
+        c.report_failure(probe, "connect refused")
+        assert c._replicas[b].state == EJECTED
+
+        events = [e["event"] for e in telemetry.tracer.recent_events()]
+        assert "router_failover" in events
+        assert "router_readmit" in events
+    finally:
+        c.close()
+
+
+def test_controller_consecutive_proxy_failures_eject():
+    a, b = "http://a:1", "http://b:1"
+    c = FleetController([a, b], interval=999.0, eject_failures=2)
+    try:
+        c.tick(rows=[_row(a), _row(b)])
+        r = c._replicas[a]
+        c.report_failure(r, "boom")
+        assert r.state == ADMITTED          # one failure is noise
+        c.report_failure(r, "boom")
+        assert r.state == EJECTED           # threshold reached
+        assert telemetry.get_registry().counter_total(
+            "veles_router_ejections_total", reason="errors") == 1
+        # stickiness falls back to the survivor, not the ejected one
+        for i in range(8):
+            assert c.select(sticky_key="session:%d" % i).url == b
+    finally:
+        c.close()
+
+
+def test_controller_partial_scrape_ejects_and_keeps_gauges():
+    a, b = "http://a:1", "http://b:1"
+    c = FleetController([a, b], interval=999.0)
+    try:
+        c.tick(rows=[_row(a, queue=7.0), _row(b, queue=1.0)])
+        # budget-truncated row: /healthz answered but the budget died
+        # before /readyz — too slow to scrape is too slow to route to
+        partial = {"url": a, "reachable": True, "ready": None,
+                   "partial": True, "firing": [], "reasons": [],
+                   "metrics": {}}
+        c.tick(rows=[partial, _row(b, queue=1.0)])
+        assert c._replicas[a].state == EJECTED
+        # ...and the stale gauge is KEPT: zeroing it would make the
+        # slowest replica the least-queue magnet on re-admission
+        assert c._replicas[a].queue_rows == 7.0
+        # a pre-health-plane process (ready None WITHOUT partial)
+        # stays admitted — no /readyz surface is not a timeout
+        bare = {"url": b, "reachable": True, "ready": None,
+                "firing": [], "reasons": [], "metrics": {}}
+        c.tick(rows=[_row(a), bare])
+        assert c._replicas[b].state == ADMITTED
+    finally:
+        c.close()
+
+
+def test_controller_drain_stops_new_requests():
+    a, b = "http://a:1", "http://b:1"
+    c = FleetController([a, b], interval=999.0)
+    try:
+        c.tick(rows=[_row(a), _row(b)])
+        assert c.drain(a) == 0
+        assert c._replicas[a].state == DRAINING
+        for _ in range(6):
+            assert c.select().url == b
+        # drain survives healthy scrapes (it is an operator decision)
+        c.tick(rows=[_row(a), _row(b)])
+        assert c._replicas[a].state == DRAINING
+        assert c.drain("http://nope:1") is None
+    finally:
+        c.close()
+
+
+# -- unit: autoscaler ----------------------------------------------------
+
+
+class FakeExecutor:
+    actuates = True
+    kind = "fake"
+
+    def __init__(self, urls):
+        self.urls = list(urls)
+        self.launched = []
+        self.stopped = []
+
+    def launch(self):
+        url = self.urls.pop(0) if self.urls else None
+        if url:
+            self.launched.append(url)
+        return url
+
+    def stop(self, url):
+        self.stopped.append(url)
+
+    def close(self):
+        pass
+
+
+def test_autoscaler_up_on_queue_down_via_drain():
+    a, new = "http://a:1", "http://new:1"
+    executor = FakeExecutor([new])
+    scaler = Autoscaler(executor, min_replicas=1, max_replicas=2,
+                        queue_high=10.0, queue_low=1.0,
+                        sustain_ticks=2, cooldown_s=0.0)
+    c = FleetController([a], interval=999.0, autoscaler=scaler)
+    try:
+        # sustained overload -> launch + admit the new replica
+        c.tick(rows=[_row(a, queue=50.0)])
+        assert executor.launched == []      # one tick is a blip
+        c.tick(rows=[_row(a, queue=50.0)])
+        # the launch runs off the control thread (a subprocess start
+        # must not freeze the loop) — wait for it to land
+        wait_until(lambda: new in c.targets(), what="launched target")
+        assert executor.launched == [new]
+        assert telemetry.get_registry().counter_total(
+            "veles_router_scale_decisions_total", direction="up") == 1
+
+        # sustained idle -> drain the launched replica, then stop it
+        # once its inflight reaches zero
+        idle = [_row(a, queue=0.0), _row(new, queue=0.0)]
+        c.tick(rows=idle)
+        c.tick(rows=idle)
+        assert c._replicas[new].state == DRAINING
+        c.tick(rows=idle)                   # drained -> stopped
+        assert new not in c.targets()       # unrouted synchronously
+        # the process stop itself runs off the control thread
+        wait_until(lambda: executor.stopped == [new],
+                   what="async executor stop")
+        wait_until(lambda: "scale_down_complete" in [
+            e["event"] for e in telemetry.tracer.recent_events()],
+            what="scale_down_complete event")
+        events = [e["event"] for e in telemetry.tracer.recent_events()]
+        assert "scale_up" in events and "scale_down" in events
+    finally:
+        c.close()
+
+
+def test_autoscaler_dry_run_records_without_actuating():
+    a = "http://a:1"
+    scaler = Autoscaler(DryRunExecutor(), min_replicas=1,
+                        max_replicas=4, queue_high=10.0,
+                        sustain_ticks=1, cooldown_s=0.0)
+    c = FleetController([a], interval=999.0, autoscaler=scaler)
+    try:
+        c.tick(rows=[_row(a, firing=["p99_burn"], queue=0.0)])
+        # firing SLO ejects the backend AND reads as scale-up signal
+        assert scaler.decisions \
+            and scaler.decisions[-1]["direction"] == "up" \
+            and scaler.decisions[-1]["actuated"] is False
+        assert c.targets() == [a]           # nothing launched
+        doc = c.status_doc
+        assert doc["autoscaler"]["last"]["direction"] == "up"
+    finally:
+        c.close()
+
+
+# -- fleet scraper: parallel + time-bounded (satellite) ------------------
+
+
+def test_parallel_scrape_bounded_by_wedged_target():
+    healthy = StubReplica("fast")
+    wedge = BrownoutProxy(("127.0.0.1", healthy.server.port))
+    wedge.set_black_hole()                  # connects, never answers
+    try:
+        t0 = time.perf_counter()
+        rows = fleet.scrape_targets(
+            [healthy.url, wedge.url, healthy.url],
+            timeout=0.5, total=0.5)
+        wall = time.perf_counter() - t0
+        # serial pre-ISSUE-13 behaviour: every surface of every
+        # target queued behind the wedged one; now the wave is
+        # bounded by ONE per-target budget
+        assert wall < 3.0, wall
+        assert rows[0]["ready"] is True
+        assert rows[1]["reachable"] is False
+        assert rows[2]["ready"] is True
+    finally:
+        wedge.close()
+        healthy.close()
+
+
+# -- live HTTP: the router in front of real sockets ----------------------
+
+
+def _mk_router(stubs, **kw):
+    kw.setdefault("interval", 0.15)
+    kw.setdefault("scrape_timeout", 0.5)
+    controller = FleetController([s if isinstance(s, str) else s.url
+                                  for s in stubs], **kw)
+    front = RouterFrontend(controller, port=0)
+    return controller, front
+
+
+def _wait_admitted(front, n, timeout=15.0):
+    def check():
+        doc = _get(front.url + "/router/status")[1]
+        # ticks >= 1: the init doc lists configured backends as
+        # admitted before any scrape confirmed them
+        return doc if doc["ticks"] >= 1 and doc["admitted"] == n \
+            else None
+    return wait_until(check, timeout=timeout,
+                      what="%d admitted backend(s)" % n)
+
+
+def test_router_proxies_predict_with_trace_and_metrics():
+    stub = StubReplica("m1")
+    controller, front = _mk_router([stub])
+    try:
+        _wait_admitted(front, 1)
+        trace = telemetry.TraceContext.new()
+        code, doc, headers = _post(
+            front.url + "/v1/predict", {"model": "m1", "inputs": [[1]]},
+            headers={"traceparent": trace.to_traceparent()})
+        assert code == 200 and doc["replica"] == "m1"
+        # trace propagation: same trace_id reaches the replica on a
+        # CHILD span, and the client gets its own context echoed
+        upstream_tp = stub.last_headers.get("traceparent", "")
+        assert trace.trace_id in upstream_tp
+        assert upstream_tp != trace.to_traceparent()
+        assert headers.get("traceparent") == trace.to_traceparent()
+        assert stub.last_headers.get("x-forwarded-for")
+        reg = telemetry.get_registry()
+        assert reg.counter_total("veles_router_requests_total",
+                                 replica=stub.url, outcome="ok") == 1
+        # routed latency histogram observed the request
+        hist = fleet.parse_prometheus(
+            reg.render_prometheus())
+        assert fleet.metric_total(
+            hist, "veles_router_request_seconds_count") >= 1
+        # the router.proxy span carries the client's trace_id
+        spans = telemetry.tracer.flight_spans()
+        mine = [ev for _, ev in spans
+                if ev.get("name") == "router.proxy"
+                and ev.get("args", {}).get("trace_id")
+                == trace.trace_id]
+        assert mine and mine[-1]["args"]["replica"] == stub.url
+
+        # velescli top sees a router row with its backends
+        row = fleet.scrape_target(front.url, timeout=5.0)
+        assert row["role"] == "router"
+        assert [b["url"] for b in row["router"]["backends"]] \
+            == [stub.url]
+        rendered = fleet.render_snapshot(
+            fleet.fleet_snapshot([front.url]))
+        assert "router: 1/1 backend(s) admitted" in rendered
+    finally:
+        front.close()
+        controller.close()
+        stub.close()
+
+
+def test_router_failover_keeps_inflight_stream_and_stickiness():
+    a = StubReplica("a", tokens=15, token_interval=0.05)
+    b = StubReplica("b", tokens=15, token_interval=0.05)
+    controller, front = _mk_router([a, b])
+    try:
+        _wait_admitted(front, 2)
+
+        def generate(session):
+            req = urllib.request.Request(
+                front.url + "/v1/generate",
+                data=json.dumps({"model": "m",
+                                 "prompt": [1]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "x-veles-session": session})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return [json.loads(line) for line in resp
+                        if line.strip()]
+
+        # discover where the session sticks (consistent hash)
+        lines = generate("pin")
+        assert lines[-1].get("done") is True
+        sticky, other = (a, b) if a.generates else (b, a)
+        # same session -> same replica, repeatedly
+        for _ in range(3):
+            assert generate("pin")[-1]["replica"] == sticky.model
+        assert sticky.generates == 4 and other.generates == 0
+
+        # start a long-lived stream on the sticky replica, then flip
+        # the OTHER replica's readiness mid-stream
+        req = urllib.request.Request(
+            front.url + "/v1/generate",
+            data=json.dumps({"model": "m", "prompt": [1]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-veles-session": "pin"})
+        resp = urllib.request.urlopen(req, timeout=30)
+        other.ready = False
+        wait_until(lambda: any(
+            bk["state"] == EJECTED
+            for bk in _get(front.url + "/router/status")[1]["backends"]
+            if bk["url"] == other.url), what="readiness-flip ejection")
+        # new work drains to the survivor...
+        for _ in range(4):
+            code, doc, _ = _post(front.url + "/v1/predict",
+                                 {"model": "m", "inputs": [[1]]})
+            assert code == 200 and doc["replica"] == sticky.model
+        assert other.predicts == 0
+        # ...while the in-flight stream is NOT re-routed: it finishes
+        # on the replica it started on, token-complete
+        lines = [json.loads(line) for line in resp if line.strip()]
+        resp.close()
+        assert lines[-1].get("done") is True
+        assert lines[-1]["replica"] == sticky.model
+        assert sum(1 for ln in lines if "token" in ln) == 15
+        assert sticky.streams_completed >= 1
+
+        # ejection is observable: counter + flight-recorder event
+        assert telemetry.get_registry().counter_total(
+            "veles_router_ejections_total", reason="not_ready") >= 1
+        events = [e for e in telemetry.tracer.recent_events()
+                  if e["event"] == "router_failover"]
+        assert any(e.get("replica") == other.url for e in events)
+    finally:
+        front.close()
+        controller.close()
+        a.close()
+        b.close()
+
+
+def test_router_e2e_chaos_brownout_ejection_recovery_trace():
+    """The acceptance scenario: 2 replicas behind ``velescli route``'s
+    machinery, one browned out (BrownoutProxy latency + /readyz flip)
+    -> ejected within 2 control ticks, zero routed requests land on
+    it until recovery, one trace_id spans client -> router ->
+    replica, half-open probe re-admits after restore."""
+    a = StubReplica("a")
+    b = StubReplica("b")
+    proxy = BrownoutProxy(("127.0.0.1", a.server.port))
+    controller, front = _mk_router(
+        [proxy.url, b.url], interval=0.4, scrape_timeout=0.5)
+    try:
+        _wait_admitted(front, 2)
+        # brown out A: every byte now crawls AND readiness flips —
+        # the scrape sees a target that cannot answer in budget
+        ticks0 = _get(front.url + "/router/status")[1]["ticks"]
+        a.ready = False
+        proxy.brownout(2.0)
+        status = wait_until(
+            lambda: next(
+                (doc for doc in
+                 [_get(front.url + "/router/status")[1]]
+                 if any(bk["state"] == EJECTED
+                        for bk in doc["backends"]
+                        if bk["url"] == proxy.url)), None),
+            what="brownout ejection")
+        assert status["ticks"] - ticks0 <= 2, \
+            "ejection took %d tick(s)" % (status["ticks"] - ticks0)
+
+        # zero routed requests on the ejected replica, all on B —
+        # with the client's trace_id stitched through the proxy
+        a_before = a.predicts
+        trace = telemetry.TraceContext.new()
+        for _ in range(10):
+            code, doc, _ = _post(
+                front.url + "/v1/predict",
+                {"model": "m", "inputs": [[1]]},
+                headers={"traceparent": trace.to_traceparent()})
+            assert code == 200 and doc["replica"] == "b"
+        assert a.predicts == a_before
+        assert b.last_headers.get("traceparent", "").startswith(
+            "00-" + trace.trace_id)
+        span_doc = _get(front.url + "/debug/trace")[1]
+        mine = [ev for ev in span_doc["traceEvents"]
+                if ev.get("name") == "router.proxy"
+                and ev.get("args", {}).get("trace_id")
+                == trace.trace_id]
+        assert len(mine) == 10
+
+        # recovery: restore the pipe + readiness; the next healthy
+        # scrape half-opens A and ONE live request re-admits it
+        proxy.restore()
+        a.ready = True
+
+        def readmitted():
+            _post(front.url + "/v1/predict",
+                  {"model": "m", "inputs": [[1]]})
+            doc = _get(front.url + "/router/status")[1]
+            return all(bk["state"] == ADMITTED
+                       for bk in doc["backends"])
+        wait_until(readmitted, interval=0.1,
+                   what="half-open re-admission")
+        assert a.predicts > a_before        # traffic reached A again
+        events = [e["event"] for e in telemetry.tracer.recent_events()]
+        assert "router_readmit" in events
+    finally:
+        front.close()
+        controller.close()
+        proxy.close()
+        a.close()
+        b.close()
+
+
+def test_router_no_backend_503_and_drain_endpoint():
+    stub = StubReplica("only")
+    controller, front = _mk_router([stub])
+    try:
+        _wait_admitted(front, 1)
+        # operator drain: new requests stop, the router flips its own
+        # readiness (0 admitted backends)
+        code, doc, _ = _post(front.url + "/router/drain",
+                             {"url": stub.url})
+        assert code == 200 and doc["draining"] == stub.url
+        code, doc, headers = _post(front.url + "/v1/predict",
+                                   {"model": "m", "inputs": [[1]]})
+        assert code == 503 and "Retry-After" in headers
+        assert stub.predicts == 0
+        assert telemetry.get_registry().counter_total(
+            "veles_router_requests_total", outcome="no_backend") == 1
+
+        def router_not_ready():
+            code, doc, _ = _get(front.url + "/readyz")
+            return code == 503 and any(
+                "backend" in r for r in doc["reasons"])
+        wait_until(router_not_ready, what="router /readyz flip")
+        code, doc, _ = _post(front.url + "/router/drain",
+                             {"url": "http://unknown:1"})
+        assert code == 404
+    finally:
+        front.close()
+        controller.close()
+        stub.close()
+
+
+def test_host_port_parses_ipv6_literals():
+    from veles.router import _host_port
+    assert _host_port("http://127.0.0.1:9999") == ("127.0.0.1", 9999)
+    assert _host_port("http://[::1]:8080") == ("::1", 8080)
+    assert _host_port("http://replica") == ("replica", 80)
+
+
+def test_route_cli_parser_and_dry_run_wiring():
+    from veles.router import build_route_argparser
+    args = build_route_argparser().parse_args(
+        ["http://r1:8080", "http://r2:8080", "--port", "0",
+         "--autoscale", "1:4", "--dry-run", "--queue-high", "16"])
+    assert args.backends == ["http://r1:8080", "http://r2:8080"]
+    assert args.autoscale == "1:4" and args.dry_run
+    assert args.queue_high == 16.0
+    with pytest.raises(SystemExit):
+        build_route_argparser().parse_args([])   # backends required

@@ -49,6 +49,15 @@ the master's per-slave timing, and renders a refreshing terminal
 view — ``--json`` emits one machine-readable snapshot (the artifact
 a router/autoscaler consumes);
 
+    python -m veles route http://replica1:8080 http://replica2:8080
+
+fronts N serving replicas behind ONE address (``veles/router.py``):
+a reactor-hosted proxy whose least-queue/consistent-hash routing,
+eager failover (readiness flips, SLO burn-rate alerts, scrape
+timeouts) and optional autoscaling (``--autoscale MIN:MAX
+--scale-cmd ...``) are driven by the same health-plane scrapes
+``velescli top`` renders — see ``velescli route --help``;
+
     python -m veles profile http://host:port [--seconds N] [--out p.json]
 
 captures a live sampling-profiler window off a running master or
@@ -812,6 +821,11 @@ def main(argv=None):
         # health + metrics surfaces (veles/fleet.py)
         from veles.fleet import top_main
         return top_main(argv[1:])
+    if argv and argv[0] == "route":
+        # the fleet router/autoscaler tier (veles/router.py): one
+        # address in front of N replicas, steered by the health plane
+        from veles.router import route_main
+        return route_main(argv[1:])
     if argv and argv[0] == "profile":
         # sampling-profiler capture off a live process's
         # /debug/profile surface (veles/profiling.py)

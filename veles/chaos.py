@@ -320,3 +320,189 @@ class ChaosProxy(Logger):
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+# -- HTTP-aware brownouts (ISSUE 13) -----------------------------------
+
+
+class _Pipe(threading.Thread):
+    """One direction of one BrownoutProxy connection: copy bytes,
+    applying whatever degradation the proxy currently orders."""
+
+    def __init__(self, proxy, src, dst, direction, conn_id):
+        super().__init__(daemon=True,
+                         name="brownout-%s-%d" % (direction, conn_id))
+        self.proxy = proxy
+        self.src = src
+        self.dst = dst
+        self.direction = direction
+        self.conn_id = conn_id
+
+    def run(self):
+        try:
+            while not self.proxy._closing.is_set():
+                data = self.src.recv(65536)
+                if not data:
+                    break
+                delay = self.proxy.latency_s
+                if delay > 0:
+                    time.sleep(delay)
+                if self.proxy.black_hole:
+                    self.proxy._count_pipe(self.direction, len(data),
+                                           swallowed=True)
+                    continue
+                self.dst.sendall(data)
+                self.proxy._count_pipe(self.direction, len(data))
+        except OSError:
+            pass
+        finally:
+            self.proxy._sever(self.conn_id)
+
+
+class BrownoutProxy(Logger):
+    """Byte-level TCP degradation proxy for the HTTP planes.
+
+    :class:`ChaosProxy` speaks the framed master↔slave wire protocol;
+    this sibling is FRAME-AGNOSTIC — it forwards raw bytes, so it can
+    sit in front of a serving replica's (or router's) HTTP port and
+    brown it out deterministically:
+
+    * :meth:`brownout` — inject ``latency_s`` seconds before every
+      forwarded read (both directions): probes and proxied requests
+      through this target slow to a crawl, exactly the
+      sick-but-not-dead replica a router must eject on scrape
+      timeout rather than wait out;
+    * :meth:`set_black_hole` — swallow bytes entirely (connections
+      stay open, nothing ever answers — the wedged-process model);
+    * :meth:`restore` — back to a transparent pipe;
+    * :meth:`kill_all` — sever every live connection now.
+
+    All knobs are plain attribute flips read by the pump threads per
+    chunk, so a test can flip a healthy fleet into brownout (and
+    back) mid-scenario without touching the replica itself."""
+
+    def __init__(self, target, listen_host="127.0.0.1"):
+        self.name = "BrownoutProxy"
+        if isinstance(target, str):
+            # accept URL form too ('http://host:port' — the shape
+            # router/fleet targets and this proxy's own .url use)
+            target = target.split("://", 1)[-1].rstrip("/")
+            host, _, port = target.rpartition(":")
+        else:
+            host, port = target[0], target[1]
+        self.target = (host or "127.0.0.1", int(port))
+        #: per-chunk forwarding delay (seconds); pump threads read it
+        self.latency_s = 0.0
+        #: True -> swallow all bytes (connections wedge silently)
+        self.black_hole = False
+        self._lock = threading.Lock()
+        self._stats = {C2S: {"bytes": 0, "swallowed": 0},
+                       S2C: {"bytes": 0, "swallowed": 0}}
+        self._conns = {}
+        self._next_conn = 0
+        self._closing = threading.Event()
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, 0))
+        self._listener.listen()
+        self.port = self._listener.getsockname()[1]
+        self.address = "%s:%d" % (listen_host, self.port)
+        self.url = "http://%s" % self.address
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="brownout-accept")
+        self._accept_thread.start()
+
+    # -- control -------------------------------------------------------
+
+    def brownout(self, latency_s):
+        """Inject ``latency_s`` seconds per forwarded chunk."""
+        self.latency_s = float(latency_s)
+        return self
+
+    def set_black_hole(self, on=True):
+        """Swallow (True) or forward (False) all traffic."""
+        self.black_hole = bool(on)
+        return self
+
+    def restore(self):
+        """Back to a transparent pipe (latency 0, forwarding on)."""
+        self.latency_s = 0.0
+        self.black_hole = False
+        return self
+
+    # -- wiring --------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._closing.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                break
+            try:
+                upstream = socket.create_connection(self.target,
+                                                    timeout=10)
+            except OSError as exc:
+                self.warning("upstream %s unreachable: %s",
+                             self.target, exc)
+                client.close()
+                continue
+            # the connect timeout must not become a recv timeout: a
+            # black-holed connection has to WEDGE indefinitely (the
+            # documented model), not sever itself after 10s
+            upstream.settimeout(None)
+            with self._lock:
+                conn_id = self._next_conn
+                self._next_conn += 1
+                self._conns[conn_id] = (client, upstream)
+            _Pipe(self, client, upstream, C2S, conn_id).start()
+            _Pipe(self, upstream, client, S2C, conn_id).start()
+
+    def _sever(self, conn_id):
+        with self._lock:
+            pair = self._conns.pop(conn_id, None)
+        if pair:
+            for sock in pair:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _count_pipe(self, direction, n, swallowed=False):
+        with self._lock:
+            stats = self._stats[direction]
+            stats["swallowed" if swallowed else "bytes"] += n
+
+    # -- control / inspection ------------------------------------------
+
+    def kill_all(self):
+        """Sever every live proxied connection now."""
+        with self._lock:
+            conn_ids = list(self._conns)
+        for conn_id in conn_ids:
+            self._sever(conn_id)
+        return len(conn_ids)
+
+    def stats(self):
+        with self._lock:
+            return {"connections": self._next_conn,
+                    "live": len(self._conns),
+                    C2S: dict(self._stats[C2S]),
+                    S2C: dict(self._stats[S2C])}
+
+    def close(self):
+        self._closing.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.kill_all()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -24,6 +24,11 @@ import sys
 import time
 import urllib.error
 import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+#: scrape fan-out cap: enough to cover a rack of replicas in one
+#: wave without spawning a thread herd for a 200-target fleet
+MAX_SCRAPE_WORKERS = 16
 
 #: one Prometheus exposition sample line: name{labels} value
 _SAMPLE_RE = re.compile(
@@ -94,16 +99,43 @@ def _fetch_json(url, timeout):
     return code, json.loads(body)
 
 
-def scrape_target(base, timeout=5.0):
+def scrape_target(base, timeout=5.0, total=None, extras=True):
     """Poll one process's health surfaces; -> its merged row dict.
-    ``base`` is ``http://host:port`` of a web-status dashboard or a
-    serving frontend."""
+    ``base`` is ``http://host:port`` of a web-status dashboard, a
+    serving frontend or a router.
+
+    ``total`` caps the WHOLE scrape of this target (default
+    ``2 x timeout``): every individual fetch waits at most the
+    remaining budget, and once it is spent the later surfaces are
+    skipped (``row["partial"] = True``) instead of queueing behind a
+    wedged peer — the bound a router control loop on this path needs
+    (ISSUE 13). ``extras=False`` skips the heavyweight optional
+    surfaces (``/metrics.json``, ``/status.json``, critical path,
+    router status) for tight control-loop scrapes."""
     base = base.rstrip("/")
     if "://" not in base:
         base = "http://" + base
+    deadline = time.monotonic() + (2.0 * timeout if total is None
+                                   else max(float(total), 0.05))
+
+    def budget():
+        """Remaining per-fetch wait: the request timeout, clamped to
+        the target's whole-scrape budget (<= 0 once it is spent)."""
+        return min(timeout, deadline - time.monotonic())
+
     row = {"url": base, "reachable": False}
+
+    def spent():
+        """True (and the row marked partial) once the whole-scrape
+        budget is gone — 'slow target, scrape truncated' must stay
+        distinguishable from 'target has no such surface'."""
+        if budget() <= 0:
+            row["partial"] = True
+            return True
+        return False
+
     try:
-        code, body = _fetch(base + "/healthz", timeout)
+        code, body = _fetch(base + "/healthz", max(budget(), 0.05))
     except Exception as exc:
         row["error"] = "%s: %s" % (type(exc).__name__, exc)
         return row
@@ -117,19 +149,28 @@ def scrape_target(base, timeout=5.0):
     except ValueError:
         row["healthz"] = None
     try:
-        code, doc = _fetch_json(base + "/readyz", timeout)
+        if spent():
+            raise TimeoutError("scrape budget spent")
+        code, doc = _fetch_json(base + "/readyz", budget())
         row["ready"] = code == 200
         row["reasons"] = list(doc.get("reasons", ()))
         row["checks"] = doc.get("checks", {})
         row["slos"] = doc.get("slos", {})
     except Exception:
+        spent()      # a fetch that DIED on the budget marks partial
         row["ready"] = None          # pre-health-plane process
         row["reasons"] = []
         row["slos"] = {}
     try:
-        _, body = _fetch(base + "/metrics", timeout)
+        if spent():
+            raise TimeoutError("scrape budget spent")
+        _, body = _fetch(base + "/metrics", budget())
         metrics = parse_prometheus(body.decode("utf-8", "replace"))
     except Exception:
+        # mark truncation when the budget died MID-fetch too: a
+        # consumer must never read "metrics absent" (gauges reset)
+        # for what was really "metrics unreadable in budget"
+        spent()
         metrics = {}
     row["firing"] = sorted(
         dict(items).get("objective", "?")
@@ -177,9 +218,28 @@ def scrape_target(base, timeout=5.0):
         if v is not None:
             summary[key] = v
     row["metrics"] = summary
+    if not extras:
+        # control-loop scrapes target serving replicas: skip the
+        # optional surfaces INCLUDING /router/status (a guaranteed
+        # 404 round trip per replica per tick otherwise)
+        row["role"] = "process"
+        return row
+    # the router tier (ISSUE 13): a routing process answers
+    # /router/status with its per-backend control-plane state
+    try:
+        if spent():
+            raise TimeoutError("scrape budget spent")
+        code, doc = _fetch_json(base + "/router/status", budget())
+        if code == 200 and isinstance(doc, dict) \
+                and isinstance(doc.get("backends"), list):
+            row["router"] = doc
+    except Exception:
+        pass
     # serving side: the per-model JSON view (rps, p99, queue, shed)
     try:
-        code, doc = _fetch_json(base + "/metrics.json", timeout)
+        if spent():
+            raise TimeoutError("scrape budget spent")
+        code, doc = _fetch_json(base + "/metrics.json", budget())
         if code == 200 and isinstance(doc, dict) \
                 and isinstance(doc.get("models"), dict):
             row["serving"] = doc["models"]
@@ -188,7 +248,9 @@ def scrape_target(base, timeout=5.0):
     # training side: the dashboard's status providers — the master's
     # row carries cluster topology + per-slave last-job timing
     try:
-        code, doc = _fetch_json(base + "/status.json", timeout)
+        if spent():
+            raise TimeoutError("scrape budget spent")
+        code, doc = _fetch_json(base + "/status.json", budget())
         if code == 200 and isinstance(doc, dict):
             row["status"] = doc
             for st in doc.values():
@@ -207,22 +269,52 @@ def scrape_target(base, timeout=5.0):
     # goes, per leg — a 404 from a pre-PR-10 target degrades the row,
     # never errors it
     try:
+        if spent():
+            raise TimeoutError("scrape budget spent")
         code, doc = _fetch_json(
-            base + "/debug/critical_path?window=120", timeout)
+            base + "/debug/critical_path?window=120", budget())
         if code == 200 and isinstance(doc, dict) \
                 and ("train" in doc or "serving" in doc):
             row["critical_path"] = doc
     except Exception:
         pass
-    row["role"] = "master" if "master" in row else (
-        "serving" if "serving" in row else "process")
+    row["role"] = "router" if "router" in row else (
+        "master" if "master" in row else (
+            "serving" if "serving" in row else "process"))
     return row
+
+
+def scrape_targets(targets, timeout=5.0, total=None, extras=True,
+                   workers=None, pool=None):
+    """Scrape every target CONCURRENTLY (thread-pool fan-out, one
+    row per target in input order). With the per-target ``total``
+    budget inside :func:`scrape_target` this bounds the whole wave
+    by the slowest single target instead of the sum — one wedged
+    replica used to stall every ``velescli top`` refresh behind it,
+    which is fatal for a router control loop on the same path
+    (ISSUE 13 satellite). A periodic caller (the router's control
+    loop) passes its own long-lived ``pool`` instead of paying
+    thread churn every tick."""
+    targets = list(targets)
+    if not targets:
+        return []
+
+    def one(t):
+        return scrape_target(t, timeout=timeout, total=total,
+                             extras=extras)
+
+    if pool is not None:
+        return list(pool.map(one, targets))
+    workers = workers or min(len(targets), MAX_SCRAPE_WORKERS)
+    with ThreadPoolExecutor(max_workers=workers,
+                            thread_name_prefix="fleet-scrape") as own:
+        return list(own.map(one, targets))
 
 
 def fleet_snapshot(targets, timeout=5.0):
     """Scrape every target; -> the merged fleet document (what
     ``velescli top --json`` prints and an autoscaler consumes)."""
-    rows = [scrape_target(t, timeout=timeout) for t in targets]
+    rows = scrape_targets(targets, timeout=timeout)
     firing = sorted({name for r in rows
                      for name in r.get("firing", ())})
     degraded = sorted(
@@ -301,6 +393,26 @@ def render_snapshot(snap):
         detail = []
         if not row.get("reachable"):
             detail.append(row.get("error", "unreachable"))
+        router = row.get("router")
+        if isinstance(router, dict):
+            backends = router.get("backends") or []
+            admitted = sum(1 for b in backends
+                           if b.get("state") == "admitted")
+            detail.append("router: %d/%d backend(s) admitted"
+                          % (admitted, len(backends)))
+            bad = ["%s (%s)" % (b.get("url", "?").replace(
+                       "http://", ""), b.get("reason") or b.get(
+                       "state"))
+                   for b in backends
+                   if b.get("state") not in ("admitted", None)]
+            if bad:
+                detail.append("out: " + ", ".join(bad))
+            scaler = router.get("autoscaler")
+            if isinstance(scaler, dict) and scaler.get("last"):
+                last = scaler["last"]
+                detail.append("autoscale %s @%s"
+                              % (last.get("direction"),
+                                 last.get("url", "-")))
         master = row.get("master")
         if master:
             detail.append("epoch %s/%s, %s slave(s)"

@@ -75,6 +75,11 @@ DEFAULT_PREFIXES = (
     # ring-sampled so /metrics/history carries memory TRAJECTORIES
     # and SLO objectives can fire on leaks
     "veles_host_", "veles_device_", "veles_perf_",
+    # fleet control (ISSUE 13, veles/router.py): routed-request
+    # counters/latency and backend inflight — ring-sampled so SLO
+    # objectives can fire on router-observed p99 and the autoscaler's
+    # own decisions are trendable in /metrics/history
+    "veles_router_",
 )
 
 #: sampler cadence (seconds) and ring capacity: 1 Hz x 900 samples =

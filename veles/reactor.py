@@ -682,6 +682,16 @@ class HttpRequest:
         self.headers = headers
         self.body = body
 
+    @property
+    def remote_addr(self):
+        """``host:port`` of the requesting peer (what a proxy tier
+        writes into ``X-Forwarded-For``), or None for non-INET
+        sockets (tests use socketpairs)."""
+        peer = getattr(self.conn, "peer", None)
+        if isinstance(peer, tuple) and len(peer) >= 2:
+            return "%s:%s" % (peer[0], peer[1])
+        return None
+
     def reply(self, code, body, ctype="text/plain", headers=()):
         if isinstance(body, str):
             body = body.encode()
@@ -759,6 +769,11 @@ class HttpConnection(Connection):
     def __init__(self, reactor, sock, handler, server=None):
         self._handler = handler
         self._server = server
+        try:
+            #: peer address as accepted — read by HttpRequest.remote_addr
+            self.peer = sock.getpeername()
+        except OSError:
+            self.peer = None
         self._buf = bytearray()
         self._head = None               # (method, path, headers)
         self._need_body = 0
