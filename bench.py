@@ -613,7 +613,8 @@ def lm_345m_tokens_per_sec(measure_chunks=3):
                           measure_chunks)
 
 
-def serving_throughput_rps(duration=0.6, clients=8):
+def serving_throughput_rps(duration=0.6, clients=8,
+                           quantize="none"):
     """Inference-path row (ISSUE 1): requests/sec through the
     veles.serving micro-batcher, IN PROCESS (no sockets — this
     measures batching + forward dispatch, not HTTP parsing).
@@ -623,12 +624,17 @@ def serving_throughput_rps(duration=0.6, clients=8):
     row runs, and means the same thing, with or without a TPU) and
     hammers it from ``clients`` threads of single-sample requests —
     the serving shape where dynamic batching is the whole game.
-    -> (requests/sec, batch_fill_ratio)."""
+    ``quantize`` prices the at-rest weight-quantized deployment
+    (ISSUE 14): same load, int8/fp8 params densified per dispatch.
+    -> (requests/sec, batch_fill_ratio, forward_cache_bytes) — the
+    cache figure read from the SAME ``veles_serving_forward_cache_
+    bytes`` gauge a /metrics scrape of the process would see."""
     import tempfile
     import threading
     import numpy
     import veles.prng as prng
     prng.seed_all(99)
+    from veles import telemetry
     from veles.config import root
     from veles.serving import ModelRegistry
     from veles.znicz_tpu.models import mnist
@@ -642,7 +648,8 @@ def serving_throughput_rps(duration=0.6, clients=8):
         with tempfile.TemporaryDirectory() as tmp:
             wf.export_inference(tmp)
             registry = ModelRegistry(backend="numpy", max_batch=64,
-                                     max_queue=4096, max_wait_ms=1.0)
+                                     max_queue=4096, max_wait_ms=1.0,
+                                     quantize_weights=quantize)
             try:
                 # a failed warm/predict used to skip the close and
                 # leak the registry's batcher threads for the rest
@@ -651,6 +658,9 @@ def serving_throughput_rps(duration=0.6, clients=8):
                 x = wf.loader.original_data.mem[:1].astype(
                     numpy.float32)
                 entry.predict(x)                  # warm
+                cache_bytes = telemetry.get_registry().gauge(
+                    "veles_serving_forward_cache_bytes",
+                    labels=("model",)).labels("mnist").value
                 stop = time.perf_counter() + duration
                 counts = [0] * clients
 
@@ -670,7 +680,7 @@ def serving_throughput_rps(duration=0.6, clients=8):
                 fill = entry.batcher.metrics()["batch_fill_ratio"]
             finally:
                 registry.close()
-        return sum(counts) / dt, fill
+        return sum(counts) / dt, fill, cache_bytes
     finally:
         root.mnist.loader.update(saved)
 
@@ -859,11 +869,76 @@ def _serving_row(extra):
     key, never in the exit code (the row must not cost TPU-less runs
     their rc 0)."""
     try:
-        rps, fill = serving_throughput_rps()
+        rps, fill, cache = serving_throughput_rps()
         extra["serving_throughput_rps"] = round(rps, 1)
         extra["serving_batch_fill_ratio"] = round(fill, 3)
+        extra["serving_cache_bytes_f32"] = int(cache)
     except Exception as exc:
         extra["serving_throughput_rps_error"] = str(exc)[:200]
+
+
+def _quantized_serving_rows(extra):
+    """ISSUE 14 acceptance rows: the SAME serving load with int8
+    at-rest weights — requests/sec (quantized-vs-f32 throughput as a
+    tracked pair; the numpy backend prices the per-dispatch dequant,
+    an accelerator fuses it) and the forward-cache shrink, read from
+    the same ``veles_serving_forward_cache_bytes`` gauge the runtime
+    exports (acceptance: ≤ 55% of the f32 figure). Directionality:
+    rps down = bad, bytes up = bad."""
+    try:
+        rps, _, cache = serving_throughput_rps(quantize="int8")
+        extra["serving_throughput_rps_int8"] = round(rps, 1)
+        extra["serving_cache_bytes_int8"] = int(cache)
+    except Exception as exc:
+        # both rows vanish together, so both carry the _error key the
+        # trajectory tooling looks for next to a missing row
+        extra["serving_throughput_rps_int8_error"] = str(exc)[:200]
+        extra["serving_cache_bytes_int8_error"] = str(exc)[:200]
+
+
+def bias_grad_step_seconds(n=65536, k=96, reps=10):
+    """ISSUE 14 tentpole row: wall seconds of ONE bias-gradient
+    dispatch — relu-derivative mask + f32-accumulating reduction over
+    ``n`` batch·space rows × ``k`` channels (a conv1-class shape) —
+    through the hand-fused Pallas kernel on a real TPU
+    (ops/pallas_grads.py — what the ``fused_bias_grad`` hatch
+    dispatches once $VELES_FUSED_BIAS_GRAD=1), the plain masked
+    matvec elsewhere (interpret-mode Pallas would time the emulator,
+    not the kernel). Scalar readback is the sync point;
+    the median of ``reps`` timed calls is returned, so the row is
+    comparable round over round per environment."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    from veles.znicz_tpu.ops import pallas_grads as PG
+
+    gen = numpy.random.Generator(numpy.random.PCG64(17))
+    on_tpu = PG._on_tpu()
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    err = jnp.asarray(gen.standard_normal((n, k), numpy.float32), dt)
+    y = jnp.asarray(gen.standard_normal((n, k), numpy.float32), dt)
+    if on_tpu:
+        fn = jax.jit(lambda e, yy: PG.bias_grad(e, yy, "strict_relu"))
+    else:
+        def plain(e, yy):
+            dz = e * (yy > 0).astype(e.dtype)
+            return dz.sum(axis=0, dtype=jnp.float32)
+        fn = jax.jit(plain)
+    float(fn(err, y).sum())                 # compile + warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(fn(err, y).sum())             # readback = sync
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def _bias_grad_row(extra):
+    try:
+        extra["bias_grad_step_seconds"] = round(
+            bias_grad_step_seconds(), 6)
+    except Exception as exc:
+        extra["bias_grad_step_seconds_error"] = str(exc)[:200]
 
 
 def _lm_decode_export(tmp):
@@ -1035,6 +1110,13 @@ def _device_reachable(timeout_s=240):
 #: bigger wins
 _LOWER_BETTER = ("bytes", "overhead", "latency", "seconds", "p99")
 
+#: keys where BIGGER is better EVEN IF a lower-better substring ever
+#: lands in the same key: an MFU ratio is a utilization figure, down
+#: = bad, and an MFU regression must be flagged in its own right —
+#: not only via the throughput row it was derived from (ISSUE 14
+#: satellite; covered by the directionality fixture in test_health)
+_HIGHER_BETTER = ("mfu",)
+
 #: keys that are environment stamps, not performance rows
 _SELF_CHECK_SKIP = ("calibration",)
 
@@ -1116,7 +1198,8 @@ def self_check(report, threshold_pct=10.0, baseline_path=None,
         if was == 0:
             continue
         pct = (now - was) / abs(was) * 100.0
-        lower_better = any(s in key for s in _LOWER_BETTER)
+        lower_better = (not any(s in key for s in _HIGHER_BETTER)
+                        and any(s in key for s in _LOWER_BETTER))
         bad = pct > threshold_pct if lower_better \
             else pct < -threshold_pct
         flag = "  << REGRESSION" if bad else ""
@@ -1173,6 +1256,8 @@ def main(argv=None):
         # report them so those trajectories survive tunnel outages
         extra = {"device_error": detail[:300]}
         _serving_row(extra)
+        _quantized_serving_rows(extra)
+        _bias_grad_row(extra)
         _routed_rows(extra)
         _generate_rows(extra)
         _grad_codec_rows(extra)
@@ -1226,6 +1311,12 @@ def main(argv=None):
             lm_base_s8k_tokens_per_sec)
     _record(extra, "lm_345M_tokens_per_sec", lm_345m_tokens_per_sec)
     _serving_row(extra)
+    # int8 at-rest weights: quantized-vs-f32 rps + the cache shrink
+    # (ISSUE 14; gauge-sourced, acceptance <= 55% of f32)
+    _quantized_serving_rows(extra)
+    # one bias-grad dispatch at a conv1-class shape through the
+    # fused_bias_grad auto path (ISSUE 14; up = bad)
+    _bias_grad_row(extra)
     # direct vs routed RPS + brownout p99 through the router tier
     # (ISSUE 13; proxy overhead and failover quality as trajectories)
     _routed_rows(extra)
@@ -1243,6 +1334,12 @@ def main(argv=None):
     for row in LM_ROWS:
         _mfu(extra, "lm_%s_tokens_per_sec" % row, "lm_%s_mfu" % row,
              row)
+    # the ROADMAP-item-3 headline under its canonical name: the
+    # transformer-base long-context MFU (the ~35%-at-S=8192 gap this
+    # arc attacks), duplicated from the per-row key so the trajectory
+    # has ONE stable handle across config retunes (down = bad)
+    if "lm_110M_s8k_mfu" in extra:
+        extra["lm_mfu_s8192"] = extra["lm_110M_s8k_mfu"]
     try:
         # calibration AGAIN at the end: a large start/end gap flags a
         # tunnel phase change mid-run (BASELINE.md r4 variance note)

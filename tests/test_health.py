@@ -534,6 +534,10 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                 "profiler_overhead_pct": 1.0,
                 "generate_tokens_per_sec_continuous": 4000.0,
                 "generate_first_token_latency_s": 0.01,
+                "lm_mfu_s8192": 0.35,
+                "bias_grad_step_seconds": 0.002,
+                "serving_cache_bytes_int8": 200000,
+                "serving_throughput_rps_int8": 3000.0,
                 "some_row_error": "boom",
             }}}
     path = tmp_path / "BENCH_r07.json"
@@ -554,6 +558,15 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
             # latency UP are the bad directions
             "generate_tokens_per_sec_continuous": 2000.0,  # -50%: bad
             "generate_first_token_latency_s": 0.05,        # +400%: bad
+            # ISSUE 14: an MFU ratio is a utilization figure — DOWN
+            # is bad (explicitly in bench._HIGHER_BETTER, immune to
+            # any lower-better substring); kernel step seconds and
+            # the quantized cache footprint are costs — UP is bad;
+            # quantized serving rps is throughput — DOWN is bad
+            "lm_mfu_s8192": 0.20,                          # -43%: bad
+            "bias_grad_step_seconds": 0.004,               # +100%: bad
+            "serving_cache_bytes_int8": 400000,            # +100%: bad
+            "serving_throughput_rps_int8": 3300.0,         # +10%: fine
         }}
     regressed = bench.self_check(report, threshold_pct=10.0,
                                  baseline_path=str(path))
@@ -566,7 +579,10 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                               "dist_scaling_efficiency_n2",
                               "profiler_overhead_pct",
                               "generate_tokens_per_sec_continuous",
-                              "generate_first_token_latency_s"}
+                              "generate_first_token_latency_s",
+                              "lm_mfu_s8192",
+                              "bias_grad_step_seconds",
+                              "serving_cache_bytes_int8"}
     assert "REGRESSION" in err and "warn-only" in err
     assert "_best" not in err.split("rows in baseline")[0]
     # no baseline -> a note, no crash, nothing regressed

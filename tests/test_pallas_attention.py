@@ -130,6 +130,144 @@ def test_pallas_unequal_blocks(bq, bk, causal):
                  numpy.abs(numpy.asarray(g) - numpy.asarray(r)).max())
 
 
+@pytest.mark.parametrize("case", CASES, ids=lambda c: str(c))
+def test_pallas_fwd_pipelined_matches_resident(case):
+    """The DMA-pipelined forward (K/V in HBM, double-buffered block
+    scratch) is a pure data-movement change: out and lse must match
+    the resident-rows kernel to float tolerance."""
+    q, k, v = _qkv(case["s"])
+    out_ref, lse_ref = PA.flash_attention_fwd(
+        q, k, v, causal=case["causal"], block_q=case["block"],
+        block_k=case["block"], interpret=True)
+    out, lse = PA.flash_attention_fwd(
+        q, k, v, causal=case["causal"], block_q=case["block"],
+        block_k=case["block"], interpret=True, pipeline=True)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(out_ref),
+                          atol=2e-5), \
+        numpy.abs(numpy.asarray(out) - numpy.asarray(out_ref)).max()
+    assert numpy.allclose(numpy.asarray(lse), numpy.asarray(lse_ref),
+                          atol=2e-5)
+
+
+@pytest.mark.parametrize("bq,bk", [(32, 16), (16, 32)],
+                         ids=["bq>bk", "bq<bk"])
+def test_pallas_fwd_pipelined_unequal_blocks(bq, bk):
+    """Unequal tiles stress the pipelined loop's causal bound (hi =
+    cdiv over block_k while the DMA window is block_k-sized)."""
+    q, k, v = _qkv(64)
+    out_ref, lse_ref = flash.blocked_attention_fwd(
+        q, k, v, causal=True, block=16)
+    out, lse = PA.flash_attention_fwd(
+        q, k, v, causal=True, block_q=bq, block_k=bk,
+        interpret=True, pipeline=True)
+    assert numpy.allclose(numpy.asarray(out), numpy.asarray(out_ref),
+                          atol=2e-5)
+    assert numpy.allclose(numpy.asarray(lse), numpy.asarray(lse_ref),
+                          atol=2e-5)
+
+
+def test_pallas_fwd_bf16_accumulate_numerics_gate():
+    """THE gate for the bf16-accumulation experiment: against the f32-
+    accumulated reference the output error must stay within the bf16
+    input-rounding regime (~2^-8 relative on O(1) softmax-weighted
+    averages), and the lse — whose statistics deliberately stay f32 —
+    must remain exact. If a kernel change ever narrows the softmax
+    chain too, this is the test that fires."""
+    import jax.numpy as jnp
+    q, k, v = _qkv(128, b=2, h=2, dh=16)
+    for causal in (True, False):
+        ref, lse_ref = PA.flash_attention_fwd(
+            q, k, v, causal=causal, block_q=32, block_k=32,
+            interpret=True)
+        out, lse = PA.flash_attention_fwd(
+            q, k, v, causal=causal, block_q=32, block_k=32,
+            interpret=True, acc_dtype=jnp.bfloat16)
+        err = numpy.abs(numpy.asarray(out) - numpy.asarray(ref)).max()
+        assert err < 1.5e-2, err          # bf16 accumulation regime
+        assert err > 0.0                  # the variant really ran
+        assert numpy.allclose(numpy.asarray(lse),
+                              numpy.asarray(lse_ref), atol=2e-5)
+
+
+def test_attention_unit_pipelined_path():
+    """attn_pipeline=True through the unit: forward matches the dense
+    numpy oracle and the backward (which reads the cached out/lse —
+    layout unchanged by the pipelined forward) still agrees."""
+    wf, feed, fwd, gd, x, err, comp = build(
+        MultiHeadAttention, input_shape=(2, 32, 16), gd_kwargs={},
+        heads=2, attn_impl="pallas", attn_block_size=16,
+        attn_pipeline=True)
+    golden = numpy.array(fwd.output.mem)
+    params0 = comp.gather_params()
+    state0 = comp.gather_state()
+    y = xla_forward(comp, feed, fwd, params0, x)
+    assert numpy.allclose(numpy.asarray(y), golden, atol=3e-5)
+    gd.numpy_run()
+    ei_np = numpy.array(gd.err_input.mem)
+    ei_x, _ = xla_backward(comp, feed, fwd, gd, params0, state0,
+                           x, err)
+    assert numpy.allclose(ei_np, numpy.asarray(ei_x), atol=3e-4)
+
+
+def test_attention_unit_bf16_acc_path():
+    """attn_acc='bf16' through the unit, forward AND backward: the
+    experimental arm's gradients must stay within the bf16-acc
+    numerics regime of the dense numpy oracle — a forward-only gate
+    would let a backward-side regression ship on exactly the A/B run
+    the knob exists for (the backward consumes the bf16-accumulated
+    out/lse via delta = rowsum(dout*out))."""
+    wf, feed, fwd, gd, x, err, comp = build(
+        MultiHeadAttention, input_shape=(2, 32, 16), gd_kwargs={},
+        heads=2, attn_impl="pallas", attn_block_size=16,
+        attn_acc="bf16")
+    golden = numpy.array(fwd.output.mem)
+    params0 = comp.gather_params()
+    state0 = comp.gather_state()
+    y = xla_forward(comp, feed, fwd, params0, x)
+    assert numpy.allclose(numpy.asarray(y), golden, atol=2e-2)
+    gd.numpy_run()
+    ei_np = numpy.array(gd.err_input.mem)
+    ei_x, params1 = xla_backward(comp, feed, fwd, gd, params0, state0,
+                                 x, err)
+    assert numpy.allclose(ei_np, numpy.asarray(ei_x), atol=2e-2), \
+        numpy.abs(ei_np - numpy.asarray(ei_x)).max()
+    for pname in fwd.PARAMS:
+        w1_np = getattr(fwd, pname).map_read().mem
+        w1_x = numpy.asarray(params1[fwd.name][pname])
+        assert numpy.allclose(w1_np, w1_x, atol=3e-2), pname
+
+
+def test_attention_unit_rejects_bad_attn_acc():
+    from veles.workflow import Workflow
+    wf = Workflow(None, name="wf-acc")
+    with pytest.raises(ValueError):
+        MultiHeadAttention(wf, heads=2, attn_acc="fp64")
+
+
+def test_attention_unit_rejects_inert_fwd_experiments():
+    """attn_pipeline/attn_acc='bf16' on a dispatch that resolves to
+    any non-pallas mode (dense/scan/ring) must raise loudly (like
+    transformer_lm's stacked guard), never run the other kernel with
+    a silently inert knob — the worst failure mode for an A/B."""
+    from veles.workflow import Workflow
+    wf = Workflow(None, name="wf-inert")
+    for kwargs in ({"attn_pipeline": True}, {"attn_acc": "bf16"}):
+        dense = MultiHeadAttention(wf, heads=2, **kwargs)
+        with pytest.raises(ValueError, match="pallas"):
+            dense._traced_mode(None, 32)
+        scan = MultiHeadAttention(wf, heads=2, attn_impl="scan",
+                                  attn_block_size=16, **kwargs)
+        with pytest.raises(ValueError, match="pallas"):
+            scan._traced_mode(None, 32)
+        ring = MultiHeadAttention(wf, heads=2, **kwargs)
+        ring.seq_mesh = object()
+        with pytest.raises(ValueError, match="pallas"):
+            ring._traced_mode(None, 32)
+        # attn_acc='f32' is the explicit default, not an experiment
+        MultiHeadAttention(wf, heads=2,
+                           attn_acc="f32")._traced_mode(None, 32)
+
+
 def test_attention_unit_pallas_path():
     """The unit with attn_impl='pallas': traced forward and backward
     must match the dense numpy oracle (different formulation, same

@@ -1,0 +1,165 @@
+"""Hand-fused Pallas bias-grad kernel (ops/pallas_grads.py):
+exactness pins against the reference ``dz.sum(axis=0)`` math
+(interpret mode on CPU; the same kernel runs natively on TPU), and the
+``fused_bias_grad`` escape hatch through the dense and conv GD units
+at the existing gd tolerances."""
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles.znicz_tpu.ops import activations as A
+from veles.znicz_tpu.ops import pallas_grads as PG
+from veles.znicz_tpu.ops.all2all import All2AllTanh
+from veles.znicz_tpu.ops.conv import ConvRELU
+
+from tests.test_conv_stack import build, xla_backward
+
+
+def _ref(err, y, act):
+    d = A.ACTIVATIONS[act][1](numpy, y)
+    dz = err if isinstance(d, float) else err * d
+    return dz.sum(axis=0, dtype=numpy.float32)
+
+
+@pytest.mark.parametrize("act", sorted(A.ACTIVATIONS))
+@pytest.mark.parametrize("shape", [(128, 96), (96, 7), (100, 5)],
+                         ids=str)
+def test_kernel_matches_reference(act, shape):
+    """Every activation derivative in the shared table, over
+    tile-friendly AND awkward (non-pow2 rows / narrow K) shapes —
+    the boundary blocks of the fixed tile are masked in-kernel,
+    never a wrong answer."""
+    import jax.numpy as jnp
+    prng.seed_all(77)
+    gen = prng.get("pg")
+    err = gen.normal(0, 1.0, shape).astype(numpy.float32)
+    y = gen.normal(0, 1.0, shape).astype(numpy.float32)
+    got = numpy.asarray(PG.bias_grad(jnp.asarray(err),
+                                     jnp.asarray(y), act))
+    ref = _ref(err, y, act)
+    assert got.shape == ref.shape
+    assert numpy.allclose(got, ref, atol=2e-4), \
+        (act, numpy.abs(got - ref).max())
+
+
+def test_kernel_bf16_inputs_f32_accumulate():
+    """bf16 err/y (the TPU storage dtype): the kernel converts and
+    accumulates in f32 — the whole point — so the result sits within
+    bf16 input-rounding error of the f32 reference, not within bf16
+    ACCUMULATION error (which at 4096 rows would be ~100x larger)."""
+    import jax.numpy as jnp
+    prng.seed_all(78)
+    gen = prng.get("pg16")
+    err = gen.normal(0, 1.0, (4096, 32)).astype(numpy.float32)
+    y = gen.normal(0, 1.0, (4096, 32)).astype(numpy.float32)
+    eb = jnp.asarray(err, jnp.bfloat16)
+    yb = jnp.asarray(y, jnp.bfloat16)
+    got = numpy.asarray(PG.bias_grad(eb, yb, "strict_relu"))
+    assert got.dtype == numpy.float32
+    ref = _ref(numpy.asarray(eb, numpy.float32),
+               numpy.asarray(yb, numpy.float32), "strict_relu")
+    assert numpy.allclose(got, ref, atol=2e-3), \
+        numpy.abs(got - ref).max()
+
+
+def test_kernel_awkward_row_count_masked_boundary():
+    """Row counts with few factors of two (exactly the B·oy·ox conv
+    shapes the hatch feeds, e.g. 2700 = 2^2·3^3·5^2) ride the fixed
+    512-row tile with an in-kernel mask on the ceil-div boundary
+    block — never a degenerate pow2-divisor tile — and stay exact."""
+    import jax.numpy as jnp
+    prng.seed_all(79)
+    gen = prng.get("pg-awkward")
+    err = gen.normal(0, 1.0, (2700, 16)).astype(numpy.float32)
+    y = gen.normal(0, 1.0, (2700, 16)).astype(numpy.float32)
+    for act in ("strict_relu", "linear"):
+        got = numpy.asarray(PG.bias_grad(jnp.asarray(err),
+                                         jnp.asarray(y), act))
+        ref = _ref(err, y, act)
+        assert numpy.allclose(got, ref, atol=1e-3), \
+            (act, numpy.abs(got - ref).max())
+
+
+def test_kernel_wide_k_tiles_channels():
+    """K beyond the 1024-channel tile (the vocab-wide dense-layer
+    case that must NOT claim K·block_n VMEM in one grid step): the
+    channel axis rides its own grid dimension — including a K that
+    the tile does not divide, whose boundary garbage lands only in
+    dropped out-of-bounds output columns."""
+    import jax.numpy as jnp
+    prng.seed_all(80)
+    gen = prng.get("pg-wide")
+    for k in (4096, 3000):
+        err = gen.normal(0, 1.0, (96, k)).astype(numpy.float32)
+        y = gen.normal(0, 1.0, (96, k)).astype(numpy.float32)
+        for act in ("strict_relu", "linear"):
+            got = numpy.asarray(PG.bias_grad(jnp.asarray(err),
+                                             jnp.asarray(y), act))
+            assert got.shape == (k,)
+            ref = _ref(err, y, act)
+            assert numpy.allclose(got, ref, atol=1e-3), \
+                (k, act, numpy.abs(got - ref).max())
+
+
+def test_kernel_rejects_bad_inputs():
+    import jax.numpy as jnp
+    x = jnp.zeros((8, 4), jnp.float32)
+    with pytest.raises(KeyError):
+        PG.bias_grad(x, x, "no_such_activation")
+    with pytest.raises(ValueError):
+        PG.bias_grad(x, jnp.zeros((8, 5), jnp.float32), "linear")
+    with pytest.raises(ValueError):
+        PG.bias_grad(x, x, "linear", block_n=3)
+
+
+@pytest.mark.parametrize("cls,kwargs", [
+    (ConvRELU, dict(n_kernels=5, kx=3, ky=3, padding=2, sliding=3)),
+    (All2AllTanh, dict(output_sample_shape=(7,))),
+], ids=lambda v: getattr(v, "__name__", "cfg"))
+def test_gd_unit_fused_matches_oracle(cls, kwargs):
+    """fused_bias_grad=True (forced through interpret mode on CPU):
+    the traced backward's bias update must match the numpy oracle at
+    the existing gd tolerances — and stay leaf-identical to the plain
+    path on every OTHER parameter (the hatch touches only the bias
+    reduction)."""
+    shape = (2, 7, 6, 3) if cls is ConvRELU else (16, 12)
+    wf, feed, fwd, gd, x, err, comp = build(
+        cls, input_shape=shape,
+        gd_kwargs={"fused_bias_grad": True}, **kwargs)
+    params0 = comp.gather_params()
+    state0 = comp.gather_state()
+    gd.numpy_run()
+    b_np = fwd.bias.map_read().mem.copy()
+    w_np = fwd.weights.map_read().mem.copy()
+    ei_x, params1 = xla_backward(comp, feed, fwd, gd, params0, state0,
+                                 x, err)
+    assert numpy.allclose(
+        b_np, numpy.asarray(params1[fwd.name]["bias"]), atol=3e-4), \
+        numpy.abs(b_np - numpy.asarray(params1[fwd.name]["bias"])).max()
+    assert numpy.allclose(
+        w_np, numpy.asarray(params1[fwd.name]["weights"]), atol=3e-4)
+
+
+def test_gd_unit_fused_off_is_default_on_cpu(monkeypatch):
+    """Auto policy: the hatch stays closed on a CPU device (the
+    pathology is a TPU fusion decision) AND — until a real-TPU window
+    validates the kernel end-to-end — without the explicit
+    $VELES_FUSED_BIAS_GRAD=1 opt-in even where a TPU would be
+    present; bias_grad_xla returns None and the call site keeps the
+    plain reduction."""
+    wf, feed, fwd, gd, x, err, comp = build(
+        ConvRELU, gd_kwargs={}, n_kernels=4, kx=3, ky=3)
+
+    class _Ctx:
+        pass
+
+    ctx = _Ctx()
+    ctx._compiler = comp
+    assert gd.fused_bias_grad is None
+    assert gd.bias_grad_xla(ctx, None, None) is None
+    # the env opt-in alone is not enough off-TPU either
+    monkeypatch.setenv("VELES_FUSED_BIAS_GRAD", "1")
+    assert gd.bias_grad_xla(ctx, None, None) is None
+    gd.fused_bias_grad = False
+    assert gd.bias_grad_xla(ctx, None, None) is None

@@ -93,8 +93,13 @@ def test_ring_attention_matches_dense(causal, inner):
     q = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
     k = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
     v = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
-    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal,
-                                        inner=inner, block=2)
+    # jit-wrapped like the production path (XLAStep traces the ring
+    # INSIDE one step program): eagerly, every one of the ring's
+    # hundreds of small multi-device ops compiles and dispatches its
+    # own SPMD program — measured 12s/case vs ~1s jitted, pure test
+    # overhead with no coverage behind it
+    out, lse = jax.jit(lambda a, b, c: ring.ring_self_attention(
+        a, b, c, mesh, causal=causal, inner=inner, block=2))(q, k, v)
     ref = dense_attention(q, k, v, causal)
     assert numpy.allclose(numpy.asarray(out), numpy.asarray(ref),
                           atol=2e-5), \
@@ -118,17 +123,20 @@ def test_ring_attention_backward_matches_jax_grad(causal, inner):
     v = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
     dout = jnp.asarray(gen.normal(0, 1.0, (b, h, s, dh)))
 
-    out, lse = ring.ring_self_attention(q, k, v, mesh, causal=causal,
-                                        inner=inner, block=2)
-    dq, dk, dv = ring.ring_self_attention_bwd(
-        q, k, v, out, lse, dout, mesh, causal=causal, inner=inner,
-        block=2)
+    # jit-wrapped like the production path (see the forward test):
+    # the eager form cost ~40s/case in pure per-op SPMD dispatch
+    out, lse = jax.jit(lambda a, b, c: ring.ring_self_attention(
+        a, b, c, mesh, causal=causal, inner=inner, block=2))(q, k, v)
+    dq, dk, dv = jax.jit(
+        lambda a, b, c, o, l, d: ring.ring_self_attention_bwd(
+            a, b, c, o, l, d, mesh, causal=causal, inner=inner,
+            block=2))(q, k, v, out, lse, dout)
 
     def loss(q, k, v):
         return jnp.sum(jnp.asarray(dout)
                        * dense_attention(q, k, v, causal))
 
-    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gq, gk, gv = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
     for got, want, name in ((dq, gq, "dq"), (dk, gk, "dk"),
                             (dv, gv, "dv")):
         assert numpy.allclose(numpy.asarray(got), numpy.asarray(want),

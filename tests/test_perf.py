@@ -82,6 +82,76 @@ def test_device_peak_env_override(monkeypatch):
     assert perf.device_peak_flops() is None
 
 
+def test_device_peak_low_precision_overrides(monkeypatch):
+    """Per-precision env escape hatches: an int8/fp8 program's MFU
+    must not silently score against the bf16 peak (ISSUE 14
+    satellite)."""
+    monkeypatch.setenv("VELES_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("VELES_PEAK_FLOPS_INT8", "2e12")
+    monkeypatch.setenv("VELES_PEAK_FLOPS_FP8", "3e12")
+    assert perf.device_peak_flops("bf16") == 1e12
+    assert perf.device_peak_flops("int8") == 2e12
+    assert perf.device_peak_flops("fp8") == 3e12
+    # without the per-precision env, int8 on an unknown device (cpu)
+    # stays unknown rather than borrowing the bf16 override
+    monkeypatch.delenv("VELES_PEAK_FLOPS_INT8")
+    assert perf.device_peak_flops("int8") is None
+
+
+def test_program_precision_detection():
+    """The cost walker classifies a program by its dominant dot-input
+    class: plain f32/bf16 -> "bf16", an int8-dominated matmul program
+    -> "int8", float8 -> "fp8"."""
+    import jax
+    import jax.numpy as jnp
+    f32 = perf.program_cost(
+        jax.jit(lambda x: x @ x), (jnp.ones((8, 8)),))
+    assert f32.precision == "bf16"
+
+    def int8_dot(q, x):
+        return jax.lax.dot_general(
+            x, q.astype(jnp.float32) * 0.5, (((1,), (0,)), ((), ())))
+
+    # the dequant-convert keeps the dot inputs f32 — that program is
+    # NOT int8-classed (inputs decide, matching what the MXU runs)
+    cost = perf.program_cost(
+        int8_dot, (jnp.ones((8, 8), jnp.int8), jnp.ones((4, 8))))
+    assert cost.precision == "bf16"
+
+    def raw_int8(q, x):
+        return jax.lax.dot_general(
+            q, x, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+    cost = perf.program_cost(
+        raw_int8, (jnp.ones((8, 8), jnp.int8),
+                   jnp.ones((8, 8), jnp.int8)))
+    assert cost.precision == "int8"
+
+    def fp8_dot(a, b):
+        return jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    cost = perf.program_cost(
+        fp8_dot, (jnp.ones((8, 8), jnp.float8_e4m3fn),
+                  jnp.ones((8, 8), jnp.float8_e4m3fn)))
+    assert cost.precision == "fp8"
+
+    # mixed operands run the WIDE rate (the hardware upcasts): an
+    # int8-lhs × bf16-rhs dot must not be scored against the doubled
+    # 8-bit peak
+    def mixed_dot(q, x):
+        return jax.lax.dot_general(
+            q, x, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    cost = perf.program_cost(
+        mixed_dot, (jnp.ones((8, 8), jnp.int8),
+                    jnp.ones((8, 8), jnp.bfloat16)))
+    assert cost.precision == "bf16"
+
+
 def test_step_metrics_on_real_run(monkeypatch):
     """Acceptance slice: after an XLA-backed training run, /metrics
     exports non-zero veles_step_flops_total and bytes, achieved

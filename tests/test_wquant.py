@@ -1,0 +1,291 @@
+"""At-rest weight quantization for serving (ISSUE 14 tentpole piece
+3): quant.py round-trip bounds, the eligibility policy, int8/fp8
+forward parity against f32 on a golden archive (logit max-abs-diff +
+top-1 agreement), the Prometheus ``veles_serving_forward_cache_bytes``
+shrink (acceptance: int8 ≤ 55% of f32), hot-reload round-trip and
+greedy-decode token parity."""
+
+import json
+import os
+import shutil
+import tempfile
+
+import numpy
+import pytest
+
+import veles.prng as prng
+from veles import telemetry
+from veles.config import root
+from veles.serving import quant
+from veles.serving.quant import (MODES, QuantizedTensor, dense_params,
+                                 quantize_tensor, quantize_tree)
+
+
+@pytest.fixture(scope="module")
+def mlp_archive(tmp_path_factory):
+    """Untrained tiny MNIST MLP archive (initialize + export only —
+    parity bounds price the quantization, not model quality)."""
+    prng.seed_all(424)
+    from veles.znicz_tpu.models import mnist
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("minibatch_size", "n_train", "n_valid")}
+    root.mnist.loader.update({"minibatch_size": 25, "n_train": 100,
+                              "n_valid": 25})
+    try:
+        wf = mnist.create_workflow(name="WQuantMLP")
+        wf.initialize(device="numpy")
+        base = tmp_path_factory.mktemp("wquant")
+        archive = str(base / "archive")
+        wf.export_inference(archive)
+        x = wf.loader.original_data.mem[:16].astype(numpy.float32)
+        return {"archive": archive, "x": x}
+    finally:
+        root.mnist.loader.update(saved)
+
+
+# -- codec-level -------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ("int8", "fp8"))
+def test_round_trip_error_bounds(mode):
+    prng.seed_all(11)
+    gen = prng.get("wq")
+    w = gen.normal(0, 0.3, (64, 48)).astype(numpy.float32)
+    qt = quantize_tensor(w, mode)
+    assert qt.shape == w.shape
+    assert qt.nbytes < w.nbytes / 3.5     # ~1 byte/element + scales
+    back = qt.dense(numpy)
+    spread = w.max() - w.min()
+    if mode == "int8":
+        # affine 255-level grid: error ≤ half a step
+        assert numpy.abs(back - w).max() <= spread / 255.0 * 0.51
+    else:
+        # e4m3: ~2 mantissa-bit relative error, elementwise
+        rel = numpy.abs(back - w) / numpy.maximum(numpy.abs(w), 1e-3)
+        assert rel.max() < 0.08, rel.max()
+
+
+def test_constant_and_mode_edges():
+    w = numpy.full((40, 40), 3.25, numpy.float32)
+    for mode in ("int8", "fp8"):
+        back = quantize_tensor(w, mode).dense(numpy)
+        assert numpy.allclose(back, w, rtol=1e-2)
+    # same-mode passthrough is the SAME object; cross-mode re-encodes
+    qt = quantize_tensor(w, "int8")
+    assert quantize_tensor(qt, "int8") is qt
+    assert quantize_tensor(qt, "fp8").mode == "fp8"
+    with pytest.raises(ValueError):
+        quantize_tensor(w, "int4")
+
+
+def test_tree_policy_skips_vectors():
+    """Biases/LN vectors (ndim<2 or tiny) stay f32 — only
+    matrix-shaped tensors carry the capacity bill."""
+    tree = {"fc": {"weights": numpy.zeros((64, 64), numpy.float32),
+                   "bias": numpy.zeros(64, numpy.float32),
+                   "small": numpy.zeros((4, 4), numpy.float32)}}
+    q = quantize_tree(tree, "int8")
+    assert isinstance(q["fc"]["weights"], QuantizedTensor)
+    assert isinstance(q["fc"]["bias"], numpy.ndarray)
+    assert isinstance(q["fc"]["small"], numpy.ndarray)
+    assert quantize_tree(tree, "none") is tree
+    with pytest.raises(ValueError):
+        quantize_tree(tree, "bf16")
+    dense = dense_params(numpy, q["fc"])
+    assert all(isinstance(v, numpy.ndarray) for v in dense.values())
+    # identity-cheap when nothing is quantized
+    assert dense_params(numpy, tree["fc"]) is tree["fc"]
+
+
+def test_quantized_tree_survives_jit_as_pytree():
+    """The registered pytree node: device_put + jit thread the
+    payload/scale as runtime leaves, so a scale change does NOT
+    retrace (the hot-reload-keeps-programs contract)."""
+    import jax
+    import jax.numpy as jnp
+    w = numpy.linspace(-1, 1, 64 * 32).reshape(64, 32) \
+        .astype(numpy.float32)
+    qt = quantize_tensor(w, "int8")
+    traces = []
+
+    @jax.jit
+    def dot(q, x):
+        traces.append(1)
+        return jnp.matmul(x, q.dense(jnp))
+
+    x = numpy.ones((2, 64), numpy.float32)
+    y1 = dot(jax.device_put(qt), x)
+    assert numpy.allclose(numpy.asarray(y1), x @ qt.dense(numpy),
+                          atol=1e-5)
+    qt2 = quantize_tensor(w * 2.0, "int8")       # new scale, same shape
+    y2 = dot(jax.device_put(qt2), x)
+    assert len(traces) == 1, "scale change must not retrace"
+    assert numpy.allclose(numpy.asarray(y2), 2 * numpy.asarray(y1),
+                          atol=1e-4)
+
+
+# -- serving parity + accounting ---------------------------------------
+
+
+def _cache_gauge(name):
+    return telemetry.get_registry().gauge(
+        "veles_serving_forward_cache_bytes",
+        labels=("model",)).labels(name).value
+
+
+@pytest.mark.parametrize("mode", ("int8", "fp8"))
+def test_forward_parity_and_gauge_shrink(mlp_archive, mode):
+    """THE acceptance pins: quantized logits within bounds of f32
+    (max-abs-diff + full top-1 agreement on the golden archive), and
+    the Prometheus forward-cache gauge at ≤ 55% of the f32 figure."""
+    from veles.serving import ModelRegistry
+    x = mlp_archive["x"]
+    out, cache = {}, {}
+    for m in ("none", mode):
+        reg = ModelRegistry(backend="jit", max_batch=16,
+                            quantize_weights=m)
+        try:
+            entry = reg.load("golden", mlp_archive["archive"])
+            y, _ = entry.engine.predict(x)
+            out[m] = numpy.asarray(y)
+            cache[m] = _cache_gauge("golden")
+            assert cache[m] == entry.cache_bytes()
+        finally:
+            reg.close()
+    diff = numpy.abs(out[mode] - out["none"]).max()
+    assert diff < 2e-2, diff              # post-softmax probabilities
+    # top-1 agreement wherever f32 has a REAL margin: a row whose
+    # top-2 gap exceeds twice the observed perturbation cannot flip;
+    # near-tie rows on this untrained archive legitimately may
+    top2 = numpy.sort(out["none"], axis=1)[:, -2:]
+    margin = top2[:, 1] - top2[:, 0]
+    strong = margin > 2 * diff
+    agree = out[mode].argmax(1) == out["none"].argmax(1)
+    assert strong.any()
+    assert agree[strong].all(), (margin, agree)
+    ratio = cache[mode] / cache["none"]
+    assert ratio <= 0.55, ratio
+
+
+def test_quantized_hot_reload_round_trip(mlp_archive, tmp_path):
+    """Reload under int8: version bumps, compiled programs survive,
+    outputs track the new weights, and the at-rest tree STAYS
+    quantized (a refresh must not silently fatten the cache back to
+    f32)."""
+    from veles.serving import ModelRegistry
+    src = str(tmp_path / "archive")
+    shutil.copytree(mlp_archive["archive"], src)
+    reg = ModelRegistry(backend="jit", max_batch=8,
+                        quantize_weights="int8")
+    try:
+        entry = reg.load("m", src, warmup=True)
+        buckets = list(entry.engine.compiled_buckets)
+        bytes_before = entry.cache_bytes()
+        before = entry.predict(mlp_archive["x"][:2])
+        with open(os.path.join(src, "contents.json")) as f:
+            head = [u for u in json.load(f)["units"]
+                    if u["type"] == "softmax"][0]
+        for key in ("weights", "bias"):
+            path = os.path.join(src, head[key])
+            numpy.save(path, numpy.zeros_like(numpy.load(path)))
+        entry2 = reg.reload("m")
+        assert entry2 is entry and entry.version == 2
+        assert entry.engine.compiled_buckets == buckets
+        after = entry.predict(mlp_archive["x"][:2])
+        assert numpy.abs(after - before).max() > 1e-4
+        numpy.testing.assert_allclose(after, 0.1, atol=1e-2)
+        assert any(
+            isinstance(v, QuantizedTensor)
+            for tree in entry.model.params.values()
+            for v in tree.values())
+        assert entry.cache_bytes() <= bytes_before
+    finally:
+        reg.close()
+
+
+def test_decode_greedy_token_parity():
+    """int8 decode through the continuous batcher: greedy tokens match
+    the f32 decode on the tiny LM wherever f32 has a REAL top-2 margin
+    (near-tie steps on an untrained archive may legitimately flip —
+    the same margin gate the forward-parity test uses; a blanket
+    token-for-token equality would be a cross-platform flake), and the
+    KV-pool-inclusive cache gauge still shrinks."""
+    from veles.serving import ModelRegistry
+    from veles.znicz_tpu.models import transformer_lm
+    prng.seed_all(99)
+    saved_loader = root.lm.loader.to_dict()
+    saved_model = root.lm.model.to_dict()
+    root.lm.loader.update({"minibatch_size": 8, "n_train": 64,
+                           "n_valid": 16, "seq_len": 16, "vocab": 32,
+                           "max_period": 8})
+    root.lm.model.update({"dim": 64, "heads": 4, "layers": 2,
+                          "ffn_hidden": 128, "moe_experts": 0,
+                          "attn_block": None, "attn_impl": None,
+                          "stacked": False})
+    prompt, n_new = [1, 2, 3], 8
+    try:
+        wf = transformer_lm.create_workflow(name="WQuantLM")
+        wf.initialize(device="numpy")
+        with tempfile.TemporaryDirectory() as tmp:
+            wf.export_inference(tmp)
+            toks, logits, cache = {}, {}, {}
+            for mode in ("none", "int8"):
+                reg = ModelRegistry(backend="jit", max_batch=8,
+                                    quantize_weights=mode,
+                                    decode_slots=2, decode_max_len=32)
+                try:
+                    entry = reg.load("lm", tmp)
+                    dec = reg.decoder("lm")
+                    toks[mode] = dec.generate(prompt,
+                                              max_tokens=n_new,
+                                              wait_s=300)
+                    cache[mode] = _cache_gauge("lm")
+                    # teacher-forced per-step logits along the F32
+                    # greedy chain ("none" runs first): the margin
+                    # gate below needs both modes' view of the SAME
+                    # contexts, independent of where either chain
+                    # wanders after a near-tie flip
+                    chain = prompt + toks["none"]
+                    seq = root.lm.loader.seq_len
+                    rows = []
+                    for i in range(n_new):
+                        row = chain[:len(prompt) + i]
+                        rows.append(row + [0] * (seq - len(row)))
+                    y, _ = entry.engine.predict(
+                        numpy.asarray(rows, numpy.float32))
+                    y = numpy.asarray(y)
+                    logits[mode] = numpy.stack(
+                        [y[i, len(prompt) + i - 1]
+                         for i in range(n_new)])
+                finally:
+                    reg.close()
+            assert cache["int8"] < cache["none"], cache
+            diff = numpy.abs(logits["int8"] - logits["none"]).max()
+            top2 = numpy.sort(logits["none"], axis=1)[:, -2:]
+            margin = top2[:, 1] - top2[:, 0]
+            # 2x the observed perturbation plus slack for the decode
+            # plane's KV-cached programs reducing in another order
+            strong = margin > 2 * diff + 1e-3
+            assert strong.any(), (margin, diff)
+            agree = (numpy.asarray(toks["int8"])
+                     == numpy.asarray(toks["none"]))
+            # the chains share context only until the first flip, so
+            # the gate applies to the strong PREFIX: a divergence at a
+            # weak step releases everything after it
+            for i in range(n_new):
+                if not strong[i]:
+                    break
+                assert agree[i], (i, toks, margin, diff)
+    finally:
+        root.lm.loader.update(saved_loader)
+        root.lm.model.update(saved_model)
+
+
+def test_registry_rejects_unknown_mode():
+    from veles.serving import ModelRegistry
+    with pytest.raises(ValueError):
+        ModelRegistry(quantize_weights="int4")
+    from veles.serving.engine import InferenceEngine
+    with pytest.raises(ValueError):
+        InferenceEngine(None, backend="numpy", quantize="fp16")
+    assert MODES == ("none", "int8", "fp8")

@@ -42,18 +42,24 @@ from veles import telemetry
 
 
 class StepCost:
-    """Cost of ONE call of a compiled program."""
+    """Cost of ONE call of a compiled program. ``precision`` is the
+    program's dominant matmul input class ("bf16" | "int8" | "fp8" —
+    by dot-FLOPs share), so the MFU gauge scores a low-precision
+    program against the peak those matmuls actually have."""
 
-    __slots__ = ("flops", "bytes", "io_bytes")
+    __slots__ = ("flops", "bytes", "io_bytes", "precision")
 
-    def __init__(self, flops=0.0, bytes=0.0, io_bytes=0.0):
+    def __init__(self, flops=0.0, bytes=0.0, io_bytes=0.0,
+                 precision="bf16"):
         self.flops = float(flops)
         self.bytes = float(bytes)
         self.io_bytes = float(io_bytes)
+        self.precision = precision
 
     def __repr__(self):
-        return ("StepCost(flops=%.4g, bytes=%.4g, io_bytes=%.4g)"
-                % (self.flops, self.bytes, self.io_bytes))
+        return ("StepCost(flops=%.4g, bytes=%.4g, io_bytes=%.4g, "
+                "precision=%s)" % (self.flops, self.bytes,
+                                   self.io_bytes, self.precision))
 
 
 def _size(shape):
@@ -118,9 +124,31 @@ def _inner_jaxprs(eqn):
     return out
 
 
-def _jaxpr_cost(jaxpr):
+def _dot_precision(eqn):
+    """Precision class of one dot_general by BOTH input dtypes: a
+    dot only runs at an 8-bit rate when both operands share the
+    class — a mixed int8×bf16 dot (e.g. a fused dequant consumer)
+    upcasts and runs the wide rate, and scoring it against the
+    doubled 8-bit peak would under-report MFU ~2x."""
+    def cls(var):
+        try:
+            name = numpy.dtype(var.aval.dtype).name
+        except (TypeError, AttributeError):
+            return "bf16"
+        if name in ("int8", "uint8"):
+            return "int8"
+        if name.startswith("float8"):
+            return "fp8"
+        return "bf16"
+    lhs, rhs = cls(eqn.invars[0]), cls(eqn.invars[1])
+    return lhs if lhs == rhs else "bf16"
+
+
+def _jaxpr_cost(jaxpr, dot_prec=None):
     """(flops, bytes) of one jaxpr execution, recursing into nested
-    programs with their trip-count multipliers."""
+    programs with their trip-count multipliers. ``dot_prec`` (when a
+    dict is passed) accumulates dot-FLOPs per precision class — the
+    input to the program-precision call."""
     flops = 0.0
     nbytes = 0.0
     for eqn in jaxpr.eqns:
@@ -128,12 +156,21 @@ def _jaxpr_cost(jaxpr):
         inner = _inner_jaxprs(eqn)
         if inner:
             for mult, sub in inner:
-                f, b = _jaxpr_cost(getattr(sub, "jaxpr", sub))
+                sub_prec = {} if dot_prec is not None else None
+                f, b = _jaxpr_cost(getattr(sub, "jaxpr", sub),
+                                   sub_prec)
                 flops += mult * f
                 nbytes += mult * b
+                if dot_prec is not None:
+                    for k, v in sub_prec.items():
+                        dot_prec[k] = dot_prec.get(k, 0.0) + mult * v
             continue
         if name == "dot_general":
-            flops += _dot_flops(eqn)
+            f = _dot_flops(eqn)
+            flops += f
+            if dot_prec is not None:
+                k = _dot_precision(eqn)
+                dot_prec[k] = dot_prec.get(k, 0.0) + f
         elif name == "conv_general_dilated":
             flops += _conv_flops(eqn)
         else:
@@ -146,35 +183,67 @@ def _jaxpr_cost(jaxpr):
 
 def program_cost(fn, args):
     """Trace ``fn(*args)`` to a jaxpr (no XLA compilation, no
-    execution, nothing donated) and walk it; -> :class:`StepCost`."""
+    execution, nothing donated) and walk it; -> :class:`StepCost`.
+    The dominant dot-input precision class rides along so MFU is
+    scored against the right peak for int8/fp8 programs."""
     import jax
     closed = jax.make_jaxpr(fn)(*args)
-    flops, nbytes = _jaxpr_cost(closed.jaxpr)
+    dot_prec = {}
+    flops, nbytes = _jaxpr_cost(closed.jaxpr, dot_prec)
     io_bytes = sum(_aval_bytes(v.aval) for v in closed.jaxpr.invars)
     io_bytes += sum(_aval_bytes(v.aval) for v in closed.jaxpr.outvars)
-    return StepCost(flops, nbytes, io_bytes)
+    precision = max(dot_prec, key=dot_prec.get) if dot_prec else "bf16"
+    return StepCost(flops, nbytes, io_bytes, precision)
 
 
 # -- device peak --------------------------------------------------------
 
-#: dense bf16/fp32-accumulate peak FLOP/s per chip by device_kind
-#: substring (vendor datasheet numbers; MFU is relative to THIS)
-_PEAK_FLOPS_BY_KIND = (
-    ("TPU v6", 918e12),
-    ("TPU v5p", 459e12),
-    ("TPU v5e", 197e12),
-    ("TPU v5 lite", 197e12),
-    ("TPU v4", 275e12),
-    ("TPU v3", 123e12),
-    ("TPU v2", 45e12),
-)
+#: peak FLOP/s per chip by precision class and device_kind substring
+#: (vendor datasheet numbers; MFU is relative to THIS). ``bf16`` is
+#: the dense bf16-input/f32-accumulate MXU rate every training row
+#: uses; ``int8`` is the doubled-throughput 8-bit MXU rate on the
+#: generations that have one (v5e/v5p/v6 — v2-v4 run int8 at the bf16
+#:  rate); ``fp8`` is native only on v6-class chips, elsewhere fp8
+#: matmuls upcast and the honest peak is the bf16 entry (the
+#: fallback). A low-precision program scored against the bf16 peak
+#: would silently over-report MFU by up to 2x — the reason
+#: ``veles_step_mfu_ratio`` resolves its peak per program precision.
+_PEAK_FLOPS_BY_KIND = {
+    "bf16": (
+        ("TPU v6", 918e12),
+        ("TPU v5p", 459e12),
+        ("TPU v5e", 197e12),
+        ("TPU v5 lite", 197e12),
+        ("TPU v4", 275e12),
+        ("TPU v3", 123e12),
+        ("TPU v2", 45e12),
+    ),
+    "int8": (
+        ("TPU v6", 1836e12),
+        ("TPU v5p", 918e12),
+        ("TPU v5e", 394e12),
+        ("TPU v5 lite", 394e12),
+    ),
+    "fp8": (
+        ("TPU v6", 1836e12),
+    ),
+}
+
+#: per-precision env overrides (the escape hatch for new hardware and
+#: deterministic tests); VELES_PEAK_FLOPS keeps its pre-existing
+#: meaning = the bf16/default peak
+_PEAK_ENV = {"bf16": "VELES_PEAK_FLOPS",
+             "int8": "VELES_PEAK_FLOPS_INT8",
+             "fp8": "VELES_PEAK_FLOPS_FP8"}
 
 
-def device_peak_flops():
-    """Peak FLOP/s of the default device, or None when unknown (CPU,
-    unrecognized kind). ``$VELES_PEAK_FLOPS`` overrides — the escape
-    hatch for new hardware and for deterministic tests."""
-    env = os.environ.get("VELES_PEAK_FLOPS")
+def device_peak_flops(precision="bf16"):
+    """Peak FLOP/s of the default device for ``precision`` ("bf16" |
+    "int8" | "fp8"), or None when unknown (CPU, unrecognized kind).
+    ``$VELES_PEAK_FLOPS`` (and ``_INT8``/``_FP8``) override. A
+    precision with no table entry for the device falls back to the
+    bf16 row — the rate those matmuls actually run at."""
+    env = os.environ.get(_PEAK_ENV.get(precision, "VELES_PEAK_FLOPS"))
     if env:
         try:
             return float(env)
@@ -185,9 +254,12 @@ def device_peak_flops():
         kind = jax.devices()[0].device_kind
     except Exception:
         return None
-    for sub, peak in _PEAK_FLOPS_BY_KIND:
-        if sub.lower() in str(kind).lower():
-            return peak
+    kind = str(kind).lower()
+    for table in (_PEAK_FLOPS_BY_KIND.get(precision, ()),
+                  _PEAK_FLOPS_BY_KIND["bf16"]):
+        for sub, peak in table:
+            if sub.lower() in kind:
+                return peak
     return None
 
 
@@ -315,7 +387,8 @@ class PerfLedger:
             if seconds > 0:
                 fps = cost.flops / seconds
                 kids["fps"].get().set(fps)
-                peak = device_peak_flops()
+                peak = device_peak_flops(
+                    getattr(cost, "precision", None) or "bf16")
                 if peak:
                     kids["mfu"].get().set(fps / peak)
         if cost is not None and cost.bytes:

@@ -309,13 +309,23 @@ class GenerativeEngine(Logger):
 
         def prefill(ptrees, poolK, poolV, slot, ids, length, temp,
                     key):
-            emb = ptrees[0]
-            x = emb["weights"][ids]
+            # quantized at-rest weights densify INSIDE the trace
+            # (serving/quant.py): matmul-consumer trees dequantize
+            # whole (the convert+scale fuses into the consumer), the
+            # embedding gathers its 1-byte rows FIRST and dequantizes
+            # only the slice — the consumer there is a gather, and
+            # densifying the vocab table per dispatch would erase the
+            # bandwidth saving
+            from veles.serving.quant import dense_params, gather_rows
+            emb, ptrees = ptrees[0], [
+                dense_params(jnp, t) for t in ptrees[1:]]
+            x = gather_rows(jnp, emb["weights"], ids)
             pos_table = emb.get("positions")
             if pos_table is not None:
-                x = x + pos_table[:bucket]
+                x = x + gather_rows(jnp, pos_table,
+                                    slice(None, bucket))
             caches = [None] * self.plan.n_caches
-            for (kind, spec, ci), p in zip(steps[1:], ptrees[1:]):
+            for (kind, spec, ci), p in zip(steps[1:], ptrees):
                 cfg = spec.get("config", {})
                 if kind == "attn":
                     heads = int(cfg["heads"])
@@ -374,13 +384,17 @@ class GenerativeEngine(Logger):
         steps = self.plan.steps
 
         def step(ptrees, poolK, poolV, tokens, pos, temp, key):
+            # see prefill: matmul trees densify whole, the embedding
+            # gathers its 1-byte rows first
+            from veles.serving.quant import dense_params, gather_rows
+            emb, ptrees = ptrees[0], [
+                dense_params(jnp, t) for t in ptrees[1:]]
             key, sub = jax.random.split(key)
-            emb = ptrees[0]
-            x = emb["weights"][tokens][:, None, :]
+            x = gather_rows(jnp, emb["weights"], tokens)[:, None, :]
             pos_table = emb.get("positions")
             if pos_table is not None:
-                x = x + pos_table[pos][:, None, :]
-            for (kind, spec, ci), p in zip(steps[1:], ptrees[1:]):
+                x = x + gather_rows(jnp, pos_table, pos)[:, None, :]
+            for (kind, spec, ci), p in zip(steps[1:], ptrees):
                 cfg = spec.get("config", {})
                 if kind == "attn":
                     x, (poolK[ci], poolV[ci]) = attn_decode(

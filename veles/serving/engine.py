@@ -38,7 +38,7 @@ class InferenceEngine:
     is free-running (pure functions, no shared buffers)."""
 
     def __init__(self, model, backend="auto", max_batch=64,
-                 donate=None):
+                 donate=None, quantize="none"):
         if backend == "auto":
             try:
                 import jax  # noqa: F401
@@ -48,6 +48,13 @@ class InferenceEngine:
         if backend not in ("numpy", "jit"):
             raise ValueError("backend must be auto|numpy|jit, got %r"
                              % (backend,))
+        from veles.serving.quant import validate_mode
+        validate_mode(quantize)
+        #: at-rest weight quantization mode (serving/quant.py):
+        #: set_model re-quantizes the model's params IN PLACE, so the
+        #: host at-rest copy and the device upload both ride 1
+        #: byte/element; apply() densifies at dispatch
+        self.quantize = quantize
         self.backend = backend
         self.max_batch = int(max_batch)
         self._lock = threading.Lock()
@@ -84,6 +91,16 @@ class InferenceEngine:
                 self._compiled.clear()
                 self.compile_seconds = {}
                 self._jit_apply = None
+            if self.quantize != "none":
+                # at-rest swap: a checkpoint refresh writes f32 leaves
+                # back into the tree; re-quantizing here keeps host
+                # AND device at 1 byte/element (already-quantized
+                # leaves pass through untouched). Compiled programs
+                # stay valid — the quantized payload and its scale are
+                # runtime pytree leaves, exactly like plain params.
+                from veles.serving.quant import quantize_tree
+                model.params = quantize_tree(model.params,
+                                             self.quantize)
             if self.backend == "jit":
                 import jax
                 self._device_params = jax.device_put(model.params)

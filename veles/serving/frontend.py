@@ -619,6 +619,13 @@ def build_serve_argparser():
                    help="default per-request deadline")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip bucket-ladder precompilation")
+    from veles.serving.quant import MODES
+    p.add_argument("--quantize-weights", default="none",
+                   choices=MODES,
+                   help="store model weights quantized at rest "
+                        "(host + device; dequantized at dispatch) — "
+                        "~1 byte/element, halving "
+                        "veles_serving_forward_cache_bytes per model")
     p.add_argument("--decode-slots", type=int, default=8,
                    help="KV pool slots = width of the shared "
                         "continuous decode batch (/v1/generate)")
@@ -666,7 +673,8 @@ def serve_main(argv=None):
         max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
         default_timeout_ms=args.timeout_ms,
         decode_slots=args.decode_slots,
-        decode_max_len=args.decode_max_len)
+        decode_max_len=args.decode_max_len,
+        quantize_weights=args.quantize_weights)
     front = None
     try:
         # inside the guard from the first load on: a bad --model

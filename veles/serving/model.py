@@ -349,10 +349,15 @@ class ArchiveModel:
     # -- evaluation ----------------------------------------------------
 
     def apply(self, xp, params, x):
-        """Pure forward through every unit; ``x``: (B, *sample)."""
+        """Pure forward through every unit; ``x``: (B, *sample).
+        Quantized at-rest weights (serving/quant.py) densify here, AT
+        dispatch — inside the trace on the jit path, where XLA fuses
+        the convert+scale into the consumer matmul."""
+        from veles.serving.quant import dense_params
         for spec in self.units:
             x = FORWARD_OPS[spec["type"]](
-                xp, x, params.get(spec["name"], {}), spec)
+                xp, x, dense_params(xp, params.get(spec["name"], {})),
+                spec)
         return x
 
     def __call__(self, x):

@@ -1,0 +1,203 @@
+"""At-rest weight quantization for the serving planes (ISSUE 14).
+
+A serving process is capacity-bound by ``veles_serving_forward_cache
+_bytes``: every loaded model holds its f32 params twice (host at-rest
+copy + device upload on the jit backend), and the decode plane's KV
+pool on top. LLM.int8()-style per-tensor weight quantization (Dettmers
+et al., 2022) halves the weight half of that bill at negligible logit
+error — weights tolerate 8-bit per-tensor quantization far better
+than activations, and serving never updates them.
+
+The representation is :class:`QuantizedTensor`: the quantized payload
+(``int8`` = uint8 + affine min/scale — the SAME math the gradient wire
+codec uses, ``veles/compression.py``; ``fp8`` = float8_e4m3fn + a
+symmetric per-tensor scale) registered as a **jax pytree node**, so
+``device_put``/``jit`` thread it through untouched and the scale rides
+as a runtime leaf — a hot reload re-uploads fresh scales without
+invalidating any compiled program (the same contract plain params
+have). Dequantization happens at DISPATCH: ``ArchiveModel.apply`` and
+the decode programs densify each unit's tree inside the trace, where
+XLA fuses the convert+scale into the consumer matmul — the at-rest and
+device copies stay 1 byte/element.
+
+Policy: only matrix-shaped tensors (``ndim >= 2``) of at least
+``MIN_QUANT_SIZE`` elements quantize — biases and layernorm vectors
+are capacity-irrelevant and numerically twitchy, so they stay f32.
+Stacked-layer tensors (layers, d, h) quantize per-TENSOR across the
+stack; the parity bounds in ``tests/test_wquant.py`` gate both modes.
+"""
+
+import threading
+
+import numpy
+
+from veles.compression import _int8_code
+
+#: accepted --quantize-weights values
+MODES = ("none", "int8", "fp8")
+
+#: smallest element count worth quantizing (below this the scale
+#: bookkeeping rivals the savings and vectors lose real precision)
+MIN_QUANT_SIZE = 1024
+
+#: float8_e4m3fn max finite — the symmetric fp8 scale target
+_FP8_MAX = 448.0
+
+_registered = False
+_register_lock = threading.Lock()
+
+
+def _ensure_registered():
+    """Register the pytree node lazily — quant must import (and the
+    numpy backend must run) on hosts without jax. Locked: two engines
+    quantizing their first model concurrently must not race the
+    check-then-register (jax raises on a duplicate registration)."""
+    global _registered
+    if _registered:
+        return
+    try:
+        import jax
+    except Exception:
+        return
+    with _register_lock:
+        if _registered:
+            return
+        jax.tree_util.register_pytree_node(
+            QuantizedTensor,
+            lambda t: ((t.q, t.scale, t.zero), (t.mode,)),
+            lambda aux, kids: QuantizedTensor(aux[0], *kids))
+        _registered = True
+
+
+class QuantizedTensor:
+    """One at-rest quantized weight: payload + per-tensor scale (and
+    zero point for the affine int8 form). Exposes ``shape``/``nbytes``
+    so the registry's ``signature()``/``cache_bytes()`` accounting
+    reads it like any array; :meth:`dense` reconstructs f32 at
+    dispatch (traced on the jit path)."""
+
+    __slots__ = ("mode", "q", "scale", "zero")
+
+    def __init__(self, mode, q, scale, zero):
+        self.mode = mode
+        self.q = q
+        self.scale = scale
+        self.zero = zero
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.scale.nbytes + self.zero.nbytes
+
+    def dense(self, xp, payload=None):
+        """f32 reconstruction with ``xp`` math (numpy on the host
+        path, jax.numpy inside a trace — where the convert+scale
+        fuses into the consumer). ``payload`` (default the whole
+        ``q``) lets a caller dequantize just a gathered/sliced piece
+        — the per-tensor scale applies to any sub-block."""
+        q = self.q if payload is None else payload
+        f32 = numpy.float32 if xp is numpy else "float32"
+        if self.mode == "int8":
+            return q.astype(f32) * self.scale + self.zero
+        return q.astype(f32) * self.scale
+
+    def __repr__(self):
+        return ("QuantizedTensor(%s, shape=%s, %d bytes)"
+                % (self.mode, self.q.shape, self.nbytes))
+
+
+def quantize_tensor(arr, mode):
+    """One f32 ndarray -> :class:`QuantizedTensor` (``int8``/``fp8``).
+    An already-quantized leaf in the SAME mode passes through (the
+    re-quantize path after a checkpoint refresh mixes fresh f32 and
+    untouched quantized leaves); a different mode densifies first."""
+    _ensure_registered()
+    if isinstance(arr, QuantizedTensor):
+        if arr.mode == mode:
+            return arr
+        arr = arr.dense(numpy)
+    a = numpy.ascontiguousarray(arr, numpy.float32)
+    if mode == "int8":
+        payload, _ = _int8_code(a, with_decoded=False)
+        return QuantizedTensor(
+            "int8", payload["data"],
+            numpy.float32(payload["scale"]),
+            numpy.float32(payload["zero"]))
+    if mode == "fp8":
+        import ml_dtypes
+        amax = float(numpy.abs(a).max()) if a.size else 0.0
+        scale = (amax / _FP8_MAX) if amax > 0 else 1.0
+        q = (a / numpy.float32(scale)).astype(ml_dtypes.float8_e4m3fn)
+        return QuantizedTensor("fp8", q, numpy.float32(scale),
+                               numpy.float32(0.0))
+    raise ValueError("unknown weight-quantization mode %r (known: %s)"
+                     % (mode, ", ".join(MODES)))
+
+
+def _eligible(arr):
+    if isinstance(arr, QuantizedTensor):
+        return True
+    return (getattr(arr, "ndim", 0) >= 2
+            and getattr(arr, "size", 0) >= MIN_QUANT_SIZE
+            and numpy.issubdtype(
+                numpy.asarray(arr).dtype, numpy.floating))
+
+
+def validate_mode(mode, param="quantize"):
+    """THE mode guard — raise on anything outside :data:`MODES`.
+    Engine, registry and tree all call this one copy, so the error
+    text (and a future mode) cannot drift between layers."""
+    if mode not in MODES:
+        raise ValueError("%s must be one of %s, got %r"
+                         % (param, "|".join(MODES), mode))
+
+
+def quantize_tree(params, mode):
+    """``{unit: {key: array}}`` -> the same tree with every eligible
+    leaf quantized IN a fresh tree (callers overwrite the at-rest
+    reference). ``mode='none'`` returns the input untouched."""
+    validate_mode(mode)
+    if mode == "none":
+        return params
+    return {
+        name: {key: (quantize_tensor(a, mode) if _eligible(a) else a)
+               for key, a in tree.items()}
+        for name, tree in params.items()}
+
+
+def dense_params(xp, tree):
+    """One unit's param dict with every quantized leaf reconstructed —
+    the dispatch-time hook. Identity-cheap when nothing is quantized
+    (the common non-quantized deployment pays one isinstance per
+    leaf)."""
+    if not any(isinstance(v, QuantizedTensor) for v in tree.values()):
+        return tree
+    return {k: (v.dense(xp) if isinstance(v, QuantizedTensor) else v)
+            for k, v in tree.items()}
+
+
+def gather_rows(xp, leaf, idx):
+    """``leaf[idx]`` densified: for a quantized leaf the 1-byte
+    payload is indexed FIRST and only the gathered slice dequantizes.
+    The embedding consumer is a gather, not a matmul — densifying the
+    whole vocab table inside every decode step would re-materialize
+    f32 rows per token and erase the bandwidth saving the at-rest
+    format buys. ``idx`` is anything ndarray indexing takes (token
+    ids, a position array, a slice)."""
+    if isinstance(leaf, QuantizedTensor):
+        return leaf.dense(xp, leaf.q[idx])
+    return leaf[idx]
+
+
+def tree_nbytes(params):
+    """Summed leaf bytes of a (possibly quantized) params tree — what
+    ``cache_bytes()`` charges for one at-rest copy."""
+    return sum(a.nbytes for tree in params.values()
+               for a in tree.values())

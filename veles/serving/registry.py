@@ -65,8 +65,8 @@ class ServedModel:
         device upload on the jit backend) and a per-compiled-bucket
         input+output buffer guess. A size proxy the health ring can
         trend, not an allocator meter."""
-        params = sum(a.nbytes for tree in self.model.params.values()
-                     for a in tree.values())
+        from veles.serving.quant import tree_nbytes
+        params = tree_nbytes(self.model.params)
         total = params * (2 if self.engine.backend == "jit" else 1)
         sample = self.model.input_sample_shape
         if sample:
@@ -94,6 +94,7 @@ class ServedModel:
             "input_sample_shape": self.model.input_sample_shape,
             "units": [s["type"] for s in self.model.units],
             "backend": self.engine.backend,
+            "quantize": self.engine.quantize,
             "compiled_buckets": self.engine.compiled_buckets,
             "loaded_at": self.loaded_at,
             "generative": DecodePlan.probe(self.model),
@@ -127,9 +128,16 @@ class ModelRegistry(Logger):
     def __init__(self, backend="auto", max_batch=64, max_queue=256,
                  max_wait_ms=2.0, default_timeout_ms=1000.0,
                  decode_slots=8, decode_max_len=256,
-                 decode_max_queue=64):
+                 decode_max_queue=64, quantize_weights="none"):
         self.name = "registry"
         self.backend = backend
+        #: at-rest weight quantization (serving/quant.py, ISSUE 14):
+        #: every loaded model's params ride int8/fp8 host AND device,
+        #: densified at dispatch — validated here so a typo'd
+        #: --quantize-weights fails at configuration time
+        from veles.serving.quant import validate_mode
+        validate_mode(quantize_weights, "quantize_weights")
+        self.quantize_weights = quantize_weights
         self.max_batch = int(max_batch)
         self.max_queue = int(max_queue)
         self.max_wait_ms = float(max_wait_ms)
@@ -180,7 +188,8 @@ class ModelRegistry(Logger):
                           name, old.version)
                 return old
             engine = InferenceEngine(model, backend=self.backend,
-                                     max_batch=self.max_batch)
+                                     max_batch=self.max_batch,
+                                     quantize=self.quantize_weights)
             batcher = MicroBatcher(
                 engine.predict, max_batch=self.max_batch,
                 max_queue=self.max_queue,

@@ -45,7 +45,8 @@ root.lm.update({
     # envelope knob for the stacked path; docs/PARALLELISM.md)
     "model": {"dim": 64, "heads": 4, "layers": 2, "ffn_hidden": 128,
               "attn_block": None, "attn_impl": None,
-              "pallas_tile": None, "moe_experts": 0,
+              "pallas_tile": None, "attn_pipeline": False,
+              "attn_acc": None, "moe_experts": 0,
               "moe_capacity_factor": 2.0, "moe_aux_weight": 0.01,
               "stacked": False, "remat": False},
     "train": {"learning_rate": 0.05, "gradient_moment": 0.9,
@@ -198,13 +199,16 @@ def build_layers():
                 "stacked=True builds dense-FFN blocks; it cannot "
                 "honour moe_experts=%r (use the per-layer model for "
                 "MoE)" % m.moe_experts)
-        if m.get("attn_block") or m.get("attn_impl"):
+        if m.get("attn_block") or m.get("attn_impl") \
+                or m.get("attn_pipeline") \
+                or m.get("attn_acc") not in (None, "f32"):
             raise ValueError(
                 "stacked=True uses dense attention inside the block "
-                "scan; attn_block=%r / attn_impl=%r are not supported "
-                "there (use the per-layer model for flash/pallas "
-                "attention)" % (m.get("attn_block"),
-                                m.get("attn_impl")))
+                "scan; attn_block=%r / attn_impl=%r / attn_pipeline=%r "
+                "/ attn_acc=%r are not supported there (use the "
+                "per-layer model for flash/pallas attention)"
+                % (m.get("attn_block"), m.get("attn_impl"),
+                   m.get("attn_pipeline"), m.get("attn_acc")))
         layers += [
             {"type": "transformer_stack",
              "->": {"layers": m.layers, "heads": m.heads,
@@ -234,7 +238,9 @@ def build_layers():
                     "residual": True,
                     "attn_block_size": m.get("attn_block"),
                     "attn_impl": m.get("attn_impl"),
-                    "pallas_tile": m.get("pallas_tile")},
+                    "pallas_tile": m.get("pallas_tile"),
+                    "attn_pipeline": m.get("attn_pipeline", False),
+                    "attn_acc": m.get("attn_acc")},
              "<-": dict(t)},
             {"type": "layernorm", "<-": dict(t)},
             dict(ffn_layer),

@@ -217,6 +217,15 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
         self.lr_scale = 1.0
         #: accumulate gradients over N steps before applying
         self.accumulate_gradient = int(kwargs.get("accumulate_gradient", 1))
+        #: hand-fused Pallas bias-grad escape hatch
+        #: (ops/pallas_grads.py), the convert_reduce fix
+        #: (docs/repro_convert_reduce.py). None = auto: the kernel
+        #: takes over on a real TPU once $VELES_FUSED_BIAS_GRAD=1 —
+        #: opt-in until a device window validates the kernel
+        #: end-to-end, the same default-off posture as the
+        #: attn_pipeline experiment; True/False force either path
+        #: (mirrors the flash kernels' fused=False stance)
+        self.fused_bias_grad = kwargs.get("fused_bias_grad")
         # lr schedules (SURVEY.md §2.4 "LR scheduling"): pure policies
         # evaluated inside the compiled step on the traced iteration
         # counter — see veles/znicz_tpu/lr_adjust.py
@@ -440,6 +449,26 @@ class GradientDescentBase(AcceleratedUnit, IDistributable):
             self.iteration.mem[...] = t + 1
 
     # traced update ----------------------------------------------------
+
+    def bias_grad_xla(self, ctx, err2d, y2d):
+        """The f32 bias gradient ``Σ_rows err∘act'(y)`` through the
+        hand-fused Pallas kernel (``ops/pallas_grads.py``), or None
+        when the ``fused_bias_grad`` policy keeps the plain XLA
+        reduction — call sites fall back to their own masked-reduce
+        form then, so the escape hatch costs nothing when off."""
+        if self.fused_bias_grad is None:
+            import os
+            from veles.znicz_tpu.parallel.pallas_attention import \
+                TPU_PLATFORMS
+            fused = (os.environ.get("VELES_FUSED_BIAS_GRAD") == "1"
+                     and ctx._compiler.device.platform
+                     in TPU_PLATFORMS)
+        else:
+            fused = bool(self.fused_bias_grad)
+        if not fused:
+            return None
+        from veles.znicz_tpu.ops import pallas_grads as PG
+        return PG.bias_grad(err2d, y2d, self.ACTIVATION)
 
     def update_weights_xla(self, ctx, grad_w, grad_b):
         import jax.numpy as jnp

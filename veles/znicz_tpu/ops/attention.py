@@ -364,6 +364,21 @@ class MultiHeadAttention(Forward):
         #: dims where the auto tile's scoped-VMEM footprint is too
         #: large. Must divide the (per-shard) sequence length.
         self.pallas_tile = kwargs.get("pallas_tile")
+        #: DMA-pipelined Pallas forward (pallas_attention._fwd_kernel
+        #: _pipe): K/V stay in HBM, blocks double-buffer into VMEM
+        #: scratch with the next load overlapping the current matmuls
+        #: — resident VMEM stops scaling with S. Exact (pinned by
+        #: tests); off by default until measured end-to-end on TPU.
+        self.attn_pipeline = bool(kwargs.get("attn_pipeline", False))
+        #: forward-accumulator dtype experiment: "bf16" narrows the
+        #: running PV accumulation chain (softmax statistics and lse
+        #: stay f32); None/"f32" keeps exact f32 accumulation. Gated
+        #: by the numerics bound in tests/test_pallas_attention.py.
+        self.attn_acc = kwargs.get("attn_acc")
+        if self.attn_acc not in (None, "f32", "bf16"):
+            raise ValueError(
+                "attn_acc must be None, 'f32' or 'bf16', got %r"
+                % (self.attn_acc,))
 
     def output_shape_for(self, ishape):
         return tuple(ishape)
@@ -450,16 +465,31 @@ class MultiHeadAttention(Forward):
         """ONE dispatch resolver for the traced forward AND backward
         (they must agree — the cache layout follows the mode):
         "ring" | "pallas" | "scan" (blocked) | "dense"."""
+        from veles.znicz_tpu.parallel.pallas_attention import \
+            TPU_PLATFORMS
         if self.seq_mesh is not None:
-            return "ring"
-        if self.attn_impl == "pallas":
-            return "pallas"
-        if not self.attn_block_size:
-            return "dense"
-        if self.attn_impl is None and s >= self.PALLAS_AUTO_MIN_S \
-                and ctx._compiler.device.platform in ("tpu", "axon"):
-            return "pallas"
-        return "scan"
+            mode = "ring"
+        elif self.attn_impl == "pallas":
+            mode = "pallas"
+        elif not self.attn_block_size:
+            mode = "dense"
+        elif self.attn_impl is None and s >= self.PALLAS_AUTO_MIN_S \
+                and ctx._compiler.device.platform in TPU_PLATFORMS:
+            mode = "pallas"
+        else:
+            mode = "scan"
+        if mode != "pallas" and (self.attn_pipeline
+                                 or self.attn_acc == "bf16"):
+            # same loud stance as transformer_lm's stacked guard: a
+            # silently inert knob invalidates exactly the A/B the
+            # experiment knobs exist for
+            raise ValueError(
+                "attn_pipeline=%r / attn_acc=%r are only honoured on "
+                "the single-shard pallas forward, but this dispatch "
+                "resolves to %r (S=%d) — force attn_impl='pallas' or "
+                "clear the knob" % (self.attn_pipeline, self.attn_acc,
+                                    mode, s))
+        return mode
 
     def xla_run(self, ctx):
         import jax.numpy as jnp
@@ -546,13 +576,17 @@ class MultiHeadAttention(Forward):
         q/k/v in the compute dtype (bf16 on TPU): half the kernel's
         VMEM (K/V ride whole rows — the difference between S=8k
         fitting and a scoped-vmem OOM) and matched MXU input dtypes."""
+        import jax.numpy as jnp
         from veles.znicz_tpu.parallel import pallas_attention as PA
         blk = self._pallas_block()
         q, k, v = self._project_qkv(x, p, dot)
         if cd is not None:
             q, k, v = q.astype(cd), k.astype(cd), v.astype(cd)
         out_heads, lse = PA.flash_attention_fwd(
-            q, k, v, causal=self.causal, block_q=blk, block_k=blk)
+            q, k, v, causal=self.causal, block_q=blk, block_k=blk,
+            pipeline=self.attn_pipeline,
+            acc_dtype=jnp.bfloat16 if self.attn_acc == "bf16"
+            else None)
         merged = self._merge(out_heads)
         y = self._finish(x, merged, p, dot)
         return y, (q, k, v, out_heads, lse, merged)

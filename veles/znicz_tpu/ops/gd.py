@@ -76,9 +76,16 @@ class GDBase(GradientDescentBase):
                     ei.reshape(x.shape).astype(ctx.act_dtype))
         grad_w = ctx.dot(dz.T, x2) if self.weights_transposed \
             else ctx.dot(x2.T, dz)
-        # bias grad accumulates in f32 even when dz flows bf16
-        grad_b = dz.sum(axis=0, dtype=jnp.float32) \
-            if self.include_bias else None
+        # bias grad accumulates in f32 even when dz flows bf16; the
+        # fused_bias_grad hatch routes mask+reduce through the Pallas
+        # kernel (ops/pallas_grads.py) so XLA never sees a bias
+        # reduce to misfuse (docs/repro_convert_reduce.py)
+        grad_b = None
+        if self.include_bias:
+            grad_b = self.bias_grad_xla(ctx, err,
+                                        y.reshape(err.shape))
+            if grad_b is None:
+                grad_b = dz.sum(axis=0, dtype=jnp.float32)
         self.update_weights_xla(ctx, grad_w, grad_b)
 
 

@@ -119,20 +119,31 @@ class GDConvBase(GradientDescentBase):
                 preferred_element_type=jnp.float32)   # -> (C,ky,kx,K)
             grad_w = gw.transpose(3, 1, 2, 0) \
                 .reshape(f.n_kernels, f.ky * f.kx * c)
-        # bias grad as an MXU matvec (ones @ dz2) with f32 accumulate.
-        # Round-4 trace: its fusion with the activation-derivative
-        # mask runs at ~11 GB/s effective — pathological — but every
-        # measured alternative was WORSE end-to-end on the v5e:
-        # optimization_barrier on dz 8877, barrier on the 2D reshape
-        # 7950, bias grad as a ones-input-channel inside the wgrad
-        # conv 8926 (the concat copies the input per conv), vs 9060
-        # img/s for this form. The reduction is XLA's to win.
+        # bias grad: default = an MXU matvec (ones @ dz2) with f32
+        # accumulate. Round-4 trace: its fusion with the activation-
+        # derivative mask runs at ~11 GB/s effective — pathological —
+        # and every measured XLA-level rewrite was WORSE end-to-end on
+        # the v5e: optimization_barrier on dz 8877, barrier on the 2D
+        # reshape 7950, bias grad as a ones-input-channel inside the
+        # wgrad conv 8926 (the concat copies the input per conv), vs
+        # 9060 img/s for this form. The reduction could not be won at
+        # the XLA level, so the fused_bias_grad hatch (on TPU with
+        # $VELES_FUSED_BIAS_GRAD=1)
+        # now takes it OUT of XLA: the hand-fused Pallas kernel
+        # (ops/pallas_grads.py) recomputes mask+convert internally and
+        # block-reduces in f32, leaving no bias reduce for XLA's
+        # fusion pass to duplicate the producer into
+        # (docs/repro_convert_reduce.py records the evidence chain).
         if self.include_bias:
-            dz2 = dz.reshape(-1, f.n_kernels)
-            ones = jnp.ones((1, dz2.shape[0]), dz2.dtype)
-            grad_b = jax.lax.dot_general(
-                ones, dz2, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)[0]
+            grad_b = self.bias_grad_xla(
+                ctx, err.reshape(-1, f.n_kernels),
+                y.reshape(-1, f.n_kernels))
+            if grad_b is None:
+                dz2 = dz.reshape(-1, f.n_kernels)
+                ones = jnp.ones((1, dz2.shape[0]), dz2.dtype)
+                grad_b = jax.lax.dot_general(
+                    ones, dz2, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)[0]
         else:
             grad_b = None
         self.update_weights_xla(ctx, grad_w, grad_b)

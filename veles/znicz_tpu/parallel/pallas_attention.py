@@ -62,10 +62,16 @@ import functools
 import numpy
 
 
+#: jax platform names where the real Pallas kernels run (everywhere
+#: else they fall back to interpret mode) — THE shared definition;
+#: ops/pallas_grads.py and nn_units.bias_grad_xla reuse it
+TPU_PLATFORMS = ("tpu", "axon")
+
+
 def _on_tpu():
     import jax
     try:
-        return jax.devices()[0].platform in ("tpu", "axon")
+        return jax.devices()[0].platform in TPU_PLATFORMS
     except Exception:
         return False
 
@@ -132,8 +138,28 @@ def _split_loop(spans, make_body, init):
     return out
 
 
+def _online_softmax_step(jnp, s, carry, vb, acc_dtype):
+    """One K-block online-softmax update shared by the resident and
+    the DMA-pipelined forward kernels: (m, l, acc) -> new carry.
+    ``m``/``l`` always ride f32 (they feed the exact lse); ``acc``
+    rides ``acc_dtype`` — f32 by default, bf16 under the gated
+    accumulation experiment (halves the live carry footprint; the
+    numerics bound is pinned by tests/test_pallas_attention.py)."""
+    m, l, acc = carry
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    coef = jnp.exp(m - m_new)
+    l_new = l * coef + p.sum(axis=-1, keepdims=True)
+    # p in the storage dtype (bf16 on TPU) for the PV matmul — exp
+    # stays f32, the MXU gets matched input dtypes
+    pv = jnp.dot(p.astype(vb.dtype), vb,
+                 preferred_element_type=acc_dtype)
+    acc_new = (acc * coef.astype(acc_dtype)) + pv
+    return m_new, l_new, acc_new
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
-                block_k, n_kb, causal, scale):
+                block_k, n_kb, causal, scale, acc_dtype):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -147,7 +173,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
 
     def make_body(masked):
         def body(j, carry):
-            m, l, acc = carry
             kb = k_ref[0, pl.ds(j * block_k, block_k), :]
             vb = v_ref[0, pl.ds(j * block_k, block_k), :]
             s = jnp.dot(qb, kb.T,
@@ -156,21 +181,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
                 cols = j * block_k + lax.broadcasted_iota(
                     jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(cols > rows, jnp.float32(-1e9), s)
-            m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)
-            coef = jnp.exp(m - m_new)
-            l_new = l * coef + p.sum(axis=-1, keepdims=True)
-            # p in the storage dtype (bf16 on TPU) for the PV matmul —
-            # exp stays f32, the MXU gets matched input dtypes
-            acc_new = acc * coef + jnp.dot(
-                p.astype(vb.dtype), vb,
-                preferred_element_type=jnp.float32)
-            return m_new, l_new, acc_new
+            return _online_softmax_step(jnp, s, carry, vb, acc_dtype)
         return body
 
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, dh), jnp.float32)
+    acc0 = jnp.zeros((block_q, dh), acc_dtype)
     if causal:
         # K blocks past this Q block's last row are all-masked — skip
         # them entirely; only the diagonal remnant needs the mask
@@ -180,8 +196,83 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
     else:
         spans = [(0, n_kb, False)]
     m, l, acc = _split_loop(spans, make_body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    o_ref[0] = (acc.astype(jnp.float32) / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)                     # (bq, 1)
+
+
+def _fwd_kernel_pipe(q_ref, k_hbm, v_hbm, o_ref, lse_ref, *, block_q,
+                     block_k, n_kb, causal, scale, acc_dtype,
+                     kv_dtype):
+    """DMA-PIPELINED forward: K/V stay in HBM and each (block_k, dh)
+    tile is double-buffered into VMEM scratch — the j+1 copy is in
+    flight while block j computes, and resident VMEM drops from two
+    full S·dh rows to four block tiles (the escape past the ~16k-token
+    whole-row ceiling documented in the module header). The causal
+    diagonal split is traded for an always-applied mask (a no-op on
+    fully-unmasked blocks): chaining two fori_loops would force a
+    second DMA warmup at the seam, costing more than the ~2 VPU passes
+    the split saves. The fully-masked tail blocks are still skipped —
+    the loop bound ``hi`` is unchanged."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    qb = q_ref[0]                                   # (bq, dh)
+    bq, dh = qb.shape
+    rows = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    hi = pl.cdiv((qi + 1) * block_q, block_k) if causal else n_kb
+
+    def run(kbuf, vbuf, ksem, vsem):
+        def dma(slot, j):
+            sl = pl.ds(j * block_k, block_k)
+            return (pltpu.make_async_copy(k_hbm.at[bh, sl, :],
+                                          kbuf.at[slot],
+                                          ksem.at[slot]),
+                    pltpu.make_async_copy(v_hbm.at[bh, sl, :],
+                                          vbuf.at[slot],
+                                          vsem.at[slot]))
+
+        for d in dma(0, 0):        # warm up: hi >= 1 always (the
+            d.start()              # diagonal block exists)
+
+        def body(j, carry):
+            slot = lax.rem(j, 2)
+
+            @pl.when(j + 1 < hi)
+            def _next():
+                for d in dma(lax.rem(j + 1, 2), j + 1):
+                    d.start()
+
+            for d in dma(slot, j):
+                d.wait()
+            kb = kbuf[slot]
+            vb = vbuf[slot]
+            s = jnp.dot(qb, kb.T,
+                        preferred_element_type=jnp.float32) * scale
+            if causal:
+                cols = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(cols > rows, jnp.float32(-1e9), s)
+            return _online_softmax_step(jnp, s, carry, vb, acc_dtype)
+
+        m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((block_q, 1), jnp.float32)
+        acc0 = jnp.zeros((block_q, dh), acc_dtype)
+        m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+        o_ref[0] = (acc.astype(jnp.float32) / l).astype(o_ref.dtype)
+        lse_ref[0] = m + jnp.log(l)                 # (bq, 1)
+
+    pl.run_scoped(
+        run,
+        kbuf=pltpu.VMEM((2, block_k, dh), kv_dtype),
+        vbuf=pltpu.VMEM((2, block_k, dh), kv_dtype),
+        ksem=pltpu.SemaphoreType.DMA((2,)),
+        vsem=pltpu.SemaphoreType.DMA((2,)))
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -382,12 +473,24 @@ def _specs(block_rows, s, dh):
 
 
 def flash_attention_fwd(q, k, v, causal=True, block_q=128,
-                        block_k=128, interpret=None):
+                        block_k=128, interpret=None, pipeline=False,
+                        acc_dtype=None):
     """q/k/v: (B, H, S, dh) → (out, lse); exact. Blocks must divide
-    S. Runs the real kernel on TPU, interpret mode elsewhere."""
+    S. Runs the real kernel on TPU, interpret mode elsewhere.
+
+    ``pipeline=True`` keeps K/V in HBM and double-buffers each block
+    into VMEM scratch (``_fwd_kernel_pipe``): the next block's DMA
+    overlaps the current block's matmuls, and the kernel's resident
+    VMEM no longer scales with S — the long-context escape hatch past
+    the whole-row ceiling. ``acc_dtype`` (default f32) sets the
+    running-context accumulator dtype; ``jnp.bfloat16`` is the gated
+    accumulation experiment — lse/softmax statistics stay f32 either
+    way, so only the PV accumulation chain narrows (error bound
+    pinned by the numerics test)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, dh = q.shape
     block_q = min(block_q, s)
@@ -397,15 +500,27 @@ def flash_attention_fwd(q, k, v, causal=True, block_q=128,
                          % (block_q, block_k, s))
     if interpret is None:
         interpret = not _on_tpu()
+    if acc_dtype is None:
+        acc_dtype = jnp.float32
     scale = numpy.float32(1.0 / numpy.sqrt(dh))
     qf = q.reshape(b * h, s, dh)
     blocked, full, vec, _ = _specs(block_q, s, dh)
+    if pipeline:
+        kernel = functools.partial(
+            _fwd_kernel_pipe, block_q=block_q, block_k=block_k,
+            n_kb=s // block_k, causal=causal, scale=scale,
+            acc_dtype=acc_dtype, kv_dtype=k.dtype)
+        kv_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    else:
+        kernel = functools.partial(
+            _fwd_kernel, block_q=block_q, block_k=block_k,
+            n_kb=s // block_k, causal=causal, scale=scale,
+            acc_dtype=acc_dtype)
+        kv_spec = full
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_q=block_q,
-                          block_k=block_k, n_kb=s // block_k,
-                          causal=causal, scale=scale),
+        kernel,
         grid=(b * h, s // block_q),
-        in_specs=[blocked, full, full],
+        in_specs=[blocked, kv_spec, kv_spec],
         out_specs=[blocked, vec],
         out_shape=[jax.ShapeDtypeStruct((b * h, s, dh), q.dtype),
                    jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32)],
