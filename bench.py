@@ -277,6 +277,45 @@ def _profiler_row(extra):
         extra["profiler_overhead_pct_error"] = str(exc)[:200]
 
 
+def model_stats_overhead_pct(measure_chunks=2):
+    """ISSUE 15 satellite: percent step-time cost of the in-graph
+    model-health stats (per-GD-unit grad/weight/update norms +
+    non-finite counts fused into the compiled step —
+    veles/model_health.py). Measured off-on-off on the SAME XLA MNIST
+    chunk loop the throughput row uses, so ambient drift cancels:
+    overhead = 1 - rate(on) / mean(rate(off_before), rate(off_after)),
+    floored at 0. Each toggle re-keys the compiled program
+    (collect_stats is part of the compile-cache key) and
+    _timed_chunks' warmup chunk absorbs the rebuild before timing.
+    Acceptance: < 2%."""
+    wf = _build_mnist("xla", "BenchStatsOverhead", max_epochs=4096)
+    loader, step = wf.loader, wf.xla_step
+    step.epochs_per_dispatch = 16
+    counter = _train_counter(loader)
+
+    def rate(enabled):
+        step.set_stats_enabled(enabled)
+        best, _median = _timed_chunks(loader, step, counter,
+                                      measure_chunks)
+        return best
+
+    r_off1 = rate(False)
+    r_on = rate(True)
+    r_off2 = rate(False)
+    r_off = (r_off1 + r_off2) / 2.0
+    return max((1.0 - r_on / r_off) * 100.0, 0.0)
+
+
+def _model_stats_row(extra):
+    """Record the model-stats-overhead bench guarded (runs on any jax
+    backend). Key says 'overhead' -> the self-check flags UP moves."""
+    try:
+        extra["model_stats_overhead_pct"] = round(
+            model_stats_overhead_pct(), 2)
+    except Exception as exc:
+        extra["model_stats_overhead_pct_error"] = str(exc)[:200]
+
+
 def _run_one_chunk(loader, step):
     """Serve exactly one dispatch chunk (the serve that crosses into an
     undispatched epoch triggers the next chunk). The ONE place that
@@ -1263,6 +1302,7 @@ def main(argv=None):
         _grad_codec_rows(extra)
         _dist_scaling_rows(extra)
         _profiler_row(extra)
+        _model_stats_row(extra)
         _lint_row(extra)
         return emit({
             "metric": "mnist_train_steps_per_sec",
@@ -1326,6 +1366,9 @@ def main(argv=None):
     # sampling-profiler cost on the same MNIST loop (ISSUE 10; the
     # acceptance bound is < 3% at the default 97 Hz)
     _profiler_row(extra)
+    # in-graph model-health stats cost, off-on-off on the XLA chunk
+    # loop (ISSUE 15; acceptance < 2%, up = bad)
+    _model_stats_row(extra)
     # the analyzer's own full-tree cost (ISSUE 12; up = bad)
     _lint_row(extra)
     # attention-aware MFU for every at-scale LM row (VERDICT r4 #2):

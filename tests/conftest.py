@@ -66,6 +66,17 @@ def _telemetry_isolation():
 
 
 @pytest.fixture(autouse=True)
+def _model_health_isolation():
+    """Each test gets a fresh model-health monitor
+    (veles/model_health.py): layer stats, the loss EWMA and the
+    divergence verdict one test's training run produces can never
+    leak into another's /debug/model or SLO evaluation."""
+    from veles import model_health
+    with model_health.scoped():
+        yield
+
+
+@pytest.fixture(autouse=True)
 def _health_isolation():
     """Each test gets a fresh health monitor (veles/health.py): the
     readiness checks and SLO alert state one test registers (web

@@ -1740,6 +1740,114 @@ def test_cli_select_runs_only_selected(tmp_path, capsys):
     assert "unused-import" in out and "bare-except" not in out
 
 
+# -- stats-cadence (ISSUE 15) ------------------------------------------
+
+_STATS_BAD = """\
+import numpy
+
+
+class Step:
+    def publish(self, outputs):
+        stats = {k[5:]: v for k, v in outputs.items()
+                 if k.startswith("stat/")}
+        for layer, vec in stats.items():
+            self.sink(layer, numpy.asarray(vec))   # per-step sync
+            self.loss = float(vec[0])              # and another
+"""
+
+_STATS_GOOD = """\
+import numpy
+
+
+class Step:
+    def _stats_due(self):
+        self._tick += 1
+        return self._tick % self.stats_interval == 0
+
+    def publish(self, outputs):
+        stats = {k[5:]: v for k, v in outputs.items()
+                 if k.startswith("stat/")}
+        if not self._stats_due():
+            return
+        for layer, vec in stats.items():
+            self.sink(layer, numpy.asarray(vec))
+"""
+
+
+def test_stats_cadence_fires_on_ungated_materialization(tmp_path):
+    """Satellite (ISSUE 15): a function handling "stat/"-keyed step
+    outputs that materializes them (asarray + float) without ever
+    consulting a stats_due gate fires once per materializer."""
+    findings = lint_src(tmp_path, _STATS_BAD,
+                        select=["stats-cadence"])
+    assert set(rule_ids(findings)) == {"stats-cadence"}
+    assert len(findings) == 2
+    messages = " | ".join(f.message for f in findings)
+    assert "'asarray'" in messages and "'float'" in messages
+    assert "cadence" in findings[0].message
+
+
+def test_stats_cadence_quiet_when_gated_and_on_sink(tmp_path):
+    """The compliant shape — materialization behind a stats_due gate
+    — is quiet; so is the observe_stats sink itself (every caller is
+    forced through the gate) and pure key routing with no
+    materializer."""
+    assert lint_src(tmp_path, _STATS_GOOD,
+                    select=["stats-cadence"]) == []
+    sink = """\
+import numpy
+
+
+class Monitor:
+    def observe_stats(self, layer_stats, step_index=None):
+        for layer, vec in layer_stats.items():
+            self.layers[layer] = float(numpy.asarray(vec)[0])
+"""
+    assert lint_src(tmp_path, sink, select=["stats-cadence"]) == []
+    routing = """\
+STAT_KEY_PREFIX = "stat/"
+
+
+def take_stats(outputs):
+    stats, rest = {}, {}
+    for key, value in outputs.items():
+        if key.startswith(STAT_KEY_PREFIX):
+            stats[key[len(STAT_KEY_PREFIX):]] = value
+        else:
+            rest[key] = value
+    return stats, rest
+"""
+    assert lint_src(tmp_path, routing,
+                    select=["stats-cadence"]) == []
+
+
+def test_stats_cadence_fires_on_sink_caller_and_pragma(tmp_path):
+    """Calling the observe_stats sink marks a function stat-handling
+    even without the string marker; the pragma escape works."""
+    caller = """\
+import numpy
+
+
+class Step:
+    def flush(self, vecs):
+        host = [numpy.asarray(v) for v in vecs]
+        self.monitor.observe_stats(dict(enumerate(host)))
+"""
+    findings = lint_src(tmp_path, caller, select=["stats-cadence"])
+    assert rule_ids(findings) == ["stats-cadence"]
+    pragma = """\
+import numpy
+
+
+class Step:
+    def flush(self, vecs):
+        host = [numpy.asarray(v) for v in vecs]  # zlint: disable=stats-cadence (one-shot postmortem dump, not a per-step path)
+        self.monitor.observe_stats(dict(enumerate(host)))
+"""
+    assert lint_src(tmp_path, pragma,
+                    select=["stats-cadence"]) == []
+
+
 # -- the permanent gate ------------------------------------------------
 
 

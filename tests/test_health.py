@@ -538,6 +538,7 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                 "bias_grad_step_seconds": 0.002,
                 "serving_cache_bytes_int8": 200000,
                 "serving_throughput_rps_int8": 3000.0,
+                "model_stats_overhead_pct": 0.5,
                 "some_row_error": "boom",
             }}}
     path = tmp_path / "BENCH_r07.json"
@@ -567,6 +568,9 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
             "bias_grad_step_seconds": 0.004,               # +100%: bad
             "serving_cache_bytes_int8": 400000,            # +100%: bad
             "serving_throughput_rps_int8": 3300.0,         # +10%: fine
+            # ISSUE 15: in-graph model-stat cost is an overhead — UP
+            # is the bad direction ("overhead" is in _LOWER_BETTER)
+            "model_stats_overhead_pct": 1.8,               # +260%: bad
         }}
     regressed = bench.self_check(report, threshold_pct=10.0,
                                  baseline_path=str(path))
@@ -582,7 +586,8 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                               "generate_first_token_latency_s",
                               "lm_mfu_s8192",
                               "bias_grad_step_seconds",
-                              "serving_cache_bytes_int8"}
+                              "serving_cache_bytes_int8",
+                              "model_stats_overhead_pct"}
     assert "REGRESSION" in err and "warn-only" in err
     assert "_best" not in err.split("rows in baseline")[0]
     # no baseline -> a note, no crash, nothing regressed

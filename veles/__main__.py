@@ -210,6 +210,35 @@ def build_argparser():
     p.add_argument("--ensemble", type=int, default=None, metavar="N",
                    help="train N differently-seeded instances and "
                         "report ensemble vs member validation error")
+    p.add_argument("--model-stats", choices=("on", "off"),
+                   default="on",
+                   help="in-graph model-health stats on the compiled "
+                        "step (per-layer grad/weight/update norms, "
+                        "non-finite counts -> veles_model_* "
+                        "instruments, /debug/model, divergence SLOs; "
+                        "veles/model_health.py). Default on; 'off' "
+                        "removes the fused stat outputs entirely")
+    p.add_argument("--stats-interval", type=int, default=None,
+                   metavar="N",
+                   help="host-sync cadence of the in-graph stats: "
+                        "publish every Nth train step's vectors "
+                        "(default 8; materializing more often costs "
+                        "a device sync per step in per-step mode)")
+    p.add_argument("--rollback-on-divergence", action="store_true",
+                   help="when the model-health verdict flips to "
+                        "diverged (non-finite grads/deltas, loss "
+                        "z-score spike), restore the last healthy "
+                        "weights: NNRollback's stash in standalone "
+                        "mode, the master's finiteness-checked RAM "
+                        "stash in master mode")
+    p.add_argument("--stash-interval", type=int, default=None,
+                   metavar="N",
+                   help="master mode, with --rollback-on-divergence: "
+                        "refresh the rollback stash every Nth merge "
+                        "(default 1 = every merge; each refresh is a "
+                        "full-model RAM copy + finiteness scan under "
+                        "the request lock, so large models amortize "
+                        "it — a restore discards at most N merges)")
     return p
 
 
@@ -303,7 +332,11 @@ class Main:
             checkpoint_every=args.checkpoint_every,
             grad_codec=args.grad_codec,
             grad_topk_percent=args.grad_topk_percent,
-            slo_config=args.slo_config)
+            slo_config=args.slo_config,
+            model_stats=args.model_stats != "off",
+            stats_interval=args.stats_interval,
+            rollback_on_divergence=args.rollback_on_divergence,
+            stash_interval=args.stash_interval)
         if args.graphics_dir and not getattr(
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):
@@ -592,16 +625,19 @@ def checkpoints_main(argv):
             age = round(_time.time() - info.wall_time, 1)
         rows.append({"name": info.name, "status": info.status,
                      "slot": m.get("slot"), "schema": m.get("schema"),
-                     "age_s": age, "error": info.error})
+                     "age_s": age, "error": info.error,
+                     "verdict": info.health_verdict})
     if args.json:
         print(json.dumps(rows, indent=2))
     else:
-        print("%-8s %-9s %-7s %12s  %s"
-              % ("STATUS", "SLOT", "SCHEMA", "AGE(s)", "NAME"))
+        print("%-8s %-9s %-7s %-9s %12s  %s"
+              % ("STATUS", "SLOT", "SCHEMA", "VERDICT", "AGE(s)",
+                 "NAME"))
         for r in rows:
-            print("%-8s %-9s %-7s %12s  %s"
+            print("%-8s %-9s %-7s %-9s %12s  %s"
                   % (r["status"], r["slot"] or "-",
                      r["schema"] if r["schema"] is not None else "-",
+                     r["verdict"] or "-",
                      r["age_s"] if r["age_s"] is not None else "-",
                      r["name"]))
             if r["error"]:

@@ -156,6 +156,16 @@ class FlowContext:
         self.axis_name = axis_name  # set when traced under shard_map
         self.values = {}            # (producer_unit_name, attr) -> tensor
         self.outputs = {}           # exported outputs (metrics etc.)
+        #: model-health plane (veles/model_health.py): when set, GD
+        #: units export their per-layer stat vector as one extra fused
+        #: output — a compile-time variant, keyed into the program
+        #: caches below. ``stats_stride`` is the IN-GRAPH cadence: the
+        #: reduces run under a lax.cond every Nth train step (sentinel
+        #: rows otherwise), so the steady-state cost amortizes
+        self.collect_stats = bool(
+            getattr(compiler, "collect_stats", False)) and train
+        self.stats_stride = int(
+            getattr(compiler, "stats_stride", 1) or 1)
 
     # value routing ----------------------------------------------------
 
@@ -296,6 +306,11 @@ class StepCompiler:
         # aliasing path) — so only donate on real accelerators
         self.donate = bool(donate) and \
             getattr(device, "platform", None) != "cpu"
+        #: in-graph model-stat collection (veles/model_health.py):
+        #: toggled by XLAStep; both are part of every compile-cache
+        #: key, since they change the traced program
+        self.collect_stats = False
+        self.stats_stride = 1
         self._compiled = {}
 
     # pytree assembly ---------------------------------------------------
@@ -364,7 +379,7 @@ class StepCompiler:
     def compile(self, batch_spec, train=True):
         key = (tuple(sorted((name, unit.name, attr)
                             for name, (unit, attr) in batch_spec.items())),
-               train)
+               train, self.collect_stats, self.stats_stride)
         if key not in self._compiled:
             t0 = time.perf_counter()
             self._compiled[key] = self.build_step(batch_spec, train=train)
@@ -463,7 +478,8 @@ class StepCompiler:
                             for name, (unit, attr) in batch_spec.items())),
                tuple((k, t, tuple(u.name for u in us))
                      for k, t, us in segments),
-               _transform_key(transform))
+               _transform_key(transform), self.collect_stats,
+               self.stats_stride)
         if key not in self._compiled:
             t0 = time.perf_counter()
             self._compiled[key] = self.build_epoch_scan(
@@ -526,7 +542,8 @@ class StepCompiler:
                tuple(sorted((name, unit.name, attr)
                             for name, (unit, attr) in batch_spec.items())),
                train, tuple(u.name for u in units),
-               _transform_key(transform))
+               _transform_key(transform), self.collect_stats,
+               self.stats_stride)
         if key not in self._compiled:
             t0 = time.perf_counter()
             self._compiled[key] = self.build_window_scan(

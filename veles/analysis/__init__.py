@@ -57,6 +57,10 @@ rule id                   checks
 ``loop-exception-safety``  call chains reachable from reactor
                           callbacks must not raise exception types
                           no frame on the chain catches
+``stats-cadence``         in-graph model-stat outputs (the
+                          model-health plane's per-layer vectors)
+                          materialize on the host only behind the
+                          ``stats_due`` cadence gate — never per step
 ``thread-lifecycle``      threads must be daemons or have a join path
 ``bare-except``           ``except:`` swallows ``KeyboardInterrupt``
 ``unused-import``         dead module-level imports

@@ -345,9 +345,9 @@ def _load_rules():
     # import for registration side effects (keeps RULES the single
     # source the CLI, tests and docs iterate)
     from veles.analysis import (        # noqa: F401
-        rules_hygiene, rules_loop, rules_probes, rules_profiler,
-        rules_purity, rules_reactor, rules_resources, rules_state,
-        rules_telemetry, rules_threads, rules_wire)
+        rules_hygiene, rules_loop, rules_model_stats, rules_probes,
+        rules_profiler, rules_purity, rules_reactor, rules_resources,
+        rules_state, rules_telemetry, rules_threads, rules_wire)
 
 
 def iter_py_files(paths):

@@ -31,11 +31,51 @@ import struct
 import threading
 import time
 
+import numpy
+
 from veles.logger import Logger
 from veles.server import _recv_exact, decode_frame_payload
 
 
 # -- checkpoint/blob corruption (the disk-side fault models) -----------
+
+
+def poison_update(update, mode="nan", layer=None, key=None):
+    """The model-divergence fault (ISSUE 15): poison ONE delta array
+    of a generated update payload IN PLACE — the first float array of
+    the first (sorted) unit section, or the named ``layer``/``key`` —
+    by writing NaN/inf into its element 0. What a blown-up or
+    bit-flipped slave ships upstream; the master's wire non-finite
+    scan (``apply_data_from_slave`` →
+    ``model_health.note_wire_nonfinite``) must catch it, fire the
+    divergence SLO and trigger the rollback actuator.
+
+    -> ``(unit_name, entry_key)`` of what was poisoned. Raises
+    ValueError when the payload holds no poisonable float array (a
+    test asking to poison an eval-only update must fail loudly, not
+    silently pass a clean payload through)."""
+    bad = float("nan") if mode == "nan" else float("inf")
+    for uname in sorted(update):
+        if layer is not None and uname != layer:
+            continue
+        payload = update[uname]
+        if not isinstance(payload, dict):
+            continue
+        for entry in sorted(payload):
+            if key is not None and entry != key:
+                continue
+            value = payload[entry]
+            if isinstance(value, numpy.ndarray) \
+                    and value.dtype.kind == "f" and value.size:
+                # .flat writes through ANY memory layout; a
+                # reshape(-1) assignment would land in a silent COPY
+                # for non-contiguous arrays and the injection would
+                # claim success against a clean payload
+                value.flat[0] = bad
+                return uname, entry
+    raise ValueError(
+        "no poisonable float delta in update payload (units: %s)"
+        % sorted(update))
 
 
 def truncate_blob(blob, frac=0.5):

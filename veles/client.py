@@ -365,6 +365,15 @@ class SlaveClient(Logger):
         # total job wall time: what the master subtracts from its
         # serve→update round-trip to attribute the WIRE portion
         tele["job_seconds"] = t3 - t0
+        # model-health summary (ISSUE 15): compact per-layer stats +
+        # verdict ride the same __telemetry__ side channel, so the
+        # master republishes them slave-labelled and ONE scrape sees
+        # cluster-wide training health. Skipped while this process
+        # has no observations yet (nothing to ship).
+        from veles import model_health
+        summary = model_health.get_model_monitor().push_summary()
+        if summary["layers"] or summary["loss"] is not None:
+            tele["model"] = summary
         if spans:
             tele["spans"] = spans
         update["__telemetry__"] = tele

@@ -278,6 +278,18 @@ def scrape_target(base, timeout=5.0, total=None, extras=True):
             row["critical_path"] = doc
     except Exception:
         pass
+    # model health (ISSUE 15): the training-dynamics verdict +
+    # loss/grad-norm snapshot — a 404/garbled answer from a target
+    # that predates /debug/model degrades the row, never errors it
+    try:
+        if spent():
+            raise TimeoutError("scrape budget spent")
+        code, doc = _fetch_json(base + "/debug/model", budget())
+        if code == 200 and isinstance(doc, dict) \
+                and "verdict" in doc:
+            row["model"] = doc
+    except Exception:
+        pass
     row["role"] = "router" if "router" in row else (
         "master" if "master" in row else (
             "serving" if "serving" in row else "process"))
@@ -443,6 +455,35 @@ def render_snapshot(snap):
                        dec.get("kv_slots_in_use"),
                        dec.get("kv_pool_slots"),
                        dec.get("queue_depth")))
+        # model health (ISSUE 15): loss + trend, worst layer grad
+        # norm and the divergence verdict in one glance — absent on
+        # pre-ISSUE-15 targets or before any observation, which must
+        # only degrade the row
+        model = row.get("model")
+        if isinstance(model, dict) and (
+                model.get("loss") is not None
+                or model.get("layers")
+                or model.get("verdict") not in (None, "healthy")):
+            # every scraped field is untrusted (version skew, or a
+            # foreign service on that port): type-check before
+            # formatting, so a garbled doc degrades this row instead
+            # of crashing the whole render
+            bits = []
+            if isinstance(model.get("loss"), (int, float)):
+                bits.append("loss %.5g (%s)"
+                            % (model["loss"],
+                               model.get("loss_trend", "flat")))
+            gns = [d.get("grad_norm")
+                   for d in (model.get("layers") or {}).values()
+                   if isinstance(d, dict)
+                   and isinstance(d.get("grad_norm"), (int, float))]
+            if gns:
+                bits.append("grad-norm %.3g" % max(gns))
+            if isinstance(model.get("rollbacks"), (int, float)) \
+                    and model["rollbacks"]:
+                bits.append("rollbacks %d" % model["rollbacks"])
+            bits.append("verdict %s" % model.get("verdict", "?"))
+            detail.append("model: " + ", ".join(bits))
         # host RSS and reactor lag side by side (ISSUE 10): one glance
         # gives "how much memory, how healthy the loop" per target —
         # either may be absent (pre-PR-9/10 process) without a row

@@ -80,6 +80,11 @@ DEFAULT_PREFIXES = (
     # objectives can fire on router-observed p99 and the autoscaler's
     # own decisions are trendable in /metrics/history
     "veles_router_",
+    # model health (ISSUE 15, veles/model_health.py): per-layer
+    # grad/weight norms, loss z-score, non-finite step counts and the
+    # verdict gauge — ring-sampled so the divergence SLOs
+    # (install_model_slos) evaluate over them
+    "veles_model_",
 )
 
 #: sampler cadence (seconds) and ring capacity: 1 Hz x 900 samples =

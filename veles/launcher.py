@@ -38,7 +38,9 @@ class Launcher(Logger):
                  profile_dir=None, slave_timeout=None,
                  slave_options=None, checkpoint_every=None,
                  grad_codec=None, grad_topk_percent=None,
-                 slo_config=None):
+                 slo_config=None, model_stats=True,
+                 stats_interval=None, rollback_on_divergence=False,
+                 stash_interval=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
@@ -66,6 +68,19 @@ class Launcher(Logger):
         #: health monitor (veles/health.py): burn-rate alerts land in
         #: /readyz, /debug/events and the veles_slo_* gauges
         self.slo_config = slo_config
+        #: model-health plane (veles/model_health.py): in-graph layer
+        #: stats on the compiled step (--model-stats off disables),
+        #: the host-sync cadence, and the divergence actuator —
+        #: NNRollback in standalone mode, the master's WeightGuard in
+        #: master mode
+        self.model_stats = bool(model_stats)
+        self.stats_interval = stats_interval
+        self.rollback_on_divergence = bool(rollback_on_divergence)
+        #: master mode: merges between WeightGuard stash refreshes —
+        #: each stash is a full-model RAM copy + finiteness scan under
+        #: the request lock, so large models amortize it (a restore
+        #: then discards at most this many merges)
+        self.stash_interval = stash_interval
         self.workflow = None
         self.interrupted = False
         #: True once SIGTERM asked for a preemption shutdown: the run
@@ -146,7 +161,50 @@ class Launcher(Logger):
             n = health.get_monitor().load_slo_file(self.slo_config)
             self.info("%d SLO objective(s) loaded from %s", n,
                       self.slo_config)
+        self._wire_model_health(workflow)
         return workflow
+
+    def _wire_model_health(self, workflow):
+        """Model-health plane wiring (ISSUE 15): stat collection knobs
+        on the compiled step, the divergence SLOs + readiness check,
+        and the --rollback-on-divergence actuator."""
+        from veles import model_health
+        step = getattr(workflow, "xla_step", None)
+        if not self.model_stats:
+            # the WHOLE plane stands down, not just the in-graph
+            # stats: with the detector's other inputs (loss z-score,
+            # wire scans) left armed, a verdict could still stamp
+            # checkpoints diverged — actuation the operator turned
+            # the observability off for
+            model_health.get_model_monitor().enabled = False
+        if step is not None:
+            if not self.model_stats:
+                step.set_stats_enabled(False)
+            if self.stats_interval:
+                # the stride is a compile-time knob: sync the compiler
+                # and drop the cached per-step programs (none compiled
+                # yet on this path — initialize just ran)
+                step.stats_interval = max(1, int(self.stats_interval))
+                if step.compiler is not None:
+                    step.compiler.stats_stride = step.stats_interval
+                    step._train_fn = step._eval_fn = None
+        if not self.model_stats:
+            return
+        monitor = model_health.get_model_monitor()
+        monitor.register_health()
+        n = model_health.install_model_slos()
+        if n:
+            self.info("model-health plane armed: %d divergence SLO "
+                      "objective(s), verdict check on /readyz", n)
+        if self.rollback_on_divergence:
+            rollback = getattr(workflow, "rollback", None)
+            if rollback is not None:
+                rollback.rollback_on_divergence = True
+            elif self.mode == "standalone":
+                self.warning(
+                    "--rollback-on-divergence: workflow has no "
+                    "rollback unit (link_rollback) — divergence will "
+                    "flip /readyz but nothing restores weights")
 
     # -- resume --------------------------------------------------------
 
@@ -312,6 +370,10 @@ class Launcher(Logger):
                               resume_state=self._master_resume,
                               grad_codec=self.grad_codec,
                               grad_topk_percent=self.grad_topk_percent,
+                              rollback_on_divergence=(
+                                  self.rollback_on_divergence
+                                  and self.model_stats),
+                              stash_interval=self.stash_interval or 1,
                               **kwargs)
         self.master_server = server
         if self.preempted:

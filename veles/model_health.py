@@ -1,0 +1,780 @@
+"""Model-health plane: training-dynamics telemetry + divergence SLOs.
+
+PRs 3/6/8/10/13 built a complete PROCESS-level observability stack —
+it can say a replica is slow, leaking, or unreachable, but not that
+the model it trains is diverging. This module is the MODEL side (the
+modernization of the reference's Decision/plotter observability,
+SURVEY.md §2.4/§2.7): a per-process :class:`ModelHealthMonitor` that
+consumes
+
+* **in-graph layer stats** — each compiled step optionally exports a
+  compact per-GD-unit vector (gradient/weight/update L2 norms +
+  non-finite count) computed INSIDE the trace
+  (``GradientDescentBase.update_weights_xla``) as one fused extra
+  output; the host materializes it only at XLAStep's cadence-gated
+  publish path (zlint ``stats-cadence`` bans per-step
+  materialization anywhere else);
+* **evaluation-tick losses** — ``DecisionBase`` feeds each epoch's
+  judged loss; an EWMA mean/variance pair turns it into a z-score;
+* **wire-side non-finite counts** — the master counts NaN/inf in
+  every decoded slave delta (``apply_data_from_slave``), so a
+  poisoned update is attributed before it can burn an epoch;
+* **slave-shipped summaries** — slaves ride a compact model summary
+  on the existing ``__telemetry__`` update path; the master republishes
+  them ``slave="N"``-labelled, so ONE scrape sees cluster-wide
+  training health;
+* **serving drift** — cheap per-batch output-distribution gauges
+  (logit entropy, top-1 margin) per served model.
+
+Everything lands in ``veles_model_*`` instruments (ring-sampled by the
+health plane, so threshold SLOs evaluate over them), a cached
+verdict — ``healthy`` / ``suspect`` / ``diverged`` — served as
+``GET /debug/model`` on web-status and the serving frontend, a
+``model:`` row in ``velescli top``, and ``model_divergence``
+flight-recorder events. :func:`install_model_slos` wires the detector
+into the PR-8 burn-rate engine (alerts flip ``/readyz`` naming the
+objective), the snapshotter stamps the current verdict into each
+checkpoint MANIFEST (``resolve_auto`` and the serving registry skip
+``diverged`` blobs), and :class:`WeightGuard` — the master-side
+``--rollback-on-divergence`` actuator — restores the last healthy
+weight stash the moment the verdict flips.
+"""
+
+import math
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy
+
+from veles import telemetry
+from veles.logger import Logger
+
+#: step-output key marker for in-graph layer stats: a GD unit exports
+#: ``STAT_KEY_PREFIX + unit_name`` -> a float32 ``STAT_FIELDS`` vector
+STAT_KEY_PREFIX = "stat/"
+
+#: the per-layer stat vector layout (order is the wire/trace contract)
+STAT_FIELDS = ("grad_norm", "weight_norm", "update_ratio", "nonfinite")
+
+#: verdict ladder (gauge encoding: healthy=0, suspect=1, diverged=2)
+VERDICTS = ("healthy", "suspect", "diverged")
+
+
+def take_stats(outputs):
+    """Split a step-output dict into ``(stats, rest)`` where ``stats``
+    maps layer name -> still-device stat vector. Pure key routing — no
+    host materialization happens here (that belongs to the
+    cadence-gated publish path; zlint ``stats-cadence``)."""
+    stats, rest = {}, {}
+    for key, value in outputs.items():
+        if key.startswith(STAT_KEY_PREFIX):
+            stats[key[len(STAT_KEY_PREFIX):]] = value
+        else:
+            rest[key] = value
+    return stats, rest
+
+
+class ModelHealthMonitor(Logger):
+    """Per-process model-health state: layer stats, loss trajectory,
+    divergence verdict.
+
+    All observation methods are cheap and lock-guarded; the verdict is
+    rebuilt on every observation and cached, so HTTP handlers
+    (``/debug/model``) and readiness checks read a dict replaced
+    wholesale — the same never-blocks discipline as
+    :class:`veles.health.HealthMonitor`.
+
+    Detector policy (each observation contributes reasons):
+
+    * any non-finite — in-graph stat vectors, wire deltas, weight
+      scans, the loss itself — is **diverged** immediately;
+    * loss EWMA z-score ≥ ``suspect_z`` is **suspect**, ≥
+      ``diverged_z`` is **diverged** (the loss-spike detector);
+    * gradient-norm explosion: a layer's grad norm ≥
+      ``explosion_factor ×`` its own EWMA is **suspect**;
+    * ``recover_after`` consecutive clean observations clear the
+      verdict back to healthy (a rollback's restored weights produce
+      them, so readiness recovers without operator action).
+    """
+
+    def __init__(self, suspect_z=4.0, diverged_z=8.0,
+                 explosion_factor=10.0, ewma_alpha=0.2,
+                 recover_after=3):
+        self.name = "model_health"
+        self.suspect_z = float(suspect_z)
+        self.diverged_z = float(diverged_z)
+        self.explosion_factor = float(explosion_factor)
+        self.ewma_alpha = float(ewma_alpha)
+        self.recover_after = int(recover_after)
+        #: master switch (--model-stats off clears it): a disabled
+        #: plane still records gauges but never judges — the verdict
+        #: stays healthy, so checkpoint stamping, resolve_auto
+        #: skipping, readiness and the rollback actuators all stay
+        #: inert. Actuation without its observability (an operator who
+        #: turned the plane off losing checkpoints to a silent
+        #: diverged stamp) is the failure mode this guards.
+        self.enabled = True
+        #: wire-note recovery pacing (seconds): after a non-finite
+        #: wire observation, clean per-unit merge notes count as at
+        #: most ONE healthy observation per this interval — longer
+        #: than the health ring's 1 Hz sampling, so the spiked
+        #: nonfinite_step gauge is guaranteed at least one ring
+        #: sample before it recovers (a per-note or per-16-notes
+        #: reset would clear within the same update frame on models
+        #: with many GD units, and the SLO would never see it)
+        self.wire_recovery_interval = 1.5
+        self._clean_wire_last = None
+        #: serving-drift sampling stride: compute the entropy/margin
+        #: gauges on every Nth dispatched batch per model — the same
+        #: amortization stance as the training-side stats_interval
+        #: (an O(batch x classes) softmax per batch on a vocab-wide
+        #: head would tax the single batcher worker)
+        self.serving_stride = 16
+        self._serving_ticks = {}
+        self._lock = threading.Lock()
+        #: layer name -> {field: float} (latest published stats)
+        self._layers = {}
+        #: layer name -> grad-norm EWMA (explosion baseline)
+        self._grad_ewma = {}
+        self._loss = None
+        self._loss_ewma = None
+        self._loss_var = None
+        self._loss_z = 0.0
+        self._loss_history = []       # (epoch, loss) tail, bounded
+        self._epoch = None
+        self._step = None
+        self._verdict = "healthy"
+        self._reasons = []
+        self._healthy_streak = 0
+        self._nonfinite_total = 0
+        self._rollbacks = 0
+        #: slave id -> last absorbed summary (master aggregation)
+        self._slaves = {}
+        #: served model -> {entropy, margin} drift snapshot
+        self._serving = {}
+        self._updated = None
+        self._doc = self._build_doc()
+        # hoisted instrument handles (hot-path convention: LazyChild =
+        # one generation compare per observation, no registry lookups)
+        self._g_layer = {
+            field: telemetry.LazyChild(
+                lambda f=field: telemetry.gauge(
+                    "veles_model_%s" % f,
+                    "Per-layer in-graph training stat (%s)" % f,
+                    ("layer",)))
+            for field in ("grad_norm", "weight_norm", "update_ratio")}
+        self._c_nonfinite = telemetry.LazyChild(
+            lambda: telemetry.counter(
+                "veles_model_nonfinite_total",
+                "Non-finite values observed in gradients, wire deltas "
+                "or weights, by layer", ("layer",)))
+        self._g_nonfinite_step = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_model_nonfinite_step",
+                "Non-finite count in the LAST published observation "
+                "(0 while training is clean — the ring series "
+                "divergence SLOs fire on)"))
+        self._g_loss = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_model_loss",
+                "Last evaluation-tick loss fed by the decision"))
+        self._g_loss_z = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_model_loss_zscore",
+                "EWMA z-score of the last loss (the loss-spike "
+                "detector input)"))
+        self._g_verdict = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_model_verdict",
+                "Model-health verdict: 0 healthy, 1 suspect, "
+                "2 diverged"))
+        self._g_entropy = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_serving_logit_entropy",
+                "Mean output-distribution entropy of the last served "
+                "batch (drift gauge)", ("model",)))
+        self._g_margin = telemetry.LazyChild(
+            lambda: telemetry.gauge(
+                "veles_serving_top1_margin",
+                "Mean top-1 minus top-2 probability of the last "
+                "served batch (drift gauge)", ("model",)))
+
+    # -- observations --------------------------------------------------
+
+    def observe_stats(self, layer_stats, step_index=None):
+        """Publish one cadence tick of in-graph layer stats.
+
+        ``layer_stats``: layer name -> host ``STAT_FIELDS`` vector
+        (already materialized by the cadence-gated publish path)."""
+        reasons = []
+        nonfinite_now = 0
+        with self._lock:
+            for layer, vec in layer_stats.items():
+                vec = numpy.asarray(vec, numpy.float64).reshape(-1)
+                if vec.shape[0] < len(STAT_FIELDS):
+                    continue
+                doc = {}
+                for i, field in enumerate(STAT_FIELDS):
+                    v = float(vec[i])
+                    doc[field] = v if math.isfinite(v) else None
+                self._layers[layer] = doc
+                gn = doc["grad_norm"]
+                nf = int(doc["nonfinite"] or 0)
+                # a non-finite NORM means the gradient itself carried
+                # NaN/inf even when the in-trace count missed it
+                # (inf^2 overflow): count it as at least one
+                if gn is None or doc["weight_norm"] is None:
+                    nf = max(nf, 1)
+                if nf:
+                    nonfinite_now += nf
+                    self._nonfinite_total += nf
+                    self._c_nonfinite.get().labels(layer).inc(nf)
+                    reasons.append(
+                        ("diverged", "nonfinite:%s" % layer))
+                elif gn is not None:
+                    ewma = self._grad_ewma.get(layer)
+                    if ewma is not None and ewma > 0.0 and \
+                            gn >= self.explosion_factor * ewma:
+                        reasons.append((
+                            "suspect",
+                            "grad_explosion:%s (%.3g >= %gx %.3g)"
+                            % (layer, gn, self.explosion_factor,
+                               ewma)))
+                    self._grad_ewma[layer] = gn if ewma is None else \
+                        (1.0 - self.ewma_alpha) * ewma \
+                        + self.ewma_alpha * gn
+                    for field in ("grad_norm", "weight_norm",
+                                  "update_ratio"):
+                        if doc[field] is not None:
+                            self._g_layer[field].get().labels(
+                                layer).set(doc[field])
+            if step_index is not None:
+                self._step = int(step_index)
+            self._g_nonfinite_step.get().set(float(nonfinite_now))
+            self._judge(reasons)
+
+    def observe_loss(self, loss, epoch=None):
+        """One evaluation-tick loss (the decision's judged class)."""
+        loss = float(loss)
+        reasons = []
+        with self._lock:
+            self._loss = loss
+            if epoch is not None:
+                self._epoch = int(epoch)
+            if not math.isfinite(loss):
+                reasons.append(("diverged", "loss_nonfinite"))
+                self._nonfinite_total += 1
+                self._c_nonfinite.get().labels("loss").inc()
+                self._loss_z = float("inf")
+            else:
+                if self._loss_ewma is None:
+                    self._loss_ewma = loss
+                    self._loss_var = 0.0
+                    self._loss_z = 0.0
+                else:
+                    sigma = math.sqrt(max(self._loss_var, 0.0))
+                    # z against the PRE-update baseline: the spike must
+                    # not dilute the mean it is judged against
+                    dev = loss - self._loss_ewma
+                    if sigma > 1e-12:
+                        self._loss_z = dev / sigma
+                    elif dev > 3.0 * max(abs(self._loss_ewma),
+                                         1e-12):
+                        # variance not established yet (2nd tick, or a
+                        # perfectly flat history): a z-score would be
+                        # forced to 0 and the detector blind to an
+                        # arbitrarily large finite blow-up — fall back
+                        # to the relative-jump test (loss > 4x the
+                        # baseline, NNRollback's blowup scale)
+                        self._loss_z = self.diverged_z
+                    else:
+                        self._loss_z = 0.0
+                    if self._loss_z >= self.diverged_z:
+                        reasons.append((
+                            "diverged", "loss_spike (z=%.1f)"
+                            % self._loss_z))
+                    elif self._loss_z >= self.suspect_z:
+                        reasons.append((
+                            "suspect", "loss_spike (z=%.1f)"
+                            % self._loss_z))
+                    if self._loss_z < self.diverged_z:
+                        # fold into the baseline only when NOT judged
+                        # a blow-up: a diverged spike folded in would
+                        # jump the mean and inflate the variance,
+                        # desensitizing every later z-score
+                        a = self.ewma_alpha
+                        self._loss_ewma += a * dev
+                        self._loss_var = (1.0 - a) * (
+                            self._loss_var + a * dev * dev)
+                self._g_loss.get().set(loss)
+                self._loss_history.append(
+                    (self._epoch, loss))
+                del self._loss_history[:-32]
+            z = self._loss_z if math.isfinite(self._loss_z) else 1e9
+            self._g_loss_z.get().set(z)
+            self._judge(reasons)
+
+    def note_wire_nonfinite(self, layer, count, slave=None):
+        """Master-side: non-finite values seen in one decoded slave
+        delta for ``layer`` (0 = clean merge, still recorded so the
+        step gauge recovers after a poisoned one)."""
+        count = int(count)
+        now = time.monotonic()
+        with self._lock:
+            if count:
+                # pace recovery from NOW: the spike must survive the
+                # rest of this update frame's clean sibling-unit
+                # notes AND at least one ring sample
+                self._clean_wire_last = now
+                self._nonfinite_total += count
+                self._c_nonfinite.get().labels(layer).inc(count)
+                self._g_nonfinite_step.get().set(float(count))
+                self._judge([(
+                    "diverged", "nonfinite_wire:%s%s"
+                    % (layer, "" if slave is None
+                       else " (slave %s)" % slave))])
+                return
+            # clean merges arrive once per UNIT per update: counting
+            # each would clear a diverged latch within the very same
+            # update frame (any model with more units than the
+            # streak). TIME-paced instead: at most one healthy
+            # observation (and one step-gauge reset) per
+            # wire_recovery_interval, so the spike outlives at least
+            # one 1 Hz ring sample and the guard's next tick
+            if self._clean_wire_last is None:
+                self._clean_wire_last = now
+                return
+            if now - self._clean_wire_last \
+                    >= self.wire_recovery_interval:
+                self._clean_wire_last = now
+                self._g_nonfinite_step.get().set(0.0)
+                self._judge([])
+
+    def absorb_slave(self, summary, slave_id):
+        """Master aggregation: republish a slave-shipped model summary
+        ``slave="N"``-labelled and fold its health into this process's
+        detector (a slave already diverged must flip the MASTER's
+        verdict — the fleet acts on the master's surfaces)."""
+        if not isinstance(summary, dict):
+            return
+        sid = str(slave_id)
+        reasons = []
+        with self._lock:
+            self._slaves[sid] = dict(summary, seen=round(
+                time.time(), 3))
+            loss = summary.get("loss")
+            if isinstance(loss, (int, float)):
+                # same families as the local series, one extra
+                # slave="N" label — children are keyed by the full
+                # item tuple, so local and absorbed series coexist
+                self._g_loss.get().child(
+                    (("slave", sid),)).set(float(loss))
+            for layer, doc in (summary.get("layers") or {}).items():
+                if not isinstance(doc, dict):
+                    continue
+                for field in ("grad_norm", "weight_norm",
+                              "update_ratio"):
+                    v = doc.get(field)
+                    if isinstance(v, (int, float)):
+                        self._g_layer[field].get().child(
+                            (("layer", str(layer)),
+                             ("slave", sid))).set(float(v))
+            if summary.get("verdict") == "diverged":
+                reasons.append(
+                    ("diverged", "slave_diverged:%s" % sid))
+            if reasons:
+                self._judge(reasons)
+            else:
+                # a HEALTHY slave summary is not a clean observation
+                # of THIS process's model: advancing the streak here
+                # would let the other slaves' routine pushes clear a
+                # diverged latch (NaN still in the canonical weights)
+                # within seconds — the same hazard the wire-note
+                # damping exists for. Recovery stays with the damped
+                # wire notes / local observations.
+                self._doc = self._build_doc()
+
+    def observe_serving(self, model, outputs):
+        """Serving drift gauges from one dispatched batch's outputs:
+        mean entropy of the (soft(max)ed) output rows and the mean
+        top-1 − top-2 probability margin. Only defined for 2-D
+        multi-class outputs; anything else is ignored. Strided: every
+        ``serving_stride``-th batch per model pays the O(batch ×
+        classes) numpy — drift moves over minutes, not batches."""
+        name = str(model)
+        # per-model tick; each model's batcher has ONE worker thread,
+        # so the unlocked read-modify-write cannot race itself
+        tick = self._serving_ticks.get(name, 0)
+        self._serving_ticks[name] = tick + 1
+        if tick % max(1, int(self.serving_stride)):
+            return
+        out = numpy.asarray(outputs)
+        if out.ndim != 2 or out.shape[1] < 2 or not out.shape[0]:
+            return
+        rows = out.astype(numpy.float64, copy=False)
+        rowsum = rows.sum(axis=1, keepdims=True)
+        if numpy.any(rows < 0) or not numpy.allclose(
+                rowsum, 1.0, atol=1e-3):
+            # logits, not a distribution: softmax first
+            z = rows - rows.max(axis=1, keepdims=True)
+            e = numpy.exp(z)
+            rows = e / e.sum(axis=1, keepdims=True)
+        ent = float(numpy.mean(
+            -(rows * numpy.log(numpy.maximum(rows, 1e-12))).sum(
+                axis=1)))
+        part = numpy.partition(rows, rows.shape[1] - 2, axis=1)
+        margin = float(numpy.mean(part[:, -1] - part[:, -2]))
+        with self._lock:
+            self._serving[name] = {
+                "entropy": round(ent, 6), "top1_margin": round(
+                    margin, 6)}
+            self._g_entropy.get().labels(name).set(ent)
+            self._g_margin.get().labels(name).set(margin)
+            # serving-hot-path: swap only the serving sub-dict into a
+            # shallow copy instead of rebuilding the whole document
+            # (layer/slave copies per dispatched batch would be O(n)
+            # churn under the lock for one changed field)
+            doc = dict(self._doc)
+            doc["serving"] = {k: dict(v)
+                              for k, v in self._serving.items()}
+            self._doc = doc
+
+    def evict_slave(self, slave_id):
+        """A slave departed (lease dropped / re-helloed under a new
+        id): drop its absorbed summary and its ``slave="N"``-labelled
+        gauge children, so /debug/model and the metrics ring stop
+        reporting a ghost at its last values forever."""
+        sid = str(slave_id)
+        match = (("slave", sid),)
+        with self._lock:
+            if self._slaves.pop(sid, None) is None:
+                return
+            self._g_loss.get().remove_children(match)
+            for handle in self._g_layer.values():
+                handle.get().remove_children(match)
+            self._doc = self._build_doc()
+
+    def note_rollback(self):
+        """A divergence rollback restored the last healthy stash:
+        count it and drop the diverged latch — the restored weights'
+        clean observations re-earn healthy through the streak."""
+        with self._lock:
+            self._rollbacks += 1
+            self._healthy_streak = 0
+            if self._verdict == "diverged":
+                self._verdict = "suspect"
+                self._reasons = ["rolled_back"]
+            self._g_verdict.get().set(
+                float(VERDICTS.index(self._verdict)))
+            self._doc = self._build_doc()
+
+    # -- the detector --------------------------------------------------
+
+    def _judge(self, reasons):
+        """Fold one observation's ``(severity, reason)`` list into the
+        verdict state machine (called under the lock)."""
+        if not self.enabled:
+            self._updated = time.time()
+            self._doc = self._build_doc()
+            return
+        bad = [r for r in reasons if r[0] == "diverged"]
+        sus = [r for r in reasons if r[0] == "suspect"]
+        previous = self._verdict
+        if bad:
+            self._verdict = "diverged"
+            self._reasons = [r for _, r in bad]
+            self._healthy_streak = 0
+        elif sus:
+            if self._verdict != "diverged":
+                self._verdict = "suspect"
+                self._reasons = [r for _, r in sus]
+            self._healthy_streak = 0
+        else:
+            self._healthy_streak += 1
+            if self._verdict != "healthy" \
+                    and self._healthy_streak >= self.recover_after:
+                self._verdict = "healthy"
+                self._reasons = []
+        if self._verdict != previous:
+            telemetry.record_event(
+                "model_divergence", verdict=self._verdict,
+                previous=previous,
+                reasons=list(self._reasons)[:4])
+            log = self.warning if self._verdict != "healthy" \
+                else self.info
+            log("model verdict %s -> %s%s", previous, self._verdict,
+                (" (%s)" % "; ".join(self._reasons)
+                 if self._reasons else ""))
+        self._g_verdict.get().set(
+            float(VERDICTS.index(self._verdict)))
+        self._updated = time.time()
+        self._doc = self._build_doc()
+
+    def verdict_state(self):
+        """(verdict, reasons) — the cheap cached read request paths
+        and readiness checks consult."""
+        doc = self._doc
+        return doc["verdict"], list(doc["reasons"])
+
+    def _loss_trend(self):
+        tail = self._loss_history[-6:]
+        if len(tail) < 2:
+            return "flat"
+        first, last = tail[0][1], tail[-1][1]
+        span = max(abs(first), abs(last), 1e-12)
+        if (first - last) / span > 0.01:
+            return "improving"
+        if (last - first) / span > 0.01:
+            return "worsening"
+        return "flat"
+
+    def _build_doc(self):
+        z = self._loss_z
+        return {
+            "verdict": self._verdict,
+            "enabled": self.enabled,
+            "reasons": list(self._reasons),
+            "loss": self._loss,
+            "loss_ewma": self._loss_ewma,
+            "loss_zscore": (round(z, 3) if math.isfinite(z)
+                            else None),
+            "loss_trend": self._loss_trend(),
+            "epoch": self._epoch,
+            "step": self._step,
+            "nonfinite_total": self._nonfinite_total,
+            "rollbacks": self._rollbacks,
+            "layers": {k: dict(v) for k, v in self._layers.items()},
+            "slaves": {k: dict(v) for k, v in self._slaves.items()},
+            "serving": {k: dict(v)
+                        for k, v in self._serving.items()},
+            "updated": self._updated,
+        }
+
+    # -- read surfaces -------------------------------------------------
+
+    def snapshot(self):
+        """The full cached document (``GET /debug/model``)."""
+        return self._doc
+
+    def push_summary(self):
+        """The compact summary a slave rides on its update frames
+        (``__telemetry__["model"]``): verdict + loss + per-layer
+        latest — small enough to ship per job."""
+        doc = self._doc
+        return {
+            "verdict": doc["verdict"],
+            "loss": doc["loss"],
+            "loss_zscore": doc["loss_zscore"],
+            "epoch": doc["epoch"],
+            "step": doc["step"],
+            "nonfinite_total": doc["nonfinite_total"],
+            "layers": doc["layers"],
+        }
+
+    def manifest_stamp(self):
+        """What the snapshotter embeds in each checkpoint MANIFEST:
+        the verdict plus the stats snapshot it was judged on —
+        ``resolve_auto`` and the serving registry's refresh skip
+        ``diverged`` blobs on this field."""
+        doc = self._doc
+        return {
+            # a disabled plane never judged anything: stamping an
+            # affirmative "healthy" would make a blind run's
+            # checkpoints indistinguishable from verified ones (the
+            # skip logic only acts on "diverged", so "unknown" blobs
+            # still resume/serve)
+            "verdict": doc["verdict"] if self.enabled else "unknown",
+            "reasons": doc["reasons"],
+            "loss": doc["loss"],
+            "loss_zscore": doc["loss_zscore"],
+            "epoch": doc["epoch"],
+            "nonfinite_total": doc["nonfinite_total"],
+            "layers": doc["layers"],
+        }
+
+    def register_health(self, monitor=None):
+        """Contribute the ``model:divergence`` readiness check to the
+        process health monitor: not ready while the verdict is
+        diverged (suspect keeps serving — it is a page, not an
+        outage)."""
+        from veles import health
+        monitor = monitor or health.get_monitor()
+
+        def check():
+            verdict, reasons = self.verdict_state()
+            if verdict == "diverged":
+                return False, "model diverged: %s" % (
+                    "; ".join(reasons) or "?")
+            return True, None
+        monitor.add_check("model:divergence", check)
+        return monitor
+
+
+# -- active-monitor plumbing -------------------------------------------
+
+_active_lock = threading.Lock()
+_active = None
+
+
+def get_model_monitor() -> ModelHealthMonitor:
+    """The process's active model monitor, created on first use."""
+    global _active
+    with _active_lock:
+        if _active is None:
+            _active = ModelHealthMonitor()
+        return _active
+
+
+def set_model_monitor(monitor):
+    """Swap the active monitor (-> the previous one)."""
+    global _active
+    with _active_lock:
+        previous = _active
+        _active = monitor
+    return previous
+
+
+@contextmanager
+def scoped(monitor=None):
+    """``with scoped():`` — run under a fresh (or given) monitor,
+    restoring on exit (the per-test isolation hook)."""
+    monitor = monitor if monitor is not None else ModelHealthMonitor()
+    previous = set_model_monitor(monitor)
+    try:
+        yield monitor
+    finally:
+        set_model_monitor(previous)
+
+
+def debug_model_doc():
+    """``GET /debug/model`` payload — the active monitor's cached
+    snapshot (one attribute read; handlers may serve it inline on the
+    reactor loop)."""
+    return get_model_monitor().snapshot()
+
+
+# -- SLO wiring ---------------------------------------------------------
+
+#: the declarative divergence objectives installed into the PR-8
+#: burn-rate engine. Windows are short on purpose: a divergence page
+#: must fire within a couple of evaluation ticks, and the fast window
+#: clears it quickly once a rollback restores clean observations.
+MODEL_SLOS = (
+    {"name": "model_nonfinite", "kind": "threshold",
+     "series": "veles_model_nonfinite_step", "op": "<=",
+     "threshold": 0.0, "target": 0.99,
+     "fast_window": 30.0, "slow_window": 90.0,
+     "burn_threshold": 1.0},
+    {"name": "model_divergence", "kind": "threshold",
+     "series": "veles_model_verdict", "op": "<",
+     "threshold": 2.0, "target": 0.99,
+     "fast_window": 30.0, "slow_window": 90.0,
+     "burn_threshold": 1.0},
+    {"name": "model_loss_spike", "kind": "threshold",
+     "series": "veles_model_loss_zscore", "op": "<=",
+     "threshold": 8.0, "target": 0.99,
+     "fast_window": 30.0, "slow_window": 90.0,
+     "burn_threshold": 1.0},
+)
+
+
+def install_model_slos(health_monitor=None):
+    """Register the divergence objectives (idempotent: objectives
+    already present are skipped); -> how many were added. One bad ring
+    sample inside the fast window burns >= the threshold at the
+    default 1 Hz cadence, so an injected blow-up alerts within two
+    evaluation ticks and resolves once clean samples age it out."""
+    from veles import health
+    monitor = health_monitor or health.get_monitor()
+    have = {slo.name for slo in monitor.slos()}
+    added = 0
+    for spec in MODEL_SLOS:
+        if spec["name"] in have:
+            continue
+        monitor.add_slo(dict(spec))
+        added += 1
+    return added
+
+
+# -- master-side rollback actuator --------------------------------------
+
+
+class WeightGuard(Logger):
+    """The master-side ``--rollback-on-divergence`` actuator.
+
+    The master merges slave deltas into the canonical weights with no
+    epoch loop of its own, so :class:`~veles.znicz_tpu.nn_rollback.
+    NNRollback`'s improved-loss stash never arms there. This guard is
+    ticked after every merge: while the verdict is healthy it keeps a
+    RAM copy of every stateful unit's params/state (at
+    ``stash_interval`` merges, finiteness-checked so a diverged state
+    can never become the stash); the tick after the verdict flips to
+    ``diverged`` it restores the stash into the unit Arrays — the next
+    job broadcast carries the pre-spike weights.
+    """
+
+    def __init__(self, workflow, monitor=None, stash_interval=1):
+        self.name = "weight_guard"
+        self.workflow = workflow
+        self._monitor = monitor
+        self.stash_interval = max(1, int(stash_interval))
+        self._merges = 0
+        self._stash = None
+        self.rollback_count = 0
+
+    @property
+    def monitor(self):
+        return self._monitor or get_model_monitor()
+
+    def tick(self):
+        """One post-merge evaluation; -> True when a restore
+        happened."""
+        self._merges += 1
+        verdict, reasons = self.monitor.verdict_state()
+        if verdict == "diverged":
+            return self._restore(reasons)
+        if verdict == "healthy" and (
+                self._stash is None
+                or self._merges % self.stash_interval == 0):
+            # HEALTHY only: a suspect verdict (grad explosion, loss
+            # z-score drifting up) means a finite blow-up may already
+            # be in the weights — refreshing the stash now would make
+            # the eventual restore reinstate the post-spike state,
+            # not the pre-spike one
+            self._maybe_stash()
+        return False
+
+    def _maybe_stash(self):
+        stash = self.workflow.stash_state()
+        for uname, (params, state) in stash.items():
+            for tree in (params, state):
+                for arr in tree.values():
+                    if not numpy.isfinite(arr).all():
+                        # a silent blow-up the wire scan missed: feed
+                        # the detector instead of stashing poison
+                        self.monitor.note_wire_nonfinite(
+                            uname, int((~numpy.isfinite(
+                                arr)).sum()))
+                        return
+        self._stash = stash
+
+    def _restore(self, reasons):
+        if self._stash is None:
+            self.warning("model diverged (%s) before any healthy "
+                         "stash existed — nothing to restore",
+                         "; ".join(reasons) or "?")
+            self.monitor.note_rollback()
+            return False
+        self.workflow.restore_stash(self._stash)
+        self.rollback_count += 1
+        self.monitor.note_rollback()
+        telemetry.record_event(
+            "model_rollback", source="weight_guard",
+            rollback=self.rollback_count,
+            reasons=list(reasons)[:4])
+        self.warning(
+            "model diverged (%s): restored last healthy weights "
+            "(rollback #%d)", "; ".join(reasons) or "?",
+            self.rollback_count)
+        return True

@@ -599,10 +599,21 @@ class MasterServer(Logger):
                  resume_state=None,
                  drain_timeout=DEFAULT_DRAIN_TIMEOUT,
                  grad_codec="none", grad_topk_percent=1.0,
-                 max_write_buffer=None):
+                 max_write_buffer=None,
+                 rollback_on_divergence=False, stash_interval=1):
         from veles import compression
         self.name = "MasterServer"
         self.workflow = workflow
+        #: model-health actuator (--rollback-on-divergence): keep a
+        #: finiteness-checked RAM stash of the canonical weights and
+        #: restore it the tick after the model-health verdict flips to
+        #: diverged (a poisoned/blown-up slave delta merged into the
+        #: canonical weights). None when disabled.
+        self._weight_guard = None
+        if rollback_on_divergence:
+            from veles.model_health import WeightGuard
+            self._weight_guard = WeightGuard(
+                workflow, stash_interval=stash_interval)
         #: gradient wire codec this master WANTS (veles/compression.py)
         #: — negotiated per slave at hello: an agreeing slave gets it,
         #: anything else (old peer, different config) falls back to
@@ -802,8 +813,13 @@ class MasterServer(Logger):
                 # crash the shutdown path
                 tree = self.checkpoint_state()
                 name = self._persist_slot.next_name("gz")
+                from veles.snapshotter import health_stamp_meta
+                # master checkpoints carry the model-health verdict
+                # too: a restart's auto-resume must not adopt state
+                # persisted while the canonical weights were diverged
                 uri, _ = write_checkpoint(
-                    store, name, tree, slot="master")
+                    store, name, tree, slot="master",
+                    extra_meta=health_stamp_meta())
             except Exception as exc:
                 self.warning("master state persist failed (%s): %s",
                              reason or "periodic", exc)
@@ -960,6 +976,17 @@ class MasterServer(Logger):
         after a lost ok-ack, a duplicated frame, or the same client
         re-helloing under a new slave_id can never double-count
         (called under self.lock)."""
+        # model-health summary (ISSUE 15): republished slave-labelled
+        # and folded into THIS process's detector, so one scrape of
+        # the master sees cluster-wide training health and a slave
+        # already diverged flips the master's verdict too. Before the
+        # counter-state gate: a push may carry a summary with no
+        # counter deltas.
+        model = tele.get("model")
+        if model is not None:
+            from veles import model_health
+            model_health.get_model_monitor().absorb_slave(
+                model, slave_id)
         token = tele.get("token")
         state = tele.get("state")
         if token is None or not isinstance(state, dict):
@@ -1174,6 +1201,12 @@ class MasterServer(Logger):
                 # JSONL sink stamps trace_id/span_id)
                 with telemetry.context(ctx):
                     merged = self.registry.apply_update(data, slave_id)
+                if self._weight_guard is not None and merged:
+                    # post-merge model-health tick: stash the weights
+                    # while healthy, restore them the moment the
+                    # verdict (fed by the per-unit wire non-finite
+                    # scan during the merge above) flips to diverged
+                    self._weight_guard.tick()
                 if telemetry.tracer.active:
                     if wire is not None:
                         telemetry.tracer.add_complete(
@@ -1225,6 +1258,11 @@ class MasterServer(Logger):
             del self.slaves[slave_id]
             self.workflow.grad_codec_by_slave.pop(slave_id, None)
             self._set_slaves_gauge()
+            # evict its absorbed model-health summary + the
+            # slave="N"-labelled gauge children: a departed slave's
+            # last-known stats must not read as current forever
+            from veles import model_health
+            model_health.get_model_monitor().evict_slave(slave_id)
             telemetry.record_event(
                 "lease_revoked", slave=slave_id, clean=bool(clean),
                 requeued=requeued)

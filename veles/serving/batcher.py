@@ -244,6 +244,14 @@ class MicroBatcher(Logger):
                 req.event.set()
             if telemetry.tracer.active:
                 self._trace_batch(live, t_dispatch, done_perf, bucket)
+            # model-health drift gauges (ISSUE 15): mean output
+            # entropy + top-1 margin — the monitor strides the
+            # computation (every Nth batch per model), so this call
+            # is a dict tick on the off-batches; ignored for
+            # non-categorical shapes
+            from veles import model_health
+            model_health.get_model_monitor().observe_serving(
+                self.model, outputs)
             self._c["batches_total"].get().inc()
             self._c["batched_requests_total"].get().inc(len(live))
             self._c["batched_rows_total"].get().inc(rows.shape[0])

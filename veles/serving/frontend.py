@@ -163,6 +163,12 @@ class ServingFrontend(Logger):
             # the capture blocks for the requested window (zlint
             # profiler-safety): worker thread, reply via call_soon
             request.defer(self._serve_profile, request)
+        elif path.startswith("/debug/model"):
+            # model-health plane (veles/model_health.py): the cached
+            # snapshot incl. per-model serving drift gauges — one
+            # attribute read, safe inline on the loop
+            from veles import model_health
+            request.reply_json(200, model_health.debug_model_doc())
         elif path.startswith("/debug/"):
             payload = telemetry.debug_endpoint(path)
             if payload is None:

@@ -390,9 +390,22 @@ class ArchiveModel:
         ``params`` tree is keyed by unit name with the same attr keys
         the archive uses; unit names absent from this model are
         ignored (the checkpoint also carries GD units), shape
-        mismatches fail loudly."""
-        from veles.snapshotter import load_snapshot
-        state = load_snapshot(target)
+        mismatches fail loudly. A manifest stamped with model-health
+        verdict ``diverged`` is REFUSED: the registry's refresh then
+        degrades to the loaded version (counted) instead of serving a
+        blown-up model."""
+        from veles.snapshotter import (_count_diverged_skip,
+                                       load_snapshot_meta)
+        state, manifest = load_snapshot_meta(target)
+        health_doc = (manifest or {}).get("model_health")
+        if isinstance(health_doc, dict) \
+                and health_doc.get("verdict") == "diverged":
+            _count_diverged_skip()
+            raise ValueError(
+                "checkpoint %s refused: MANIFEST model-health verdict "
+                "is 'diverged' (%s)" % (
+                    target,
+                    "; ".join(health_doc.get("reasons") or ()) or "?"))
         loaded = 0
         for uname, tree in state.get("params", {}).items():
             if uname not in self.params:

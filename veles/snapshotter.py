@@ -601,6 +601,23 @@ def _count_verify_failure():
         "scan)").inc()
 
 
+def _count_diverged_skip():
+    telemetry.counter(
+        "veles_checkpoint_diverged_skips_total",
+        "Checkpoints skipped by auto-resume/refresh because their "
+        "MANIFEST carries model-health verdict 'diverged'").inc()
+
+
+def health_stamp_meta():
+    """The ``extra_meta`` every checkpoint writer stamps: the model
+    monitor's current verdict + stats snapshot under ``model_health``
+    — what lets ``resolve_auto`` and the serving registry's refresh
+    skip blobs written while the model was diverging."""
+    from veles import model_health
+    return {"model_health":
+            model_health.get_model_monitor().manifest_stamp()}
+
+
 class _CountingSink:
     """Write-through wrapper counting the bytes actually handed to
     the store — i.e. COMPRESSED size, which is what the bytes-written
@@ -756,6 +773,17 @@ class CheckpointInfo:
                 pass
         return None
 
+    @property
+    def health_verdict(self):
+        """The model-health verdict stamped at write time
+        (healthy/suspect/diverged), or None for pre-ISSUE-15 and
+        legacy blobs."""
+        if self.manifest:
+            doc = self.manifest.get("model_health")
+            if isinstance(doc, dict):
+                return doc.get("verdict")
+        return None
+
     def __repr__(self):
         return "CheckpointInfo(%r, %s)" % (self.name, self.status)
 
@@ -840,6 +868,20 @@ def resolve_auto(target, logger=None, prefixes=None):
             continue
         if manifest is None:
             continue                # legacy: explicit-path only
+        health_doc = manifest.get("model_health")
+        if isinstance(health_doc, dict) \
+                and health_doc.get("verdict") == "diverged":
+            # stamped while the model-health plane judged the run
+            # diverged: never auto-resume it — the whole point of the
+            # verdict is that a serving fleet / restart must not pick
+            # up a blown-up model
+            _count_diverged_skip()
+            if logger is not None:
+                logger.warning(
+                    "checkpoint %s skipped: model-health verdict "
+                    "'diverged' (%s)", name,
+                    "; ".join(health_doc.get("reasons") or ()) or "?")
+            continue
         try:
             wall = float(manifest.get("wall_time") or 0.0)
         except (TypeError, ValueError):
@@ -1014,9 +1056,13 @@ class SnapshotterBase(Unit):  # zlint: disable=checkpoint-state (sequence/retent
             # master's persist_state): a transient get_state failure
             # must degrade this checkpoint, not kill the run
             payload = self.workflow.checkpoint_state()
+            # the MANIFEST carries the model-health verdict the run
+            # held at write time: resolve_auto and the serving
+            # registry's refresh skip 'diverged' blobs
             path, _ = write_checkpoint(
                 self.store, name, payload,
-                compression=self.compression, slot=slot)
+                compression=self.compression, slot=slot,
+                extra_meta=health_stamp_meta())
         except Exception as exc:
             # a checkpoint is auxiliary: a TRANSIENT store failure
             # (remote 503, full disk) must not kill hours of training
@@ -1082,14 +1128,22 @@ def load_snapshot(path):
     ``http(s)://`` URI resolved through :class:`HTTPSnapshotStore`
     (remote resume). Raises :class:`CorruptCheckpointError` on a
     truncated, bit-flipped or otherwise unreadable blob."""
+    return load_snapshot_meta(path)[0]
+
+
+def load_snapshot_meta(path):
+    """:func:`load_snapshot` that also returns the verified manifest
+    (None for legacy blobs) — readers that gate on manifest fields
+    (the serving registry's refresh checks the model-health verdict)
+    use this instead of re-fetching the blob."""
     store, name = store_for(path)
     if store is not None:
         raw = store.get(name)
     else:
         with open(path, "rb") as f:
             raw = f.read()
-    flat, _ = parse_checkpoint(raw, name)
-    return _unflatten_tree(flat)
+    flat, manifest = parse_checkpoint(raw, name)
+    return _unflatten_tree(flat), manifest
 
 
 def _flatten_tree(tree, prefix=""):

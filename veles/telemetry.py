@@ -253,6 +253,20 @@ class _Family:
         with self._lock:
             return sorted(self._children.items())
 
+    def remove_children(self, match_items):
+        """Drop every child whose label items contain all of
+        ``match_items`` (e.g. ``(("slave", "3"),)`` evicts a departed
+        slave's absorbed series); -> how many were removed. The series
+        disappears from exposition and ring sampling — the right
+        answer for per-peer gauges whose last value would otherwise
+        read as current forever."""
+        want = set(match_items)
+        with self._lock:
+            stale = [k for k in self._children if want <= set(k)]
+            for k in stale:
+                del self._children[k]
+        return len(stale)
+
     # label-less families act as their own child ----------------------
 
     def _default(self):

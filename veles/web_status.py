@@ -52,7 +52,7 @@ import html
 import json
 import threading
 
-from veles import health, reactor, telemetry
+from veles import health, model_health, reactor, telemetry
 from veles.logger import Logger
 
 _PAGE = """<!DOCTYPE html>
@@ -130,6 +130,11 @@ class WebStatus(Logger):
             # on the loop (zlint profiler-safety): a worker thread
             # captures and replies via call_soon
             request.defer(self._serve_profile, request)
+        elif path.startswith("/debug/model"):
+            # model-health plane (veles/model_health.py): the cached
+            # verdict + per-layer training-dynamics snapshot — one
+            # attribute read, safe inline on the loop
+            request.reply_json(200, model_health.debug_model_doc())
         elif path.startswith("/debug/"):
             # flight-recorder surfaces: /debug/trace (Perfetto JSON
             # of the retained span window), /debug/events (recent

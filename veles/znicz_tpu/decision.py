@@ -118,6 +118,17 @@ class DecisionBase(Unit):
                 }
         self.history.append(summary)
         self.on_epoch_summary(summary)
+        # model-health evaluation tick (veles/model_health.py): the
+        # judged class's mean loss feeds the loss-spike detector —
+        # same class preference as NNRollback._epoch_loss
+        from veles import model_health
+        for cls in (CLASS_VALID, CLASS_TRAIN):
+            acc = self.epoch_metrics[cls]
+            if acc and acc["samples"]:
+                model_health.get_model_monitor().observe_loss(
+                    acc["loss"] / acc["samples"],
+                    epoch=self.epoch_number)
+                break
         self.epoch_metrics = [None, None, None]
         self.epoch_number += 1
         if self.max_epochs is not None \

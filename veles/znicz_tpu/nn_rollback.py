@@ -30,7 +30,7 @@ class NNRollback(Unit):
     """RAM-snapshot weight rollback on loss divergence."""
 
     def __init__(self, workflow, lr_cut=0.5, blowup_factor=4.0,
-                 interval=1, **kwargs):
+                 interval=1, rollback_on_divergence=False, **kwargs):
         super().__init__(workflow, **kwargs)
         #: multiply learning rates by this on rollback
         self.lr_cut = float(lr_cut)
@@ -38,6 +38,13 @@ class NNRollback(Unit):
         self.blowup_factor = float(blowup_factor)
         #: kept for API compatibility; checks happen every epoch
         self.interval = int(interval)
+        #: also restore when the model-health plane's verdict flips to
+        #: ``diverged`` (veles/model_health.py: in-graph non-finite
+        #: counts, loss z-score, gradient explosion) — checked every
+        #: cycle, not just at epoch ends, so an in-epoch blow-up the
+        #: stat cadence caught rolls back before the epoch finishes
+        #: (``--rollback-on-divergence``)
+        self.rollback_on_divergence = bool(rollback_on_divergence)
         self.rollback_count = 0
         self._stash = None
         self._best_loss = None
@@ -60,16 +67,11 @@ class NNRollback(Unit):
         return None
 
     def _snapshot(self):
-        wf = self.workflow
-        if wf.xla_step is not None:
-            # at_valid: the epoch's validation metric was measured on
-            # the epoch-ENTRY params (valid is served before train), so
-            # "last good" must stash those — the post-train values may
-            # already have diverged inside the very epoch being judged
-            wf.xla_step.sync_host(at_valid=True)
-        self._stash = {
-            u.name: (u.export_params(), u.export_state())
-            for u in wf._stateful_units()}
+        # at_valid: the epoch's validation metric was measured on the
+        # epoch-ENTRY params (valid is served before train), so "last
+        # good" must stash those — the post-train values may already
+        # have diverged inside the very epoch being judged
+        self._stash = self.workflow.stash_state(at_valid=True)
 
     def _cut_lr(self):
         # scale AFTER the lr policy: schedules like ArbitraryStepPolicy
@@ -80,22 +82,43 @@ class NNRollback(Unit):
                 gd.lr_scale *= self.lr_cut
 
     def _restore(self):
-        wf = self.workflow
-        for u in wf._stateful_units():
-            if u.name in self._stash:
-                params, state = self._stash[u.name]
-                u.import_params(params)
-                u.import_state(state)
+        self.workflow.restore_stash(self._stash)
         self._cut_lr()
-        if wf.xla_step is not None:
-            wf.xla_step.refresh_device()
         self.rollback_count += 1
+        from veles import telemetry
+        telemetry.record_event(
+            "model_rollback", source="nn_rollback",
+            rollback=self.rollback_count, lr_cut=self.lr_cut)
         self.warning(
             "loss blow-up: rolled back to last good weights, "
             "learning rates cut by %.3g (rollback #%d)",
             self.lr_cut, self.rollback_count)
 
+    def _divergence_tick(self):
+        """``--rollback-on-divergence``: restore the stash the moment
+        the model-health verdict flips to diverged (non-finite grads /
+        loss spike seen by the in-graph stats, possibly mid-epoch)."""
+        from veles import model_health
+        monitor = model_health.get_model_monitor()
+        verdict, reasons = monitor.verdict_state()
+        if verdict != "diverged":
+            return
+        if self._stash is not None:
+            self.warning("model-health verdict diverged (%s): "
+                         "restoring last good weights",
+                         "; ".join(reasons) or "?")
+            self._restore()
+        else:
+            self._cut_lr()
+            self.warning(
+                "model-health verdict diverged (%s) before any good "
+                "stash: learning rates cut by %.3g",
+                "; ".join(reasons) or "?", self.lr_cut)
+        monitor.note_rollback()
+
     def run(self):
+        if self.rollback_on_divergence:
+            self._divergence_tick()
         d = self.workflow.decision
         if not bool(d.epoch_ended):
             return

@@ -59,6 +59,19 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
         self.state = None
         self.base_key = None
         self.step_index = 0
+        #: model-health plane (veles/model_health.py): collect the
+        #: per-layer in-graph stat vectors (one fused extra output per
+        #: GD unit). Toggle BEFORE initialize, or via
+        #: :meth:`set_stats_enabled` afterwards (clears the compiled
+        #: program caches — the flag is a compile-time variant).
+        self.collect_model_stats = True
+        #: stat cadence: the reduces run IN-GRAPH every Nth train step
+        #: (a lax.cond emits -1 sentinel rows in between, so the
+        #: steady-state cost is the reduction pass divided by N), and
+        #: the publish path materializes only the sampled rows. zlint
+        #: ``stats-cadence`` bans materializing stat outputs outside
+        #: that path. Set BEFORE initialize (compile-time stride).
+        self.stats_interval = 8
         #: last step/epoch outputs fetched to host (key -> value)
         self.metrics = {}
         #: jax.sharding.NamedSharding for batch tensors (set by the
@@ -89,6 +102,8 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
         super().initialize(**kwargs)
         self.device = device or getattr(self.workflow, "device", None)
         self.compiler = StepCompiler(self.train_units, self.device)
+        self.compiler.collect_stats = bool(self.collect_model_stats)
+        self.compiler.stats_stride = max(1, int(self.stats_interval))
         self.params = self._place_tree(self.compiler.gather_params())
         self.state = self._place_tree(self.compiler.gather_state())
         from veles import prng
@@ -630,11 +645,60 @@ class XLAStep(Unit):  # zlint: disable=checkpoint-state (params/state/step_index
             return int(mem.shape[1])
         return None
 
+    def set_stats_enabled(self, enabled):
+        """Toggle in-graph model-stat collection. The flag is a
+        compile-time variant, so the cached per-step programs are
+        dropped (scan/window programs re-key through the compiler
+        cache on their next dispatch)."""
+        enabled = bool(enabled)
+        if enabled == self.collect_model_stats:
+            return
+        self.collect_model_stats = enabled
+        if self.compiler is not None:
+            self.compiler.collect_stats = enabled
+            self._train_fn = None
+            self._eval_fn = None
+
+    def _stats_due(self):
+        """The gate of the model-health publish path (zlint
+        ``stats-cadence``): the cadence itself is enforced IN-GRAPH —
+        ``export_layer_stats`` strides the reduces by
+        ``stats_interval`` and emits ``-1`` sentinel rows in between
+        — so the host side only filters. Disabled collection means
+        nothing may materialize at all."""
+        return bool(self.collect_model_stats)
+
+    def _publish_model_stats(self, stats):
+        """The ONE sanctioned materialization point for in-graph stat
+        outputs: gate first, then materialize the tiny per-layer
+        vectors and drop the in-graph stride's sentinel rows (a
+        negative weight norm cannot occur naturally; NaN rows compare
+        False and are KEPT — they are the signal)."""
+        if not self._stats_due():
+            return
+        host = {}
+        for layer, vec in stats.items():
+            row = numpy.asarray(vec, numpy.float64).reshape(-1)
+            if row.shape[0] >= 2 and row[1] < 0.0:
+                continue
+            host[layer] = row
+        if not host:
+            return
+        from veles import model_health
+        model_health.get_model_monitor().observe_stats(
+            host, step_index=self.step_index)
+
     def _publish_metrics(self, outputs):
         """Hand step metrics to the host side. Every unit may declare
         ``metric_sinks() -> [(output_key, attr_name), ...]`` — the
         evaluator base declares n_err/loss; custom trainers (Kohonen,
-        RBM) publish their own."""
+        RBM) publish their own. Stat outputs (the model-health plane's
+        per-layer vectors) are split off first and published at the
+        stats cadence."""
+        from veles import model_health
+        stats, outputs = model_health.take_stats(outputs)
+        if stats:
+            self._publish_model_stats(stats)
         for unit in self.train_units:
             sinks = getattr(unit, "metric_sinks", None)
             if sinks is None:
