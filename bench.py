@@ -935,6 +935,85 @@ def _quantized_serving_rows(extra):
         extra["serving_cache_bytes_int8_error"] = str(exc)[:200]
 
 
+def continual_staleness_s(rounds=2):
+    """ISSUE 16 row: end-to-end staleness at the TRAINER point right
+    after a continual round completes — seconds between the last
+    ingested sample's arrival and "now", with the stream served
+    through the real prefetch plane (producer thread, bounded block
+    buffer). Steady state for the loop is "this stays near zero"."""
+    import numpy
+    from veles.loader.stream import ArraySource, ContinualStreamLoader
+    from veles.workflow import Workflow
+    rng = numpy.random.RandomState(5)
+    wf = Workflow(None, name="BenchContinual")
+    ld = ContinualStreamLoader(
+        wf, name="loader", minibatch_size=32,
+        source=ArraySource(
+            rng.uniform(-1, 1, (256, 16)).astype(numpy.float32),
+            rng.randint(0, 4, 256).astype(numpy.int32)),
+        round_samples=128, valid_samples=32)
+    try:
+        ld.initialize()
+        done = 0
+        while done < rounds:
+            ld.run()
+            if bool(ld.epoch_ended):
+                done += 1
+        return max(0.0, time.time() - ld.last_ingest_wall)
+    finally:
+        ld.stop()
+
+
+def rolling_refresh_downtime_s():
+    """ISSUE 16 row: wall time of ONE in-place registry hot swap on a
+    tiny MNIST model — the window a rolling refresh holds a drained
+    replica out of the fleet (the roll itself never fails requests:
+    the replica is drained first; this prices how long the roll
+    takes per replica)."""
+    import tempfile
+    import veles.prng as prng
+    from veles.config import root
+    from veles.serving import ModelRegistry
+    from veles.znicz_tpu.models import mnist
+    prng.seed_all(41)
+    saved = {k: root.mnist.loader.get(k)
+             for k in ("minibatch_size", "n_train", "n_valid")}
+    root.mnist.loader.update({"minibatch_size": 50, "n_train": 200,
+                              "n_valid": 50})
+    try:
+        wf = mnist.create_workflow(name="BenchRefresh")
+        wf.initialize(device="numpy")
+        with tempfile.TemporaryDirectory() as tmp:
+            wf.export_inference(tmp)
+            registry = ModelRegistry(backend="numpy", max_batch=64,
+                                     max_queue=256, max_wait_ms=1.0)
+            try:
+                registry.load("mnist", tmp, warmup=True)
+                t0 = time.perf_counter()
+                registry.reload("mnist")
+                return time.perf_counter() - t0
+            finally:
+                registry.close()
+    finally:
+        root.mnist.loader.update(saved)
+
+
+def _continual_rows(extra):
+    """Record the continual-loop pair guarded (device-independent
+    rows). Directionality: both are in _LOWER_BETTER — staleness or
+    refresh downtime creeping up is the loop decaying."""
+    try:
+        extra["staleness_seconds_steady_state"] = round(
+            continual_staleness_s(), 4)
+    except Exception as exc:
+        extra["staleness_seconds_steady_state_error"] = str(exc)[:200]
+    try:
+        extra["rolling_refresh_downtime_s"] = round(
+            rolling_refresh_downtime_s(), 4)
+    except Exception as exc:
+        extra["rolling_refresh_downtime_s_error"] = str(exc)[:200]
+
+
 def bias_grad_step_seconds(n=65536, k=96, reps=10):
     """ISSUE 14 tentpole row: wall seconds of ONE bias-gradient
     dispatch — relu-derivative mask + f32-accumulating reduction over
@@ -1147,7 +1226,8 @@ def _device_reachable(timeout_s=240):
 #: first-token latency, the analyzer's own wall time); everything
 #: else numeric in the report is a throughput/efficiency figure where
 #: bigger wins
-_LOWER_BETTER = ("bytes", "overhead", "latency", "seconds", "p99")
+_LOWER_BETTER = ("bytes", "overhead", "latency", "seconds", "p99",
+                 "staleness", "downtime")
 
 #: keys where BIGGER is better EVEN IF a lower-better substring ever
 #: lands in the same key: an MFU ratio is a utilization figure, down
@@ -1296,6 +1376,7 @@ def main(argv=None):
         extra = {"device_error": detail[:300]}
         _serving_row(extra)
         _quantized_serving_rows(extra)
+        _continual_rows(extra)
         _bias_grad_row(extra)
         _routed_rows(extra)
         _generate_rows(extra)
@@ -1354,6 +1435,9 @@ def main(argv=None):
     # int8 at-rest weights: quantized-vs-f32 rps + the cache shrink
     # (ISSUE 14; gauge-sourced, acceptance <= 55% of f32)
     _quantized_serving_rows(extra)
+    # continual-loop staleness + per-replica refresh downtime
+    # (ISSUE 16; both down = good — the loop decays upward)
+    _continual_rows(extra)
     # one bias-grad dispatch at a conv1-class shape through the
     # fused_bias_grad auto path (ISSUE 14; up = bad)
     _bias_grad_row(extra)

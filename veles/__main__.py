@@ -239,6 +239,18 @@ def build_argparser():
                         "full-model RAM copy + finiteness scan under "
                         "the request lock, so large models amortize "
                         "it — a restore discards at most N merges)")
+    p.add_argument("--continual", type=int, nargs="?", const=0,
+                   default=None, metavar="ROUNDS",
+                   help="continual training (ISSUE 16): keep running "
+                        "the workflow over its (streaming) loader in "
+                        "rounds of max_epochs, re-opening the stop "
+                        "gate between rounds, until interrupted/"
+                        "preempted — or for ROUNDS rounds when given. "
+                        "The snapshotter's --checkpoint-every gate "
+                        "keeps emitting verified 'current'-slot "
+                        "checkpoints throughout; MANIFESTs carry the "
+                        "ingest wall so serving staleness is "
+                        "measurable end to end")
     return p
 
 
@@ -336,7 +348,8 @@ class Main:
             model_stats=args.model_stats != "off",
             stats_interval=args.stats_interval,
             rollback_on_divergence=args.rollback_on_divergence,
-            stash_interval=args.stash_interval)
+            stash_interval=args.stash_interval,
+            continual=args.continual)
         if args.graphics_dir and not getattr(
                 self.workflow, "plotters", None) \
                 and hasattr(self.workflow, "link_plotters"):

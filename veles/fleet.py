@@ -83,6 +83,19 @@ def metric_total(metrics, name, **match):
     return total if hit else None
 
 
+def metric_max(metrics, name, **match):
+    """Max over ``name``'s matching children — for staleness-style
+    gauges where the fleet number is the WORST point (summing
+    staleness across points would fabricate a worse loop than
+    exists)."""
+    want = {(k, str(v)) for k, v in match.items()}
+    best = None
+    for (n, items), v in metrics.items():
+        if n == name and want <= set(items):
+            best = v if best is None else max(best, v)
+    return best
+
+
 def _fetch(url, timeout):
     """(status_code, body_bytes) — HTTP error codes are ANSWERS here
     (a 503 /readyz carries the reason payload), only transport
@@ -217,6 +230,16 @@ def scrape_target(base, timeout=5.0, total=None, extras=True):
         v = metric_total(metrics, name)
         if v is not None:
             summary[key] = v
+    # continual loop (ISSUE 16): end-to-end staleness and the served
+    # checkpoint's wall — MAX over label children, and absent on
+    # pre-PR-16 targets, which must only degrade the row
+    stale = metric_max(metrics, "veles_staleness_seconds")
+    if stale is not None:
+        summary["staleness_seconds"] = stale
+    wall = metric_max(metrics,
+                      "veles_serving_checkpoint_wall_seconds")
+    if wall is not None:
+        summary["serving_ckpt_wall"] = wall
     row["metrics"] = summary
     if not extras:
         # control-loop scrapes target serving replicas: skip the
@@ -425,6 +448,21 @@ def render_snapshot(snap):
                 detail.append("autoscale %s @%s"
                               % (last.get("direction"),
                                  last.get("url", "-")))
+            # rolling refresh (ISSUE 16): which replica last rolled
+            # to a fresh checkpoint — absent on pre-PR-16 routers,
+            # which must only degrade the row
+            rolling = router.get("rolling_refresh")
+            if isinstance(rolling, dict) \
+                    and isinstance(rolling.get("last"), dict):
+                last = rolling["last"]
+                urls = [b.get("url") for b in backends]
+                which = (
+                    "replica %d" % urls.index(last.get("replica"))
+                    if last.get("replica") in urls
+                    else str(last.get("replica", "?")).replace(
+                        "http://", ""))
+                detail.append("last refresh: %s (%s)"
+                              % (which, last.get("outcome", "?")))
         master = row.get("master")
         if master:
             detail.append("epoch %s/%s, %s slave(s)"
@@ -489,6 +527,11 @@ def render_snapshot(snap):
         # either may be absent (pre-PR-9/10 process) without a row
         # error
         health_bits = []
+        # the loop SLO (ISSUE 16) leads: "how far behind the stream
+        # is what this target runs" — absent on pre-PR-16 targets
+        stale = row.get("metrics", {}).get("staleness_seconds")
+        if stale is not None:
+            health_bits.append("staleness %.0fs" % stale)
         rss = row.get("metrics", {}).get("host_rss_bytes")
         if rss is not None:
             health_bits.append("rss %.1fMB" % (rss / 1048576.0))

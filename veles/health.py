@@ -85,6 +85,10 @@ DEFAULT_PREFIXES = (
     # verdict gauge — ring-sampled so the divergence SLOs
     # (install_model_slos) evaluate over them
     "veles_model_",
+    # continual loop (ISSUE 16, veles/continual.py): the end-to-end
+    # staleness gauge the burn-rate SLO evaluates over, round
+    # progress, and stream-ingest prefetch/failure counters
+    "veles_staleness_", "veles_continual_", "veles_stream_",
 )
 
 #: sampler cadence (seconds) and ring capacity: 1 Hz x 900 samples =

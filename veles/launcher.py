@@ -40,7 +40,7 @@ class Launcher(Logger):
                  grad_codec=None, grad_topk_percent=None,
                  slo_config=None, model_stats=True,
                  stats_interval=None, rollback_on_divergence=False,
-                 stash_interval=None):
+                 stash_interval=None, continual=None):
         self.name = "Launcher"
         self.device_spec = device
         self.snapshot = snapshot
@@ -81,6 +81,10 @@ class Launcher(Logger):
         #: the request lock, so large models amortize it (a restore
         #: then discards at most this many merges)
         self.stash_interval = stash_interval
+        #: continual mode (ISSUE 16, veles/continual.py): None = one
+        #: ordinary run; 0 = endless rounds; N>0 = that many rounds.
+        #: Standalone only — the distributed modes own their loops
+        self.continual = continual
         self.workflow = None
         self.interrupted = False
         #: True once SIGTERM asked for a preemption shutdown: the run
@@ -312,9 +316,18 @@ class Launcher(Logger):
         try:
             with prof:
                 if self.mode == "master":
+                    if self.continual is not None:
+                        self.warning("--continual is standalone-only "
+                                     "for now; running one ordinary "
+                                     "master session")
                     self._run_master()
                 elif self.mode == "slave":
                     self._run_slave()
+                elif self.continual is not None:
+                    from veles import continual as continual_mod
+                    continual_mod.continual_loop(
+                        wf, rounds=self.continual or None,
+                        launcher=self)
                 else:
                     wf.run()
             if not isinstance(prof, contextlib.nullcontext):

@@ -119,6 +119,9 @@ _H_LATENCY = telemetry.LazyChild(lambda: telemetry.histogram(
     "veles_router_request_seconds",
     "Routed request latency as the router observed it (connect to "
     "last byte)"))
+_C_REFRESH = telemetry.LazyChild(lambda: telemetry.counter(
+    "veles_router_refreshes_total",
+    "Rolling-refresh replica rolls, by outcome", ("outcome",)))
 
 
 class HashRing:
@@ -165,7 +168,8 @@ class Replica:
     __slots__ = ("url", "state", "reason", "fails", "inflight",
                  "trial_inflight", "queue_rows", "kv_in_use",
                  "kv_slots", "firing", "reachable", "ready",
-                 "requests", "errors", "launched")
+                 "requests", "errors", "launched", "ckpt_wall",
+                 "staleness")
 
     def __init__(self, url, launched=False):
         self.url = url
@@ -183,6 +187,8 @@ class Replica:
         self.requests = 0
         self.errors = 0
         self.launched = launched     # autoscaler-owned (stoppable)
+        self.ckpt_wall = None        # None = pre-continual replica
+        self.staleness = None
 
     def describe(self):
         return {"url": self.url, "state": self.state,
@@ -194,7 +200,9 @@ class Replica:
                 "consecutive_failures": self.fails,
                 "requests_total": self.requests,
                 "errors_total": self.errors,
-                "launched": self.launched}
+                "launched": self.launched,
+                "ckpt_wall": self.ckpt_wall,
+                "staleness": self.staleness}
 
 
 class FleetController(Logger):
@@ -205,13 +213,14 @@ class FleetController(Logger):
 
     def __init__(self, targets, interval=1.0, scrape_timeout=2.0,
                  eject_failures=3, slo_eject=True, autoscaler=None,
-                 full_scrape=False):
+                 full_scrape=False, refresher=None):
         self.name = "router-fleet"
         self.interval = float(interval)
         self.scrape_timeout = float(scrape_timeout)
         self.eject_failures = int(eject_failures)
         self.slo_eject = bool(slo_eject)
         self.autoscaler = autoscaler
+        self.refresher = refresher
         self.full_scrape = bool(full_scrape)
         self._lock = threading.Lock()
         self._replicas = {}          # url -> Replica (insert order)
@@ -274,6 +283,23 @@ class FleetController(Logger):
                                inflight=inflight)
         self.info("draining %s (%d in flight)", url, inflight)
         return inflight
+
+    def readmit(self, url):
+        """Return a DRAINING replica to the routable set (the other
+        half of :meth:`drain` — the rolling refresh re-admits each
+        replica after its reload passes ``/readyz``). -> True when
+        the state changed."""
+        url = _norm_url(url)
+        with self._lock:
+            r = self._replicas.get(url)
+            if r is None or r.state != DRAINING:
+                return False
+            r.state = ADMITTED
+            r.reason = None
+            r.fails = 0
+        telemetry.record_event("router_readmit", replica=url)
+        self.info("backend %s re-admitted after drain", url)
+        return True
 
     def inflight(self, url):
         with self._lock:
@@ -340,6 +366,12 @@ class FleetController(Logger):
             except Exception as exc:
                 self.warning("autoscaler evaluation failed: %s: %s",
                              type(exc).__name__, exc)
+        if self.refresher is not None:
+            try:
+                self.refresher.evaluate(self)
+            except Exception as exc:
+                self.warning("rolling-refresh evaluation failed: "
+                             "%s: %s", type(exc).__name__, exc)
         with self._lock:
             self.status_doc = self._build_status(
                 [r.describe() for r in self._replicas.values()])
@@ -361,6 +393,13 @@ class FleetController(Logger):
             r.kv_in_use = float(
                 metrics.get("kv_slots_in_use") or 0.0)
             r.kv_slots = float(metrics.get("kv_pool_slots") or 0.0)
+            # absent on pre-continual replicas: keep None, never 0 —
+            # the rolling refresh must not mistake "no gauge" for
+            # "infinitely stale"
+            wall = metrics.get("serving_ckpt_wall")
+            r.ckpt_wall = float(wall) if wall else None
+            stale = metrics.get("staleness_seconds")
+            r.staleness = None if stale is None else float(stale)
         if not r.reachable:
             reason, category = (
                 "unreachable: %s" % row.get("error", "?"),
@@ -412,6 +451,8 @@ class FleetController(Logger):
                                if b.get("state") == ADMITTED)}
         if self.autoscaler is not None:
             doc["autoscaler"] = self.autoscaler.describe()
+        if self.refresher is not None:
+            doc["rolling_refresh"] = self.refresher.describe()
         return doc
 
     def _publish_gauges(self):
@@ -780,6 +821,181 @@ class Autoscaler(Logger):
             thread.join(timeout=getattr(
                 self.executor, "start_timeout", 5.0) + 5.0)
         self.executor.close()
+
+
+# -- rolling refresh (ISSUE 16) -----------------------------------------
+
+
+class RollingRefresh(Logger):
+    """Verified-checkpoint rolling fleet refresh: close the continual
+    loop's last mile.
+
+    Evaluated once per control tick (on the controller thread, same
+    contract as :class:`Autoscaler`); every ``period_s`` it moves the
+    whole roll OFF the control thread — a roll waits out drains and
+    reload health polls for seconds, and ejections/re-admissions must
+    not freeze behind it. The worker:
+
+    1. scans the snapshot store newest-first, SKIPPING diverged
+       verdicts (a poisoned update is never rolled out — the skip is
+       logged with the blob name and recorded);
+    2. picks the ADMITTED replicas whose scraped
+       ``serving_ckpt_wall`` is older than the newest healthy
+       checkpoint (replicas without the gauge — pre-continual
+       processes — are left alone);
+    3. rolls them STRICTLY one at a time: drain -> wait inflight 0 ->
+       ``POST /v1/models/<m>/refresh`` -> wait ``/readyz`` -> readmit.
+
+    A failed roll re-admits the replica anyway — serving the previous
+    version beats serving nothing — and counts under
+    ``veles_router_refreshes_total{outcome}``."""
+
+    def __init__(self, store, model, period_s=30.0,
+                 drain_timeout_s=30.0, ready_timeout_s=60.0,
+                 http_timeout_s=5.0):
+        self.name = "rolling-refresh"
+        self.store = str(store)
+        self.model = str(model)
+        self.period_s = float(period_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.http_timeout_s = float(http_timeout_s)
+        self._thread = None
+        self._last_scan = None
+        self._lock = threading.Lock()
+        self.rolls = []              # newest-last, bounded
+        self.newest = None           # newest healthy blob seen
+
+    def describe(self):
+        thread = self._thread
+        with self._lock:
+            return {"store": self.store, "model": self.model,
+                    "period_s": self.period_s,
+                    "rolling": bool(thread) and thread.is_alive(),
+                    "newest_checkpoint": self.newest,
+                    "last": self.rolls[-1] if self.rolls else None,
+                    "rolls": len(self.rolls)}
+
+    def evaluate(self, controller):
+        now = time.monotonic()
+        if self._thread is not None and self._thread.is_alive():
+            return
+        if self._last_scan is not None \
+                and now - self._last_scan < self.period_s:
+            return
+        self._last_scan = now
+        self._thread = threading.Thread(
+            target=self._roll_fleet, args=(controller,), daemon=True,
+            name="rolling-refresh")
+        self._thread.start()
+
+    def _newest_healthy(self):
+        """Newest valid, NON-diverged checkpoint in the store (the
+        scan already ranks corrupt/legacy blobs last)."""
+        from veles import snapshotter
+        try:
+            infos = snapshotter.scan_checkpoints(self.store)
+        except Exception as exc:
+            self.warning("store scan of %s failed: %s: %s",
+                         self.store, type(exc).__name__, exc)
+            return None
+        for info in infos:
+            if info.status != "valid":
+                continue
+            if info.health_verdict == "diverged":
+                telemetry.record_event("refresh_skipped_diverged",
+                                       checkpoint=info.name,
+                                       store=self.store)
+                self.warning("rolling refresh SKIPPED diverged "
+                             "checkpoint %s", info.name)
+                continue
+            return info
+        return None
+
+    def _roll_fleet(self, controller):
+        info = self._newest_healthy()
+        if info is None or info.wall_time is None:
+            return
+        with self._lock:
+            self.newest = {"name": info.name,
+                           "wall_time": info.wall_time}
+        with controller._lock:
+            stale = [r.url for r in controller._replicas.values()
+                     if r.state == ADMITTED and r.ckpt_wall is not None
+                     and float(info.wall_time) > r.ckpt_wall + 1e-6]
+        for url in stale:            # strictly one at a time
+            self._roll_one(controller, url, info)
+
+    def _roll_one(self, controller, url, info):
+        outcome, error = "ok", None
+        t0 = time.monotonic()
+        path = ("%s/%s" % (self.store.rstrip("/"), info.name)
+                if self.store.startswith(("http://", "https://"))
+                else os.path.join(self.store, info.name))
+        try:
+            if controller.drain(url) is None:
+                outcome, error = "skipped", "replica left the fleet"
+                return
+            deadline = t0 + self.drain_timeout_s
+            while (controller.inflight(url) or 0) > 0:
+                if time.monotonic() >= deadline:
+                    outcome, error = "failed", "drain timed out"
+                    return
+                time.sleep(0.05)
+            body = json.dumps({"checkpoint": path,
+                               "store": self.store}).encode()
+            req = urllib.request.Request(
+                "%s/v1/models/%s/refresh" % (url, self.model),
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            # the reload is synchronous on the replica side: the 200
+            # means the new checkpoint serves
+            with urllib.request.urlopen(
+                    req, timeout=self.ready_timeout_s) as resp:
+                json.load(resp)
+            deadline = time.monotonic() + self.ready_timeout_s
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                            url + "/readyz",
+                            timeout=self.http_timeout_s) as resp:
+                        if resp.status == 200:
+                            break
+                except OSError:      # 503 lands here too (HTTPError)
+                    pass
+                if time.monotonic() >= deadline:
+                    outcome, error = \
+                        "failed", "/readyz never recovered"
+                    return
+                time.sleep(0.1)
+        except Exception as exc:
+            outcome = "failed"
+            error = "%s: %s" % (type(exc).__name__, exc)
+        finally:
+            # serving the previous version beats serving nothing: a
+            # replica whose roll failed is re-admitted regardless
+            controller.readmit(url)
+            _C_REFRESH.get().labels(outcome).inc()
+            telemetry.record_event("rolling_refresh", replica=url,
+                                   checkpoint=info.name,
+                                   outcome=outcome,
+                                   error=error or "-")
+            record = {"wall": round(time.time(), 3), "replica": url,
+                      "checkpoint": info.name, "outcome": outcome,
+                      "error": error,
+                      "took_s": round(time.monotonic() - t0, 3)}
+            with self._lock:
+                self.rolls.append(record)
+                del self.rolls[:-64]
+            log = self.info if outcome == "ok" else self.warning
+            log("rolled %s to %s: %s%s", url, info.name, outcome,
+                "" if error is None else " (%s)" % error)
+
+    def close(self):
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=self.ready_timeout_s + 5.0)
 
 
 # -- the HTTP proxy -----------------------------------------------------
@@ -1183,6 +1399,17 @@ def build_route_argparser():
                         "the autoscaler acts")
     p.add_argument("--cooldown", type=float, default=30.0,
                    help="seconds between autoscaler actions")
+    p.add_argument("--refresh-store", default=None, metavar="TARGET",
+                   help="snapshot store (dir or http base) to watch "
+                        "for newer HEALTHY checkpoints; with "
+                        "--refresh-model, enables the rolling fleet "
+                        "refresh (diverged blobs never roll out)")
+    p.add_argument("--refresh-model", default=None, metavar="NAME",
+                   help="served model name the rolling refresh "
+                        "reloads on each replica")
+    p.add_argument("--refresh-period", type=float, default=30.0,
+                   metavar="SECS",
+                   help="seconds between rolling-refresh store scans")
     p.add_argument("--slo-config", default=None, metavar="PATH",
                    help="JSON list of SLO objectives for the "
                         "router's own health monitor (e.g. on "
@@ -1218,12 +1445,20 @@ def route_main(argv=None):
             queue_high=args.queue_high, queue_low=args.queue_low,
             sustain_ticks=args.sustain_ticks,
             cooldown_s=args.cooldown)
+    refresher = None
+    if args.refresh_store or args.refresh_model:
+        if not (args.refresh_store and args.refresh_model):
+            raise SystemExit("--refresh-store and --refresh-model "
+                             "go together")
+        refresher = RollingRefresh(args.refresh_store,
+                                   args.refresh_model,
+                                   period_s=args.refresh_period)
     controller = FleetController(
         args.backends, interval=args.interval,
         scrape_timeout=args.scrape_timeout,
         eject_failures=args.eject_failures,
         slo_eject=not args.no_slo_eject, autoscaler=autoscaler,
-        full_scrape=args.full_scrape)
+        full_scrape=args.full_scrape, refresher=refresher)
     front = None
     try:
         front = RouterFrontend(controller, port=args.port,
@@ -1255,6 +1490,8 @@ def route_main(argv=None):
         controller.close()
         if autoscaler is not None:
             autoscaler.close()
+        if refresher is not None:
+            refresher.close()
     return 0
 
 

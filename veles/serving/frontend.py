@@ -141,6 +141,12 @@ class ServingFrontend(Logger):
                 # decoder build and a non-streaming wait do block —
                 # worker thread, replies posted back to the loop
                 request.defer(self._serve_generate, request)
+            elif (path.startswith("/v1/models/")
+                    and path.endswith("/refresh")):
+                # the rolling-refresh hook: store scan + checkpoint
+                # load both block — worker thread
+                request.defer(self._serve_refresh, request,
+                              path[len("/v1/models/"):-len("/refresh")])
             else:
                 request.reply_json(404, {"error": "not found"})
             return
@@ -180,6 +186,42 @@ class ServingFrontend(Logger):
                                {"models": self.registry.describe()})
         else:
             request.reply_json(404, {"error": "not found"})
+
+    def _serve_refresh(self, request, name):
+        """Worker-thread half of ``POST /v1/models/<name>/refresh``
+        (ISSUE 16): hot-load either the explicit checkpoint in the
+        body (``{"checkpoint": ...}`` — what the router's rolling
+        refresh sends after its own health gate) or the newest
+        healthy one the refresh poll finds (``{"store": ...}``
+        optionally naming where to scan)."""
+        try:
+            doc = json.loads(request.body) if request.body else {}
+        except ValueError:
+            request.reply_json(400, {"error": "bad json"})
+            return
+        try:
+            entry = self.registry.get(name)
+        except KeyError:
+            request.reply_json(404, {"error": "no model %r" % name})
+            return
+        checkpoint = doc.get("checkpoint")
+        try:
+            if checkpoint:
+                entry = self.registry.load(
+                    name, entry.source, checkpoint=checkpoint,
+                    refresh_store=doc.get("store"))
+                loaded = checkpoint
+            else:
+                loaded = self.registry.refresh_newest(
+                    name, store_target=doc.get("store"))
+                entry = self.registry.get(name)
+        except (ValueError, OSError) as exc:
+            request.reply_json(409, {"error": str(exc)})
+            return
+        request.reply_json(200, {
+            "model": name, "version": entry.version,
+            "loaded": loaded,
+            "checkpoint_meta": dict(entry.model.checkpoint_meta)})
 
     def _serve_profile(self, request):
         from veles import profiling
@@ -639,6 +681,16 @@ def build_serve_argparser():
                    help="per-slot KV length: prompt + max_tokens "
                         "must fit (clamped to the exported "
                         "positions table)")
+    p.add_argument("--refresh-every", type=float, default=None,
+                   metavar="SECS",
+                   help="poll each model's snapshot store this often "
+                        "and hot-load the newest HEALTHY checkpoint "
+                        "(diverged blobs are skipped and counted)")
+    p.add_argument("--refresh-store", action="append", default=[],
+                   metavar="NAME=TARGET",
+                   help="snapshot store (dir or http base) the "
+                        "refresh poll scans for NAME; defaults to "
+                        "the store implied by --checkpoint")
     p.add_argument("--slo-config", default=None, metavar="PATH",
                    help="JSON list of SLO objectives evaluated by "
                         "the in-process health monitor (burn-rate "
@@ -669,10 +721,12 @@ def serve_main(argv=None):
     args = build_serve_argparser().parse_args(argv)
     models = _parse_kv(args.model, "--model")
     checkpoints = _parse_kv(args.checkpoint, "--checkpoint")
-    unknown = sorted(set(checkpoints) - set(models))
+    refresh_stores = _parse_kv(args.refresh_store, "--refresh-store")
+    unknown = sorted((set(checkpoints) | set(refresh_stores))
+                     - set(models))
     if unknown:
-        raise SystemExit("--checkpoint for unloaded model(s): %s"
-                         % ", ".join(unknown))
+        raise SystemExit("--checkpoint/--refresh-store for unloaded "
+                         "model(s): %s" % ", ".join(unknown))
     telemetry.tracer.set_process_name("serving")
     registry = ModelRegistry(
         backend=args.backend, max_batch=args.max_batch,
@@ -689,9 +743,21 @@ def serve_main(argv=None):
         for name, source in sorted(models.items()):
             registry.load(name, source,
                           checkpoint=checkpoints.get(name),
-                          warmup=not args.no_warmup)
+                          warmup=not args.no_warmup,
+                          refresh_store=refresh_stores.get(name))
         front = ServingFrontend(registry, port=args.port,
                                 host=args.host)
+        if args.refresh_every:
+            def refresh_poll():
+                while not poll_stop.wait(args.refresh_every):
+                    for name in sorted(models):
+                        try:
+                            registry.refresh_newest(name)
+                        except ValueError:
+                            pass    # no store configured for it
+            poll_stop = threading.Event()
+            threading.Thread(target=refresh_poll, daemon=True,
+                             name="RefreshPoll").start()
         if args.slo_config:
             n = health.get_monitor().load_slo_file(args.slo_config)
             front.info("%d SLO objective(s) loaded from %s", n,

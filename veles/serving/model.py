@@ -314,6 +314,10 @@ class ArchiveModel:
                                    else tuple(input_sample_shape))
         self.units = units          # list of spec dicts
         self.params = params        # {unit_name: {key: np.float32 arr}}
+        #: MANIFEST excerpt of the checkpoint the params came from
+        #: (wall_time / ingest_wall / verdict), {} for archive-only
+        #: models — what the serving staleness gauges read
+        self.checkpoint_meta = {}
         for spec in units:
             if spec["type"] not in FORWARD_OPS:
                 raise ValueError(
@@ -427,4 +431,11 @@ class ArchiveModel:
                 "checkpoint %s shares no parameters with this model "
                 "(unit names: %s)" % (target,
                                       sorted(self.params)))
+        manifest = manifest or {}
+        self.checkpoint_meta = {
+            "wall_time": manifest.get("wall_time"),
+            "ingest_wall": manifest.get("ingest_wall"),
+            "verdict": (health_doc or {}).get("verdict")
+            if isinstance(health_doc, dict) else None,
+        }
         return loaded

@@ -18,6 +18,7 @@ engine keeps its compiled programs (params are runtime arguments) —
 reload costs one host→device upload, no recompilation.
 """
 
+import os
 import threading
 import time
 
@@ -27,18 +28,27 @@ from veles.serving.batcher import MicroBatcher
 from veles.serving.engine import InferenceEngine
 from veles.serving.model import ArchiveModel
 
+_C_REFRESH_FAILURES = telemetry.LazyChild(lambda: telemetry.counter(
+    "veles_serving_refresh_failures_total",
+    "Hot reloads that failed and degraded to the loaded version",
+    ("model",)))
+
 
 class ServedModel:
     """One registry entry: model + engine + batcher + metadata."""
 
     def __init__(self, name, model, engine, batcher, source,
-                 checkpoint=None):
+                 checkpoint=None, refresh_store=None):
         self.name = name
         self.model = model
         self.engine = engine
         self.batcher = batcher
         self.source = source
         self.checkpoint = checkpoint
+        #: snapshot-store target (dir or http base) the refresh poll
+        #: scans for newer healthy checkpoints (ISSUE 16 rolling
+        #: refresh); derived from ``checkpoint`` when unset
+        self.refresh_store = refresh_store
         self.version = 1
         self.loaded_at = time.time()
         #: lazy decode plane (ISSUE 11): built by
@@ -158,10 +168,12 @@ class ModelRegistry(Logger):
 
     # -- lifecycle -----------------------------------------------------
 
-    def load(self, name, source, checkpoint=None, warmup=False):
+    def load(self, name, source, checkpoint=None, warmup=False,
+             refresh_store=None):
         """Load (or replace) model ``name`` from artifact directory
         ``source``; optionally refresh its params from ``checkpoint``
-        and precompile the bucket ladder."""
+        and precompile the bucket ladder. ``refresh_store`` records
+        the snapshot-store target :meth:`refresh_newest` polls."""
         model = ArchiveModel.from_dir(source)
         if checkpoint:
             model.load_checkpoint(checkpoint)
@@ -181,6 +193,8 @@ class ModelRegistry(Logger):
                     old.decoder.engine.set_params(model)
                 old.source = source
                 old.checkpoint = checkpoint
+                if refresh_store:
+                    old.refresh_store = refresh_store
                 old.version += 1
                 old.loaded_at = time.time()
                 self._version_gauge(name).set(old.version)
@@ -197,11 +211,14 @@ class ModelRegistry(Logger):
                 default_timeout_ms=self.default_timeout_ms,
                 name="batcher-%s" % name, model=name)
             entry = ServedModel(name, model, engine, batcher, source,
-                                checkpoint)
+                                checkpoint, refresh_store=refresh_store)
             if old is not None:
                 entry.version = old.version + 1
+                if refresh_store is None:
+                    entry.refresh_store = old.refresh_store
             self._models[name] = entry
         self._version_gauge(name).set(entry.version)
+        self._checkpoint_gauges(name)
         # scrape-time evaluation: buckets compile lazily and reloads
         # swap entries, so a stored value would go stale immediately.
         # Unloaded names read 0 (the series stays, the memory is gone).
@@ -248,10 +265,7 @@ class ModelRegistry(Logger):
                 self._refresh_failures[name] = \
                     self._refresh_failures.get(name, 0) + 1
                 n = self._refresh_failures[name]
-            telemetry.counter(
-                "veles_serving_refresh_failures_total",
-                "Hot reloads that failed and degraded to the loaded "
-                "version", ("model",)).labels(name).inc()
+            _C_REFRESH_FAILURES.get().labels(name).inc()
             telemetry.record_event("reload_failed", model=name,
                                    error=str(exc))
             self.warning(
@@ -259,6 +273,120 @@ class ModelRegistry(Logger):
                 "still serving v%d", name, type(exc).__name__, exc,
                 n, entry.version)
             return entry
+
+    # -- rolling refresh (ISSUE 16) ------------------------------------
+
+    def refresh_newest(self, name, store_target=None):
+        """The refresh poll: scan the model's snapshot store for the
+        newest HEALTHY checkpoint and hot-load it when it is newer
+        than what is served.
+
+        Every diverged blob encountered on the way down is skipped
+        WITH ITS NAME in the log, an event in the flight recorder and
+        a count in ``veles_checkpoint_diverged_skips_total`` — a
+        wedged rollout must be diagnosable from one scrape. Corrupt
+        and legacy blobs fall through silently (the scan already
+        ranks them last). Store/transport failures degrade like
+        :meth:`reload`: counted, logged, still serving.
+
+        -> the loaded checkpoint path, or None (nothing newer, or
+        the refresh degraded)."""
+        from veles import snapshotter
+        entry = self.get(name)
+        target = store_target or entry.refresh_store
+        if target is None and entry.checkpoint:
+            # a concrete checkpoint path implies its store
+            ckpt = str(entry.checkpoint)
+            target = (ckpt.rsplit("/", 1)[0]
+                      if ckpt.startswith(("http://", "https://"))
+                      else os.path.dirname(ckpt))
+        if not target:
+            raise ValueError(
+                "model %r has no snapshot store to refresh from "
+                "(pass store_target or load with refresh_store=)"
+                % name)
+        served_wall = entry.model.checkpoint_meta.get("wall_time")
+        try:
+            infos = snapshotter.scan_checkpoints(target)
+        except Exception as exc:
+            with self._lock:
+                self._refresh_failures[name] = \
+                    self._refresh_failures.get(name, 0) + 1
+            _C_REFRESH_FAILURES.get().labels(name).inc()
+            self.warning("refresh poll of %s: store scan of %s failed "
+                         "(%s: %s) — still serving v%d", name, target,
+                         type(exc).__name__, exc, entry.version)
+            return None
+        for info in infos:
+            if info.status != "valid":
+                continue
+            if info.wall_time is not None and served_wall \
+                    and info.wall_time <= float(served_wall):
+                break               # nothing newer than what we serve
+            if info.health_verdict == "diverged":
+                snapshotter._count_diverged_skip()
+                telemetry.record_event("refresh_skipped_diverged",
+                                       model=name,
+                                       checkpoint=info.name)
+                self.warning(
+                    "refresh poll of %s SKIPPED diverged checkpoint "
+                    "%s — still serving v%d (staleness reflects the "
+                    "skip)", name, info.name, entry.version)
+                continue
+            path = ("%s/%s" % (str(target).rstrip("/"), info.name)
+                    if str(target).startswith(("http://", "https://"))
+                    else os.path.join(str(target), info.name))
+            try:
+                self.load(name, entry.source, checkpoint=path,
+                          refresh_store=target)
+            except Exception as exc:
+                with self._lock:
+                    self._refresh_failures[name] = \
+                        self._refresh_failures.get(name, 0) + 1
+                _C_REFRESH_FAILURES.get().labels(name).inc()
+                telemetry.record_event("reload_failed", model=name,
+                                       error=str(exc))
+                self.warning(
+                    "refresh of %s from %s failed (%s: %s) — still "
+                    "serving v%d", name, path, type(exc).__name__,
+                    exc, entry.version)
+                return None
+            telemetry.record_event("refresh_loaded", model=name,
+                                   checkpoint=info.name,
+                                   wall_time=info.wall_time)
+            return path
+        return None
+
+    def _checkpoint_gauges(self, name):
+        """Scrape-time gauges over the served checkpoint's MANIFEST:
+        the absolute walls the rolling-refresh orchestrator compares
+        across replicas, and the model's own staleness point."""
+        from veles.continual import install_point_gauge
+        telemetry.gauge(
+            "veles_serving_checkpoint_wall_seconds",
+            "MANIFEST wall time of the served checkpoint (0 = "
+            "serving the export archive, no checkpoint loaded)",
+            ("model",)).labels(name).set_function(
+                lambda n=name: self._ckpt_meta(n, "wall_time"))
+        telemetry.gauge(
+            "veles_serving_checkpoint_ingest_wall_seconds",
+            "MANIFEST ingest_wall of the served checkpoint (0 = no "
+            "continual stamp)", ("model",)).labels(name).set_function(
+                lambda n=name: self._ckpt_meta(n, "ingest_wall"))
+        install_point_gauge(
+            "serving:%s" % name,
+            lambda n=name: self._ckpt_meta(n, "ingest_wall") or None)
+
+    def _ckpt_meta(self, name, key):
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            return 0.0
+        value = entry.model.checkpoint_meta.get(key)
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return 0.0
 
     def unload(self, name):
         with self._lock:

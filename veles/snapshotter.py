@@ -612,10 +612,18 @@ def health_stamp_meta():
     """The ``extra_meta`` every checkpoint writer stamps: the model
     monitor's current verdict + stats snapshot under ``model_health``
     — what lets ``resolve_auto`` and the serving registry's refresh
-    skip blobs written while the model was diverging."""
-    from veles import model_health
-    return {"model_health":
+    skip blobs written while the model was diverging — plus, when a
+    continual run registered an ingest clock, ``ingest_wall``: the
+    wall time of the newest sample behind these weights, the number
+    the end-to-end staleness SLO (veles/continual.py) measures a
+    serving replica against."""
+    from veles import continual, model_health
+    meta = {"model_health":
             model_health.get_model_monitor().manifest_stamp()}
+    wall = continual.ingest_wall()
+    if wall:
+        meta["ingest_wall"] = float(wall)
+    return meta
 
 
 class _CountingSink:
@@ -782,6 +790,17 @@ class CheckpointInfo:
             doc = self.manifest.get("model_health")
             if isinstance(doc, dict):
                 return doc.get("verdict")
+        return None
+
+    @property
+    def ingest_wall(self):
+        """Wall time of the newest sample behind these weights
+        (continual runs, ISSUE 16), or None for non-streaming blobs."""
+        if self.manifest:
+            try:
+                return float(self.manifest.get("ingest_wall"))
+            except (TypeError, ValueError):
+                pass
         return None
 
     def __repr__(self):
