@@ -1227,14 +1227,16 @@ def _device_reachable(timeout_s=240):
 #: else numeric in the report is a throughput/efficiency figure where
 #: bigger wins
 _LOWER_BETTER = ("bytes", "overhead", "latency", "seconds", "p99",
-                 "staleness", "downtime")
+                 "staleness", "downtime", "shed", "rejected")
 
 #: keys where BIGGER is better EVEN IF a lower-better substring ever
 #: lands in the same key: an MFU ratio is a utilization figure, down
 #: = bad, and an MFU regression must be flagged in its own right —
 #: not only via the throughput row it was derived from (ISSUE 14
-#: satellite; covered by the directionality fixture in test_health)
-_HIGHER_BETTER = ("mfu",)
+#: satellite; covered by the directionality fixture in test_health).
+#: routed_capacity_rps_at_p99_slo carries "p99" in its name but IS a
+#: capacity figure (ISSUE 18's loadgen row): down = bad.
+_HIGHER_BETTER = ("mfu", "routed_capacity")
 
 #: keys that are environment stamps, not performance rows
 _SELF_CHECK_SKIP = ("calibration",)

@@ -77,6 +77,16 @@ def _model_health_isolation():
 
 
 @pytest.fixture(autouse=True)
+def _tenant_table_isolation():
+    """The per-tenant QoS table (veles/serving/tenants.py) is
+    process-global by design; a test that installs one must never
+    leave quotas/weights behind for the next test's frontends."""
+    yield
+    from veles.serving import tenants
+    tenants.set_table(None)
+
+
+@pytest.fixture(autouse=True)
 def _health_isolation():
     """Each test gets a fresh health monitor (veles/health.py): the
     readiness checks and SLO alert state one test registers (web

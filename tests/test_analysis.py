@@ -684,6 +684,45 @@ class Unit:
                     select=["telemetry-hygiene"]) == []
 
 
+def test_telemetry_hygiene_wire_label_fires(tmp_path):
+    # ISSUE 18: a .labels(...) value read straight off the wire lets
+    # callers mint series at will — every spelling of the read fires
+    src = """\
+def count(fam, request):
+    fam.labels(request.headers.get("x-veles-tenant")).inc()
+    fam.labels(request.headers["x-api-key"]).inc()
+    fam.labels("t-%s" % request.body).inc()
+"""
+    findings = lint_src(tmp_path, src, select=["telemetry-hygiene"])
+    assert rule_ids(findings) == ["telemetry-hygiene"] * 3
+    assert "headers/body" in findings[0].message
+    assert "resolve" in findings[0].hint
+
+
+def test_telemetry_hygiene_wire_label_resolver_quiet(tmp_path):
+    # the sanctioned spelling: the raw header passes through a
+    # bounded *resolve* call (unknown keys fold to one bucket), or is
+    # resolved into a plain local before labelling
+    src = """\
+def count(fam, table, request):
+    fam.labels(table.resolve(request.headers.get("x-tenant"))).inc()
+    tenant = table.resolve(request.headers.get("x-tenant"))
+    fam.labels(tenant).inc()
+"""
+    assert lint_src(tmp_path, src,
+                    select=["telemetry-hygiene"]) == []
+
+
+def test_telemetry_hygiene_wire_label_pragma(tmp_path):
+    src = """\
+def count(fam, request):
+    fam.labels(request.headers.get("x-t")).inc()  \
+# zlint: disable=telemetry-hygiene (bounded by proxy upstream)
+"""
+    assert lint_src(tmp_path, src,
+                    select=["telemetry-hygiene"]) == []
+
+
 def test_telemetry_hygiene_span_rule_ignores_foreign_span(tmp_path):
     # .span on a non-telemetry receiver (e.g. a regex Match.span or a
     # geometry object) must not fire, whatever the argument looks like

@@ -290,7 +290,7 @@ class _ShedEntry:
     warm = True
     checkpoint = None
 
-    def predict(self, rows, timeout_ms=None, trace=None):
+    def predict(self, rows, timeout_ms=None, trace=None, tenant=None):
         from veles.serving.batcher import QueueFull
         raise QueueFull("queue full (256 rows pending, max 256)")
 
@@ -539,6 +539,9 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                 "serving_cache_bytes_int8": 200000,
                 "serving_throughput_rps_int8": 3000.0,
                 "model_stats_overhead_pct": 0.5,
+                "loadgen_shed_rate_pct": 1.0,
+                "serving_rejected_per_sec": 10.0,
+                "routed_capacity_rps_at_p99_slo": 100.0,
                 "some_row_error": "boom",
             }}}
     path = tmp_path / "BENCH_r07.json"
@@ -571,6 +574,12 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
             # ISSUE 15: in-graph model-stat cost is an overhead — UP
             # is the bad direction ("overhead" is in _LOWER_BETTER)
             "model_stats_overhead_pct": 1.8,               # +260%: bad
+            # ISSUE 18: shed/rejected rates are costs — UP is bad;
+            # routed capacity carries a "p99" substring but is a
+            # capacity figure (bench._HIGHER_BETTER) — DOWN is bad
+            "loadgen_shed_rate_pct": 5.0,                  # +400%: bad
+            "serving_rejected_per_sec": 20.0,              # +100%: bad
+            "routed_capacity_rps_at_p99_slo": 50.0,        # -50%: bad
         }}
     regressed = bench.self_check(report, threshold_pct=10.0,
                                  baseline_path=str(path))
@@ -587,7 +596,10 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                               "lm_mfu_s8192",
                               "bias_grad_step_seconds",
                               "serving_cache_bytes_int8",
-                              "model_stats_overhead_pct"}
+                              "model_stats_overhead_pct",
+                              "loadgen_shed_rate_pct",
+                              "serving_rejected_per_sec",
+                              "routed_capacity_rps_at_p99_slo"}
     assert "REGRESSION" in err and "warn-only" in err
     assert "_best" not in err.split("rows in baseline")[0]
     # no baseline -> a note, no crash, nothing regressed

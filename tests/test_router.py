@@ -424,8 +424,14 @@ def test_router_proxies_predict_with_trace_and_metrics():
         assert headers.get("traceparent") == trace.to_traceparent()
         assert stub.last_headers.get("x-forwarded-for")
         reg = telemetry.get_registry()
-        assert reg.counter_total("veles_router_requests_total",
-                                 replica=stub.url, outcome="ok") == 1
+        # the outcome counter increments AFTER the reply is handed to
+        # the reactor's write queue — the client can observe the
+        # response a beat before the router thread settles accounting
+        wait_until(
+            lambda: reg.counter_total("veles_router_requests_total",
+                                      replica=stub.url,
+                                      outcome="ok") == 1,
+            what="routed request counted")
         # routed latency histogram observed the request
         hist = fleet.parse_prometheus(
             reg.render_prometheus())

@@ -1,0 +1,695 @@
+"""Per-tenant observability & QoS (ISSUE 18).
+
+The acceptance path: a tenant table (``--tenants``) turns the serving
+plane multi-tenant — bounded identity off the ``x-veles-tenant``
+header, token-bucket quotas answering 429 + Retry-After, weighted-fair
+scheduling in BOTH batchers so one tenant's burst cannot starve
+another, tenant-labelled telemetry with per-tenant p99 SLOs, and the
+``velescli loadgen`` open-loop harness proving capacity against a real
+routed 2-replica fleet while an abusive tenant and a browned-out
+replica (chaos) try to ruin the compliant tenant's day.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles import fleet, health, reactor, telemetry
+from veles.chaos import BrownoutProxy
+from veles.router import EJECTED, FleetController, RouterFrontend
+from veles.serving import tenants
+
+
+def wait_until(fn, timeout=15.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = fn()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.load(exc), dict(exc.headers)
+
+
+def _post(url, doc, headers=None, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.load(exc), dict(exc.headers)
+
+
+#: the table most tests install: one gold tenant, one metered silver
+#: tenant, one best-effort batch class; anon stays unmetered
+def _mk_table(**overrides):
+    doc = {
+        "default": "anon",
+        "slo": {"p99_ms": 500.0},
+        "tenants": {
+            "acme": {"priority": "gold"},
+            "hammer": {"rps": 5, "burst": 5, "priority": "silver"},
+            "bulk": {"rps": 100, "priority": "batch"},
+        },
+    }
+    doc.update(overrides)
+    return tenants.TenantTable.from_dict(doc)
+
+
+# -- shared tiny classifier artifact (hand-written, no training) -------
+
+
+@pytest.fixture(scope="module")
+def clf_archive(tmp_path_factory):
+    """A 4->4 dense archive built by hand — instant to load, prices a
+    real numpy forward through the full predict path."""
+    base = tmp_path_factory.mktemp("tenants")
+    numpy.save(base / "fc_weights.npy",
+               numpy.eye(4, dtype=numpy.float32))
+    (base / "contents.json").write_text(json.dumps({
+        "format": 1, "workflow": "clf", "input_sample_shape": [4],
+        "units": [{"type": "all2all", "name": "fc",
+                   "config": {"neurons": 4,
+                              "output_sample_shape": [4]},
+                   "weights": "fc_weights.npy", "bias": None}]}))
+    return str(base)
+
+
+def _mk_frontend(clf_archive, **registry_kw):
+    from veles.serving import ModelRegistry
+    from veles.serving.frontend import ServingFrontend
+    reg = ModelRegistry(backend="numpy", **registry_kw)
+    reg.load("clf", clf_archive)
+    front = ServingFrontend(reg, port=0)
+    return reg, front, "http://127.0.0.1:%d" % front.port
+
+
+# -- unit: table / quotas / resolver -----------------------------------
+
+
+def test_token_bucket_quota_and_retry_after():
+    q = tenants.TenantQuota("t", rps=10.0, burst=5.0)
+    now = 100.0
+    q._stamp = now                          # injectable test clock
+    for _ in range(5):                      # burst drains
+        ok, retry = q.admit(now)
+        assert ok and retry == 0.0
+    ok, retry = q.admit(now)
+    assert not ok
+    assert retry == pytest.approx(0.1)      # 1 token @ 10 rps
+    ok, _ = q.admit(now + 0.11)             # refilled one token
+    assert ok
+    ok, _ = q.admit(now + 0.11)
+    assert not ok
+    # burst is the refill ceiling, however long the idle gap
+    ok, retry = q.admit(now + 1000.0, cost=5.0)
+    assert ok
+    assert not q.admit(now + 1000.0)[0]
+    # unmetered tenant never says no
+    free = tenants.TenantQuota("free")
+    assert free.admit(0.0, cost=1e9) == (True, 0.0)
+
+
+def test_table_resolver_bounds_identity():
+    table = _mk_table()
+    assert table.resolve("acme") == "acme"
+    assert table.resolve(None) == "anon"
+    assert table.resolve("") == "anon"
+    # unknown keys — the internet — fold into ONE bucket
+    assert table.resolve("mallory'; drop table tenants;") == "other"
+    assert table.resolve("x" * 4096) == "other"
+    assert set(table.names()) == {"acme", "hammer", "bulk", "anon",
+                                  "other"}
+    # weights follow priority classes; best-effort = batch class only
+    assert table.weight("acme") == 4.0
+    assert table.weight("hammer") == 2.0
+    assert table.weight("bulk") == table.weight("other") == 1.0
+    assert table.best_effort("bulk")
+    assert not table.best_effort("acme")
+    doc = table.describe()
+    assert doc["default"] == "anon"
+    assert doc["tenants"]["anon"]["default"] is True
+    assert doc["tenants"]["hammer"]["rps"] == 5.0
+    assert doc["tenants"]["acme"]["rps"] is None    # unmetered
+    assert doc["tenants"]["acme"]["weight"] == 4.0
+
+
+def test_table_config_validation():
+    with pytest.raises(ValueError, match="unknown key"):
+        tenants.TenantTable.from_dict({"tenant": {}})   # typo'd key
+    with pytest.raises(ValueError, match="unknown priority"):
+        tenants.TenantTable.from_dict(
+            {"tenants": {"a": {"priority": "platinum"}}})
+    with pytest.raises(ValueError, match="rps must be"):
+        tenants.TenantTable.from_dict({"tenants": {"a": {"rps": 0}}})
+    with pytest.raises(ValueError, match="unknown key"):
+        tenants.TenantTable.from_dict(
+            {"tenants": {"a": {"qps": 5}}})
+    with pytest.raises(ValueError, match="JSON object"):
+        tenants.TenantTable.from_dict([])
+    # no table installed -> every tenant weighs 1 (FIFO-equivalent)
+    assert tenants.get_table() is None
+    assert tenants.weight("whoever") == 1.0
+
+
+# -- unit: weighted-fair micro-batcher ---------------------------------
+
+
+def test_micro_batcher_weighted_fair_order():
+    """With the dispatch loop held open, a gold tenant submitted LAST
+    is served before the bronze backlog (virtual finish times), and
+    each tenant's own requests keep FIFO order."""
+    from veles.serving import MicroBatcher
+    tenants.set_table(tenants.TenantTable.from_dict({"tenants": {
+        "gold": {"priority": "gold"},
+        "plain": {"priority": "bronze"}}}))
+    order = []
+    started = threading.Event()
+    release = threading.Event()
+    first = {"seen": False}
+
+    def run_batch(rows):
+        if not first["seen"]:
+            first["seen"] = True
+            started.set()
+            release.wait(30)
+        else:
+            order.append(int(rows[0, 0]))
+        return rows, rows.shape[0]
+
+    b = MicroBatcher(run_batch, max_batch=1, max_wait_ms=1.0)
+    results = []
+
+    def client(i, tenant):
+        results.append(b.predict(
+            numpy.full((1, 4), float(i), numpy.float32),
+            tenant=tenant))
+
+    try:
+        blocker = threading.Thread(target=client, args=(0, None))
+        blocker.start()
+        started.wait(30)
+        threads = []
+        for i, tenant in ((1, "plain"), (2, "plain"), (3, "plain"),
+                          (4, "gold")):
+            t = threading.Thread(target=client, args=(i, tenant))
+            t.start()
+            threads.append(t)
+            wait_until(lambda n=i: b._queued_rows >= n,
+                       what="request %d queued" % i)
+        release.set()
+        blocker.join(30)
+        for t in threads:
+            t.join(30)
+        # gold's vft = 1/4 jumps the bronze backlog (1, 2, 3) even
+        # though it arrived last; bronze stays FIFO among itself
+        assert order == [4, 1, 2, 3]
+        assert len(results) == 5
+    finally:
+        b.close()
+
+
+# -- unit: weighted-fair continuous batcher (real decode plane) --------
+
+
+def test_continuous_batcher_fair_grant_no_starvation(tmp_path):
+    """One KV slot, a queued bronze backlog, a gold request arriving
+    last: the freed slot goes to gold first, and EVERY queued request
+    still completes (zero cross-tenant starvation)."""
+    from test_decode import _export_lm
+    from veles.serving import (ArchiveModel, ContinuousBatcher,
+                               GenerativeEngine)
+    tenants.set_table(tenants.TenantTable.from_dict({"tenants": {
+        "gold": {"priority": "gold"},
+        "plain": {"priority": "bronze"}}}))
+    _, archive = _export_lm(tmp_path, "TenantLM")
+    engine = GenerativeEngine(ArchiveModel.from_dir(archive),
+                              n_slots=1, max_len=64)
+    batcher = ContinuousBatcher(engine, max_queue=8, model="lm")
+    done_order = []
+    lock = threading.Lock()
+    try:
+        blocker = batcher.submit([1, 2, 3], max_tokens=24,
+                                 tenant="plain")
+        wait_until(lambda: len(blocker.tokens) >= 2,
+                   what="blocker decoding")
+        handles = [
+            ("plain-1", batcher.submit([1, 2], max_tokens=4,
+                                       tenant="plain")),
+            ("plain-2", batcher.submit([2, 3], max_tokens=4,
+                                       tenant="plain")),
+            ("gold", batcher.submit([3, 4], max_tokens=4,
+                                    tenant="gold")),
+        ]
+
+        def waiter(name, handle):
+            handle.wait(120)
+            with lock:
+                done_order.append(name)
+
+        threads = [threading.Thread(target=waiter, args=(n, h))
+                   for n, h in handles]
+        for t in threads:
+            t.start()
+        assert blocker.wait(120)
+        for t in threads:
+            t.join(120)
+        # all three completed (no starvation), gold first: its
+        # virtual finish time (cost/4) undercuts the bronze backlog
+        assert sorted(done_order) == ["gold", "plain-1", "plain-2"]
+        assert done_order[0] == "gold"
+        assert engine.pool.in_use == 0
+        # token attribution rode along
+        reg = telemetry.get_registry()
+        assert reg.counter_total("veles_serving_tenant_tokens_total",
+                                 tenant="gold") >= 4
+        assert reg.counter_total("veles_serving_tenant_tokens_total",
+                                 tenant="plain") >= 24
+    finally:
+        batcher.close()
+
+
+# -- HTTP: quotas, /debug/tenants, bounded tenant series ---------------
+
+
+def test_http_quota_429_debug_doc_and_bounded_series(clf_archive):
+    reg = front = None
+    try:
+        reg, front, base = _mk_frontend(clf_archive)
+        # no table installed: /debug/tenants says so, traffic flows
+        code, doc, _ = _get(base + "/debug/tenants")
+        assert code == 404 and "tenants" in doc["error"]
+        tenants.set_table(_mk_table())
+        body = {"model": "clf", "inputs": [[1.0, 2.0, 3.0, 4.0]]}
+
+        # gold tenant: unmetered, all 200
+        for _ in range(8):
+            code, doc, _ = _post(base + "/v1/predict", body,
+                                 headers={"x-veles-tenant": "acme"})
+            assert code == 200
+        # metered tenant: burst of 5, then 429 + honest Retry-After
+        # (the loop may straddle a refill instant, so allow 5-6 hits)
+        answers = [_post(base + "/v1/predict", body,
+                         headers={"x-veles-tenant": "hammer"})
+                   for _ in range(8)]
+        codes = [c for c, _, _ in answers]
+        n_429 = codes.count(429)
+        assert codes.count(200) in (5, 6)
+        assert n_429 >= 2 and codes.count(200) + n_429 == 8
+        rejected = next(a for a in answers if a[0] == 429)
+        assert "quota" in rejected[1]["error"]
+        assert rejected[1]["retry_after_s"] > 0
+        assert float(rejected[2]["Retry-After"]) > 0
+        # unknown keys fold into ONE bucket — the internet cannot
+        # mint series
+        for key in ("mallory-1", "mallory-2", "mallory-3"):
+            code, _, _ = _post(base + "/v1/predict", body,
+                               headers={"x-veles-tenant": key})
+            assert code == 200
+
+        # /debug/tenants: live bucket levels, cached-doc cheap
+        code, doc, _ = _get(base + "/debug/tenants")
+        assert code == 200
+        assert doc["tenants"]["hammer"]["tokens"] < 5
+        assert doc["tenants"]["acme"]["priority"] == "gold"
+
+        # the scrape surface: tenant-labelled series with BOUNDED
+        # cardinality (configured names + anon + other, nothing else)
+        metrics = fleet.parse_prometheus(
+            telemetry.get_registry().render_prometheus())
+        table = tenants.get_table()
+        for name in ("veles_serving_tenant_requests_total",
+                     "veles_serving_rejected_total",
+                     "veles_serving_tenant_latency_seconds_count"):
+            seen = {dict(items)["tenant"]
+                    for (n, items) in metrics
+                    if n == name and "tenant" in dict(items)}
+            assert seen, name
+            assert seen <= set(table.names()), name
+        reg_t = telemetry.get_registry()
+        assert reg_t.counter_total(
+            "veles_serving_tenant_requests_total",
+            tenant="other") == 3
+        assert reg_t.counter_total(
+            "veles_serving_rejected_total",
+            reason="quota", tenant="hammer") == n_429
+
+        # scrape_target folds the tenant families into the top row...
+        row = fleet.scrape_target(base, timeout=5.0)
+        by_tenant = row["metrics"]["tenants"]
+        assert by_tenant["hammer"]["requests"] == 8
+        assert by_tenant["hammer"]["rejected"] == n_429
+        assert by_tenant["other"]["requests"] == 3
+        # ... and velescli top renders the per-tenant line
+        rendered = fleet.render_snapshot(fleet.fleet_snapshot([base]))
+        assert "tenants " in rendered
+        assert "hammer: req 8" in rendered
+        assert "shed 3" in rendered
+    finally:
+        if front is not None:
+            front.close()
+        if reg is not None:
+            reg.close()
+
+
+def test_top_degrades_silently_on_pre_tenant_target():
+    """A probe-only (pre-PR-18) target exports no tenant families:
+    the scrape row must carry no 'tenants' key and the rendered top
+    view no tenants line — not an error row."""
+    def route(request):
+        if request.path.startswith("/healthz"):
+            request.reply_json(200, {"status": "ok"})
+        elif request.path.startswith("/readyz"):
+            request.reply_json(200, {"ready": True, "reasons": [],
+                                     "checks": {}, "slos": {}})
+        elif request.path.startswith("/metrics"):
+            request.reply(200, b'veles_serving_queue_rows{model="m"}'
+                          b' 0\n', "text/plain")
+        else:
+            request.reply_json(404, {"error": "nope"})
+
+    server = reactor.HttpServer("127.0.0.1", 0, route, name="pre18")
+    url = "http://127.0.0.1:%d" % server.port
+    try:
+        row = fleet.scrape_target(url, timeout=5.0)
+        assert row["ready"] is True
+        assert "tenants" not in row["metrics"]
+        rendered = fleet.render_snapshot(fleet.fleet_snapshot([url]))
+        assert "tenants " not in rendered
+        assert "error" not in rendered.lower()
+    finally:
+        server.close()
+
+
+def test_best_effort_tenant_sheds_first_under_pressure(clf_archive):
+    """While the shedding check fires (excluded for everyone else),
+    batch-class traffic is refused 503 BEFORE any compute."""
+    reg = front = None
+    try:
+        reg, front, base = _mk_frontend(clf_archive)
+        tenants.set_table(_mk_table())
+        monitor = health.get_monitor()
+        monitor.add_check("serving:99:shedding",
+                          lambda: (False, "shed ratio 0.9"))
+        monitor.tick()
+        body = {"model": "clf", "inputs": [[0.0, 0.0, 0.0, 0.0]]}
+        code, doc, _ = _post(base + "/v1/predict", body,
+                             headers={"x-veles-tenant": "bulk"})
+        assert code == 503 and "best-effort" in doc["error"]
+        # a paying tenant still rides through the excluded check
+        code, _, _ = _post(base + "/v1/predict", body,
+                           headers={"x-veles-tenant": "acme"})
+        assert code == 200
+        assert telemetry.get_registry().counter_total(
+            "veles_serving_rejected_total",
+            reason="priority", tenant="bulk") == 1
+    finally:
+        if front is not None:
+            front.close()
+        if reg is not None:
+            reg.close()
+
+
+# -- per-tenant SLOs ----------------------------------------------------
+
+
+def test_tenant_p99_slo_template_fires_on_breach():
+    table = _mk_table()
+    monitor = health.get_monitor()
+    names = table.install_slos(monitor)
+    assert "tenant_p99:acme" in names
+    assert len(names) == len(table.names())
+    hist = telemetry.histogram(
+        "veles_serving_tenant_latency_seconds",
+        "per-tenant serving latency", labels=("tenant",))
+    # acme breaches its 500ms objective on every sample; hammer stays
+    # comfortably inside it
+    for _ in range(20):
+        hist.labels("acme").observe(2.0)
+        hist.labels("hammer").observe(0.005)
+    now = time.time()
+    monitor.tick(now=now)
+    monitor.tick(now=now + 1.0)
+    by_name = {slo.name: slo for slo in monitor.slos()}
+    assert by_name["tenant_p99:acme"].firing
+    assert not by_name["tenant_p99:hammer"].firing
+    ready, reasons = monitor.ready_state()
+    assert not ready
+    assert any("tenant_p99:acme" in r for r in reasons)
+
+
+# -- router: latency-aware policy, tenant attribution ------------------
+
+
+def _row(url, p99=None, queue=0.0):
+    metrics = {"serving_queue_rows": queue}
+    if p99 is not None:
+        metrics["serving_p99_s"] = p99
+    return {"url": url, "reachable": True, "ready": True,
+            "firing": [], "reasons": [], "metrics": metrics}
+
+
+def test_router_latency_policy_selection():
+    a, b = "http://a:1", "http://b:1"
+    with pytest.raises(ValueError, match="routing"):
+        FleetController([a], routing_policy="fastest")
+    c = FleetController([a, b], interval=999.0,
+                        routing_policy="latency")
+    try:
+        # scrape plumbing: p99 rides the row into the replica state
+        c.tick(rows=[_row(a, p99=0.5), _row(b, p99=0.01)])
+        assert c._replicas[a].p99_s == 0.5
+        assert c._replicas[a].describe()["p99_s"] == 0.5
+        assert c.select().url == b          # faster replica wins
+        # queue pressure prices in: fast-but-deep loses to
+        # slower-but-idle
+        c.tick(rows=[_row(a, p99=0.05), _row(b, p99=0.01, queue=10)])
+        assert c.select().url == a
+        # a replica with UNKNOWN p99 (pre-18, or no traffic yet)
+        # prices at the fleet median — neither magnet nor pariah
+        c.tick(rows=[_row(a), _row(b, p99=0.02)])
+        assert c._replicas[a].p99_s is None
+        assert c.select().url == a          # tie -> url order
+        # nobody scraped a p99 yet -> least-queue fallback
+        c.tick(rows=[_row(a, queue=3.0), _row(b, queue=0.0)])
+        assert c.select().url == b
+    finally:
+        c.close()
+
+
+def test_fleet_histogram_quantile():
+    text = "\n".join([
+        'veles_x_seconds_bucket{le="0.1"} 50',
+        'veles_x_seconds_bucket{le="0.5"} 90',
+        'veles_x_seconds_bucket{le="+Inf"} 100',
+        'veles_x_seconds_count 100',
+    ]) + "\n"
+    metrics = fleet.parse_prometheus(text)
+    # p50 interpolates inside the first bucket; p99 lands in +Inf ->
+    # clamped to the last finite bound
+    assert fleet.histogram_quantile(metrics, "veles_x_seconds", 0.5) \
+        == pytest.approx(0.1)
+    assert fleet.histogram_quantile(metrics, "veles_x_seconds", 0.95) \
+        == pytest.approx(0.5)
+    assert fleet.histogram_quantile(metrics, "veles_x_seconds", 0.99) \
+        == pytest.approx(0.5)
+    assert fleet.histogram_quantile(metrics, "veles_nope", 0.5) is None
+
+
+# -- chaos: abusive tenant + browned-out replica -----------------------
+
+
+def test_chaos_abusive_tenant_and_brownout(clf_archive):
+    """The ISSUE 18 chaos scenario: one tenant floods at 10x its
+    quota while one of two replicas browns out. The compliant
+    tenant's requests all answer 200 through the healthy replica, its
+    per-tenant p99 SLO stays quiet, and the abusive tenant's quota
+    shed counter climbs."""
+    table = tenants.set_table(_mk_table())
+    monitor = health.get_monitor()
+    table.install_slos(monitor)
+    reg_a = front_a = reg_b = front_b = None
+    proxy = controller = router = None
+    try:
+        reg_a, front_a, base_a = _mk_frontend(clf_archive)
+        reg_b, front_b, base_b = _mk_frontend(clf_archive)
+        proxy = BrownoutProxy(("127.0.0.1", front_a.port))
+        controller = FleetController([proxy.url, base_b],
+                                     interval=0.3, scrape_timeout=0.5)
+        router = RouterFrontend(controller, port=0)
+        rbase = router.url
+        wait_until(lambda: _get(rbase + "/router/status")[1][
+            "admitted"] == 2, what="both replicas admitted")
+        # chaos on: replica A's pipe crawls; the control loop ejects
+        # it on scrape timeout, traffic drains to B
+        proxy.brownout(2.0)
+        wait_until(lambda: any(
+            bk["state"] == EJECTED and bk["url"] == proxy.url
+            for bk in _get(rbase + "/router/status")[1]["backends"]),
+            what="brownout ejection")
+
+        body = {"model": "clf", "inputs": [[1.0, 0.0, 0.0, 0.0]]}
+        abusive_codes = []
+
+        abusive_retry_after = []
+
+        def abuse():
+            # 10x the 5 rps quota, no pacing: the bucket must dry up
+            for _ in range(50):
+                code, _, hdrs = _post(rbase + "/v1/predict", body,
+                                      headers={"x-veles-tenant":
+                                               "hammer"})
+                abusive_codes.append(code)
+                if code == 429:
+                    abusive_retry_after.append(
+                        hdrs.get("Retry-After"))
+
+        abuser = threading.Thread(target=abuse)
+        abuser.start()
+        compliant_codes = []
+        for _ in range(30):
+            code, _, _ = _post(rbase + "/v1/predict", body,
+                               headers={"x-veles-tenant": "acme"})
+            compliant_codes.append(code)
+            time.sleep(0.005)
+        abuser.join(60)
+
+        # zero starvation: every compliant request answered 200
+        assert compliant_codes == [200] * 30
+        # the abusive tenant hit the wall: 429s, counted per-tenant,
+        # each carrying the replica bucket's Retry-After THROUGH the
+        # router hop (the generic forward path must not drop it)
+        assert abusive_codes.count(429) >= 20
+        assert abusive_retry_after and all(
+            ra is not None and float(ra) > 0
+            for ra in abusive_retry_after)
+        reg = telemetry.get_registry()
+        shed = reg.counter_total("veles_serving_rejected_total",
+                                 reason="quota", tenant="hammer")
+        assert shed == abusive_codes.count(429)
+        assert reg.counter_total("veles_serving_rejected_total",
+                                 tenant="acme") == 0
+        # router attribution saw both tenants
+        assert reg.counter_total("veles_router_requests_total",
+                                 tenant="acme") == 30
+        # the compliant tenant's p99 SLO exists AND is not firing
+        monitor.tick()
+        by_name = {slo.name: slo for slo in monitor.slos()}
+        assert "tenant_p99:acme" in by_name
+        assert not by_name["tenant_p99:acme"].firing
+    finally:
+        for closable in (router, controller, proxy, front_a, front_b,
+                         reg_a, reg_b):
+            if closable is not None:
+                closable.close()
+
+
+# -- loadgen: the open-loop proof harness ------------------------------
+
+
+def test_loadgen_parse_and_mix():
+    from veles import loadgen
+    with pytest.raises(SystemExit):
+        loadgen._parse_tenants([":0.5"])
+    with pytest.raises(SystemExit):
+        loadgen._parse_tenants(["a:lots"])
+    mix = loadgen._TenantMix(loadgen._parse_tenants(
+        ["acme:3", "free"]))
+    assert mix.names == ["acme", "free"]
+    import random
+    rng = random.Random(7)
+    picks = [mix.pick(rng) for _ in range(2000)]
+    share = picks.count("acme") / len(picks)
+    assert 0.70 <= share <= 0.80            # 3:1 mix, seeded draw
+    # open-loop percentile helper
+    assert loadgen._percentile([], 0.99) is None
+    assert loadgen._percentile([1.0, 2.0, 3.0, 4.0], 0.5) == 2.0
+
+
+def test_loadgen_e2e_routed_fleet(clf_archive, capsys):
+    """The acceptance run: loadgen drives a tenant mix at a REAL
+    routed 2-replica fleet and reports per-tenant curves plus the
+    routed_capacity_rps_at_p99_slo row."""
+    from veles.loadgen import loadgen_main
+    tenants.set_table(_mk_table())
+    reg_a = front_a = reg_b = front_b = None
+    controller = router = None
+    try:
+        reg_a, front_a, base_a = _mk_frontend(clf_archive)
+        reg_b, front_b, base_b = _mk_frontend(clf_archive)
+        controller = FleetController([base_a, base_b], interval=0.3,
+                                     scrape_timeout=1.0,
+                                     routing_policy="latency")
+        router = RouterFrontend(controller, port=0)
+        wait_until(lambda: _get(router.url + "/router/status")[1][
+            "admitted"] == 2, what="both replicas admitted")
+        rc = loadgen_main([
+            router.url, "--tenant", "acme:3", "--tenant", "free",
+            "--rps", "10", "--rps", "25", "--duration", "1.2",
+            "--p99-slo-ms", "2000", "--seed", "99", "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["metric"] == "routed_capacity_rps_at_p99_slo"
+        # the tiny fleet holds both offered stages inside a 2s p99
+        assert report["value"] == 25.0
+        assert report["extra"]["compliant_tenant"] == "acme"
+        stages = report["extra"]["stages"]
+        assert [s["offered_rps"] for s in stages] == [10.0, 25.0]
+        for stage in stages:
+            for name in ("acme", "free"):
+                t = stage["tenants"][name]
+                assert t["offered"] > 0
+                assert t["ok"] + t["shed"] + t["errors"] \
+                    == t["offered"]
+                assert t["errors"] == 0
+                assert t["p99_ms"] is not None
+        # open-loop accounting: offered tracks rate x duration, and
+        # the tenant mix roughly honored its 3:1 shares
+        s = stages[1]["tenants"]
+        total = s["acme"]["offered"] + s["free"]["offered"]
+        assert total >= 15                  # 25 rps x 1.2 s, jittered
+        assert s["acme"]["offered"] > s["free"]["offered"]
+        # both replicas actually served routed traffic
+        reg = telemetry.get_registry()
+        for url in (base_a, base_b):
+            assert reg.counter_total("veles_router_requests_total",
+                                     replica=url, outcome="ok") > 0
+    finally:
+        for closable in (router, controller, front_a, front_b,
+                         reg_a, reg_b):
+            if closable is not None:
+                closable.close()
+
+
+def test_loadgen_cli_parsers():
+    from veles.loadgen import build_loadgen_argparser
+    args = build_loadgen_argparser().parse_args(
+        ["http://x:1", "--tenant", "a:2", "--rps", "5", "--json"])
+    assert args.target == "http://x:1"
+    assert args.tenant == ["a:2"] and args.rps == [5.0]
+    # the serve/route CLIs grew their QoS knobs
+    from veles.router import build_route_argparser
+    ra = build_route_argparser().parse_args(
+        ["http://a:1", "--routing-policy", "latency",
+         "--tenants", "/tmp/t.json"])
+    assert ra.routing_policy == "latency"
+    assert ra.tenants == "/tmp/t.json"
+    from veles.serving.frontend import build_serve_argparser
+    sa = build_serve_argparser().parse_args(
+        ["--model", "m=/x", "--tenants", "/tmp/t.json"])
+    assert sa.tenants == "/tmp/t.json"

@@ -879,6 +879,12 @@ def main(argv=None):
         # sampling-profiler capture off a live process's
         # /debug/profile surface (veles/profiling.py)
         return profile_main(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # open-loop tenant-mix load generator (veles/loadgen.py):
+        # per-tenant goodput/p99/shed curves + the
+        # routed_capacity_rps_at_p99_slo bench row
+        from veles.loadgen import loadgen_main
+        return loadgen_main(argv[1:])
     m = Main(argv)
     if getattr(m.args, "background", False):
         if not daemonize(m.args.log_file):

@@ -20,6 +20,14 @@ runtime:
   unbounded name universe in any aggregating backend. The identity
   belongs in the span's ``args`` (``span("job.serve",
   job_id=job_id)``), where it is per-event payload, not cardinality.
+* **label values taken from the wire** (ISSUE 18) — a ``.labels(...)``
+  argument that reads ``request.headers``/``request.body`` hands the
+  INTERNET the keys of your series dict: every novel header value is
+  a new child that lives forever. Caller attribution must pass
+  through a bounded resolver first (``TenantTable.resolve`` maps
+  unknown keys to one ``other`` bucket — veles/serving/tenants.py);
+  a ``*resolve*``-named call wrapping the whole argument is the
+  recognized escape hatch.
 """
 
 import ast
@@ -120,6 +128,42 @@ def _identity_labelled(node):
                list(node.args) + [kw.value for kw in node.keywords])
 
 
+#: attribute names that read caller-controlled bytes off the wire
+_WIRE_SOURCES = ("headers", "body")
+
+
+def _resolver_wrapped(node):
+    """True when the whole expression is a call to a ``*resolve*``
+    function — the bounded escape hatch (``table.resolve(...)``,
+    ``tenants.resolve(...)``, ``_resolve_tenant(...)``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    fname = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    return "resolve" in fname.lower()
+
+
+def _wire_derived(node):
+    """True when the expression reads ``*.headers``/``*.body``
+    anywhere inside — ``request.headers.get("x-veles-tenant")``,
+    ``req.headers["x-api-key"]``, ``request.body`` — i.e. the value
+    universe is whatever callers choose to send."""
+    if _resolver_wrapped(node):
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _WIRE_SOURCES:
+            return True
+    return False
+
+
+def _wire_labelled(node):
+    """True when a ``.labels(...)`` call passes a header/body-derived
+    value without the resolver escape."""
+    return any(_wire_derived(arg) for arg in
+               list(node.args) + [kw.value for kw in node.keywords])
+
+
 def _is_span_call(node):
     """``*.span(name, ...)`` / ``*.add_complete(name, ...)`` calls on
     a telemetry/tracer-shaped receiver — ``telemetry.span(...)``,
@@ -185,17 +229,29 @@ def check_telemetry_hygiene(project):
                         "a telemetry.LazyChild at the call site"))
             if isinstance(node.func, ast.Attribute) \
                     and node.func.attr == "labels" \
-                    and (node.args or node.keywords) \
-                    and _identity_labelled(node):
-                findings.append(Finding(
-                    mod.relpath, node.lineno, "telemetry-hygiene",
-                    "error",
-                    "label value minted from an identity (id/uuid/"
-                    "token/pid) — every value is a new series that "
-                    "lives forever",
-                    "label by a bounded dimension (kind, model, "
-                    "unit name); aggregate identities before "
-                    "labelling or bound them with TTL eviction"))
+                    and (node.args or node.keywords):
+                if _identity_labelled(node):
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, "telemetry-hygiene",
+                        "error",
+                        "label value minted from an identity (id/uuid/"
+                        "token/pid) — every value is a new series that "
+                        "lives forever",
+                        "label by a bounded dimension (kind, model, "
+                        "unit name); aggregate identities before "
+                        "labelling or bound them with TTL eviction"))
+                if _wire_labelled(node):
+                    findings.append(Finding(
+                        mod.relpath, node.lineno, "telemetry-hygiene",
+                        "error",
+                        "label value read from request headers/body "
+                        "without a bounded resolver — callers mint "
+                        "series at will (unbounded cardinality from "
+                        "the wire)",
+                        "pass the raw value through a bounded "
+                        "resolver first, e.g. "
+                        "tenants.TenantTable.resolve(...), which "
+                        "folds unknown keys into one 'other' bucket"))
             if _is_span_call(node) \
                     and _formatted_identity(node.args[0]):
                 findings.append(Finding(

@@ -96,6 +96,61 @@ def metric_max(metrics, name, **match):
     return best
 
 
+def metric_by_label(metrics, name, label):
+    """``{label_value: sum}`` over ``name``'s children grouped by one
+    label, or None when the family is absent. Children WITHOUT the
+    label (an old exposition predating it) contribute nothing — the
+    caller sees an empty dict, not fabricated zeros."""
+    out, hit = {}, False
+    for (n, items), v in metrics.items():
+        if n != name:
+            continue
+        hit = True
+        value = dict(items).get(label)
+        if value is not None:
+            out[value] = out.get(value, 0.0) + v
+    return out if hit else None
+
+
+def histogram_quantile(metrics, name, q, **match):
+    """PromQL-style quantile over ``name``'s cumulative ``_bucket``
+    samples (summed across matching children), with linear
+    interpolation inside the winning bucket; -> seconds, or None
+    when the histogram is absent or empty (a pre-traffic replica
+    must read as 'unknown', never 'instant')."""
+    want = {(k, str(v)) for k, v in match.items()}
+    buckets = {}
+    for (n, items), v in metrics.items():
+        if n != name + "_bucket":
+            continue
+        d = dict(items)
+        le = d.pop("le", None)
+        if le is None or not want <= set(d.items()):
+            continue
+        try:
+            bound = (float("inf") if le == "+Inf" else float(le))
+        except ValueError:
+            continue
+        buckets[bound] = buckets.get(bound, 0.0) + v
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for b in bounds:
+        cum = buckets[b]
+        if cum >= rank:
+            if b == float("inf") or cum == prev_cum:
+                return prev_bound if b == float("inf") else b
+            return prev_bound + (b - prev_bound) \
+                * (rank - prev_cum) / (cum - prev_cum)
+        prev_bound, prev_cum = b, cum
+    return prev_bound
+
+
 def _fetch(url, timeout):
     """(status_code, body_bytes) — HTTP error codes are ANSWERS here
     (a 503 /readyz carries the reason payload), only transport
@@ -240,6 +295,28 @@ def scrape_target(base, timeout=5.0, total=None, extras=True):
                       "veles_serving_checkpoint_wall_seconds")
     if wall is not None:
         summary["serving_ckpt_wall"] = wall
+    # per-request serving p99 out of the Prometheus histogram buckets
+    # (ISSUE 18): what the router's latency routing policy weighs —
+    # absent (None) on pre-traffic or pre-histogram targets
+    p99 = histogram_quantile(metrics,
+                             "veles_serving_latency_seconds", 0.99)
+    if p99 is not None:
+        summary["serving_p99_s"] = round(p99, 6)
+    # per-tenant attribution (ISSUE 18): requests/rejections on a
+    # serving replica, routed requests on a router — families (or
+    # their tenant label) absent on pre-PR-18 targets, which must
+    # only degrade the row
+    by_tenant = {}
+    for key, name in (("requests",
+                       "veles_serving_tenant_requests_total"),
+                      ("rejected", "veles_serving_rejected_total"),
+                      ("tokens", "veles_serving_tenant_tokens_total"),
+                      ("routed", "veles_router_requests_total")):
+        grouped = metric_by_label(metrics, name, "tenant")
+        for tenant, v in (grouped or {}).items():
+            by_tenant.setdefault(tenant, {})[key] = v
+    if by_tenant:
+        summary["tenants"] = by_tenant
     row["metrics"] = summary
     if not extras:
         # control-loop scrapes target serving replicas: skip the
@@ -522,6 +599,29 @@ def render_snapshot(snap):
                 bits.append("rollbacks %d" % model["rollbacks"])
             bits.append("verdict %s" % model.get("verdict", "?"))
             detail.append("model: " + ", ".join(bits))
+        # per-tenant goodput/shed columns (ISSUE 18): one line per
+        # target naming each resolved tenant's request/routed/shed
+        # counts — absent on pre-PR-18 targets, which must only
+        # degrade the row
+        by_tenant = row.get("metrics", {}).get("tenants")
+        if isinstance(by_tenant, dict):
+            parts = []
+            for tenant, d in sorted(by_tenant.items()):
+                if not isinstance(d, dict):
+                    continue
+                bits = []
+                if d.get("requests") is not None:
+                    bits.append("req %d" % d["requests"])
+                if d.get("routed") is not None:
+                    bits.append("routed %d" % d["routed"])
+                if d.get("tokens"):
+                    bits.append("tok %d" % d["tokens"])
+                if d.get("rejected"):
+                    bits.append("shed %d" % d["rejected"])
+                if bits:
+                    parts.append("%s: %s" % (tenant, " ".join(bits)))
+            if parts:
+                detail.append("tenants " + " | ".join(parts))
         # host RSS and reactor lag side by side (ISSUE 10): one glance
         # gives "how much memory, how healthy the loop" per target —
         # either may be absent (pre-PR-9/10 process) without a row

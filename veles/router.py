@@ -80,6 +80,7 @@ from urllib.parse import urlsplit
 
 from veles import fleet, health, reactor, telemetry
 from veles.logger import Logger
+from veles.serving import tenants
 
 #: replica lifecycle states (strings: they land in /router/status)
 ADMITTED = "admitted"
@@ -96,10 +97,31 @@ RETRY_AFTER_NO_BACKEND = 5
 #: to rebuild on membership change
 RING_POINTS = 64
 
+#: routing policies (``--routing-policy``): classic least-queue, or
+#: latency-aware — weight each admitted backend's scraped serving p99
+#: by its current load so a slow replica (brownout, noisy neighbour)
+#: sheds share BEFORE it trips an SLO ejection (PR-13 stretch,
+#: shipped in ISSUE 18). Backends that predate the p99 scrape fall
+#: back to the fleet median, degrading to least-queue behaviour.
+ROUTING_POLICIES = ("least-queue", "latency")
+
 _C_REQUESTS = telemetry.LazyChild(lambda: telemetry.counter(
     "veles_router_requests_total",
-    "Requests proxied through the router, by chosen replica and "
-    "outcome", ("replica", "outcome")))
+    "Requests proxied through the router, by chosen replica, "
+    "resolved tenant and outcome",
+    ("replica", "tenant", "outcome")))
+
+
+def _resolve_tenant(request):
+    """Bounded tenant label for one routed request: the installed
+    tenant table's resolver output, or the default tenant with no
+    table — raw ``x-veles-tenant`` values never reach a label (zlint
+    telemetry-hygiene). The RAW header is still forwarded upstream:
+    the replica's own resolution is authoritative."""
+    table = tenants.get_table()
+    if table is None:
+        return tenants.DEFAULT_TENANT
+    return table.resolve(request.headers.get("x-veles-tenant"))
 _C_EJECT = telemetry.LazyChild(lambda: telemetry.counter(
     "veles_router_ejections_total",
     "Replicas ejected from the routable set, by reason",
@@ -169,7 +191,7 @@ class Replica:
                  "trial_inflight", "queue_rows", "kv_in_use",
                  "kv_slots", "firing", "reachable", "ready",
                  "requests", "errors", "launched", "ckpt_wall",
-                 "staleness")
+                 "staleness", "p99_s")
 
     def __init__(self, url, launched=False):
         self.url = url
@@ -189,6 +211,7 @@ class Replica:
         self.launched = launched     # autoscaler-owned (stoppable)
         self.ckpt_wall = None        # None = pre-continual replica
         self.staleness = None
+        self.p99_s = None            # None = p99 never scraped
 
     def describe(self):
         return {"url": self.url, "state": self.state,
@@ -202,7 +225,8 @@ class Replica:
                 "errors_total": self.errors,
                 "launched": self.launched,
                 "ckpt_wall": self.ckpt_wall,
-                "staleness": self.staleness}
+                "staleness": self.staleness,
+                "p99_s": self.p99_s}
 
 
 class FleetController(Logger):
@@ -213,10 +237,16 @@ class FleetController(Logger):
 
     def __init__(self, targets, interval=1.0, scrape_timeout=2.0,
                  eject_failures=3, slo_eject=True, autoscaler=None,
-                 full_scrape=False, refresher=None):
+                 full_scrape=False, refresher=None,
+                 routing_policy="least-queue"):
         self.name = "router-fleet"
         self.interval = float(interval)
         self.scrape_timeout = float(scrape_timeout)
+        if routing_policy not in ROUTING_POLICIES:
+            raise ValueError("routing_policy %r not one of %s"
+                             % (routing_policy,
+                                ", ".join(ROUTING_POLICIES)))
+        self.routing_policy = routing_policy
         self.eject_failures = int(eject_failures)
         self.slo_eject = bool(slo_eject)
         self.autoscaler = autoscaler
@@ -400,6 +430,11 @@ class FleetController(Logger):
             r.ckpt_wall = float(wall) if wall else None
             stale = metrics.get("staleness_seconds")
             r.staleness = None if stale is None else float(stale)
+            # absent on pre-18 replicas (or before any traffic):
+            # keep None — the latency policy substitutes the fleet
+            # median instead of treating "unknown" as "instant"
+            p99 = metrics.get("serving_p99_s")
+            r.p99_s = None if p99 is None else float(p99)
         if not r.reachable:
             reason, category = (
                 "unreachable: %s" % row.get("error", "?"),
@@ -468,8 +503,10 @@ class FleetController(Logger):
 
         A HALF-OPEN replica with a free trial slot wins first (the
         probe must happen for re-admission); then consistent-hash
-        stickiness when the request carries a session key; then
-        least-queue (scraped queue depth + live inflight)."""
+        stickiness when the request carries a session key; then the
+        configured load policy — least-queue (scraped queue depth +
+        live inflight) or latency-aware (scraped serving p99
+        weighted by that same load; see :data:`ROUTING_POLICIES`)."""
         with self._lock:
             candidates = [r for r in self._replicas.values()
                           if r.url not in exclude]
@@ -485,6 +522,22 @@ class FleetController(Logger):
                     sticky_key, {r.url for r in admitted})
                 if url is not None:
                     return self._replicas[url]
+            if self.routing_policy == "latency":
+                known = sorted(r.p99_s for r in admitted
+                               if r.p99_s is not None)
+                if known:
+                    # expected wait ~ per-request p99 x (queued ahead
+                    # + 1); unknown p99 (pre-18 replica, no traffic
+                    # yet) prices at the fleet median — neither a
+                    # magnet nor a pariah
+                    med = known[len(known) // 2]
+                    return min(
+                        admitted,
+                        key=lambda r: (
+                            (r.p99_s if r.p99_s is not None else med)
+                            * (1.0 + r.queue_rows
+                               + 2.0 * r.inflight),
+                            r.url))
             return min(admitted,
                        key=lambda r: (r.queue_rows + 2.0 * r.inflight,
                                       r.url))
@@ -1160,6 +1213,7 @@ class RouterFrontend(Logger):
         -> (replica|None, http_code) for the span."""
         controller = self.controller
         sticky = self._sticky_key(request)
+        tenant = _resolve_tenant(request)
         tried = set()
         last_error = None
         for _ in range(max(len(controller.targets()), 1)):
@@ -1188,7 +1242,8 @@ class RouterFrontend(Logger):
                 outcome, code, retry = "error", 502, False
             finally:
                 controller.finish(replica)
-            _C_REQUESTS.get().labels(replica.url, outcome).inc()
+            _C_REQUESTS.get().labels(replica.url, tenant,
+                                     outcome).inc()
             if not retry:
                 return replica, code
             last_error = "%s -> %s" % (replica.url, outcome)
@@ -1200,7 +1255,7 @@ class RouterFrontend(Logger):
                  "retry_after_s": RETRY_AFTER_NO_BACKEND}
         if last_error:
             reply["last_error"] = last_error
-        _C_REQUESTS.get().labels("-", "no_backend").inc()
+        _C_REQUESTS.get().labels("-", tenant, "no_backend").inc()
         request.reply_json(
             503, reply,
             headers=tp_header + (("Retry-After",
@@ -1216,7 +1271,10 @@ class RouterFrontend(Logger):
         host, port = _host_port(replica.url)
         headers = {"traceparent": hop.to_traceparent(),
                    "Connection": "close"}
-        for name in ("content-type", "accept", "x-veles-session"):
+        # x-veles-tenant rides the same hop as the traceparent: one
+        # trace_id + tenant pair crosses client -> router -> replica
+        for name in ("content-type", "accept", "x-veles-session",
+                     "x-veles-tenant"):
             value = request.headers.get(name)
             if value:
                 headers[name] = value
@@ -1268,10 +1326,17 @@ class RouterFrontend(Logger):
                 return ("ok" if stream_ok else "error"), code, False
             body = resp.read()
             self.controller.report_success(replica)
+            # a per-tenant 429 is the REPLICA's quota verdict: never
+            # a failover (another backend shares the same table), and
+            # its Retry-After — the bucket's exact refill time — must
+            # reach the caller
+            retry_after = resp.getheader("Retry-After")
+            extra = (("Retry-After", retry_after),) \
+                if retry_after else ()
             request.reply(
                 code, body,
                 resp.getheader("Content-Type") or "text/plain",
-                headers=tp_header)
+                headers=tp_header + extra)
             return ("ok" if code < 500 else "upstream_error"), \
                 code, False
         except (OSError, http.client.HTTPException) as exc:
@@ -1414,6 +1479,18 @@ def build_route_argparser():
                    help="JSON list of SLO objectives for the "
                         "router's own health monitor (e.g. on "
                         "veles_router_request_seconds:p99)")
+    p.add_argument("--routing-policy", default="least-queue",
+                   choices=ROUTING_POLICIES,
+                   help="backend selection: least-queue (default) "
+                        "or latency — scraped serving p99 weighted "
+                        "by live load (backends without a p99 price "
+                        "at the fleet median)")
+    p.add_argument("--tenants", default=None, metavar="PATH",
+                   help="tenant config (same JSON as serve "
+                        "--tenants): bounds the router's per-tenant "
+                        "request labels; the raw x-veles-tenant "
+                        "header is forwarded to the replica either "
+                        "way")
     return p
 
 
@@ -1453,12 +1530,15 @@ def route_main(argv=None):
         refresher = RollingRefresh(args.refresh_store,
                                    args.refresh_model,
                                    period_s=args.refresh_period)
+    if args.tenants:
+        tenants.set_table(tenants.TenantTable.from_file(args.tenants))
     controller = FleetController(
         args.backends, interval=args.interval,
         scrape_timeout=args.scrape_timeout,
         eject_failures=args.eject_failures,
         slo_eject=not args.no_slo_eject, autoscaler=autoscaler,
-        full_scrape=args.full_scrape, refresher=refresher)
+        full_scrape=args.full_scrape, refresher=refresher,
+        routing_policy=args.routing_policy)
     front = None
     try:
         front = RouterFrontend(controller, port=args.port,

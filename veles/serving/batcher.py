@@ -33,6 +33,7 @@ import time
 
 from veles import telemetry
 from veles.logger import Logger
+from veles.serving import tenants
 
 
 class QueueFull(Exception):
@@ -45,9 +46,9 @@ class DeadlineExceeded(Exception):
 
 class _Request:
     __slots__ = ("rows", "deadline", "t_enqueue", "t_perf", "event",
-                 "result", "error", "trace")
+                 "result", "error", "trace", "tenant", "vft")
 
-    def __init__(self, rows, deadline, trace=None):
+    def __init__(self, rows, deadline, trace=None, tenant=None):
         self.rows = rows
         self.deadline = deadline
         self.t_enqueue = time.monotonic()
@@ -59,6 +60,11 @@ class _Request:
         self.error = None
         #: veles.telemetry.TraceContext of the originating request
         self.trace = trace
+        #: resolved tenant (ISSUE 18) — the weighted-fair queue key
+        self.tenant = tenant
+        #: virtual finish tag (rows / tenant weight past the queue's
+        #: virtual time at enqueue) — dequeue order under fairness
+        self.vft = 0.0
 
 
 class MicroBatcher(Logger):
@@ -97,7 +103,15 @@ class MicroBatcher(Logger):
         self.default_timeout = float(default_timeout_ms) / 1000.0
         self._lock = threading.Lock()
         self._have_work = threading.Condition(self._lock)
-        self._queue = collections.deque()
+        # weighted-fair queuing (ISSUE 18): one FIFO per tenant
+        # (bounded — keys are resolver output), dequeued by least
+        # virtual-finish-tag so a burst from one tenant interleaves
+        # with, instead of preceding, everyone else's requests. With
+        # a single tenant (or no tenant table) every request lands in
+        # one deque and the order is exactly the pre-18 FIFO.
+        self._queues = {}              # tenant -> deque of _Request
+        self._vtime = 0.0              # queue-wide virtual time
+        self._vfinish = {}             # tenant -> last finish tag
         self._queued_rows = 0
         self._running = True
         # -- instruments: registry-backed (ISSUE 3), metrics() is the
@@ -126,18 +140,20 @@ class MicroBatcher(Logger):
 
     # -- client side ---------------------------------------------------
 
-    def submit(self, rows, timeout_ms=None, trace=None):
+    def submit(self, rows, timeout_ms=None, trace=None, tenant=None):
         """Enqueue ``rows`` (n, *sample); -> a wait()able handle.
         Raises :class:`QueueFull` when the queue is at capacity.
         ``trace`` tags the request's queue-wait span with the
-        caller's trace context."""
+        caller's trace context; ``tenant`` (resolver output) keys the
+        weighted-fair queue."""
         n = int(rows.shape[0])
         if n < 1 or n > self.max_batch:
             raise ValueError("request rows %d outside [1, %d]"
                              % (n, self.max_batch))
         timeout = (self.default_timeout if timeout_ms is None
                    else float(timeout_ms) / 1000.0)
-        req = _Request(rows, time.monotonic() + timeout, trace=trace)
+        req = _Request(rows, time.monotonic() + timeout, trace=trace,
+                       tenant=tenant)
         with self._lock:
             if not self._running:
                 raise RuntimeError("batcher is closed")
@@ -147,15 +163,22 @@ class MicroBatcher(Logger):
                     "queue full (%d rows pending, max %d)"
                     % (self._queued_rows, self.max_queue))
             self._c["requests_total"].get().inc()
-            self._queue.append(req)
+            start = max(self._vtime, self._vfinish.get(tenant, 0.0))
+            req.vft = start + n / tenants.weight(tenant)
+            self._vfinish[tenant] = req.vft
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = collections.deque()
+            q.append(req)
             self._queued_rows += n
             self._g_queue.get().set(self._queued_rows)
             self._have_work.notify()
         return req
 
-    def predict(self, rows, timeout_ms=None, trace=None):
+    def predict(self, rows, timeout_ms=None, trace=None, tenant=None):
         """submit + wait; raises DeadlineExceeded / the batch error."""
-        req = self.submit(rows, timeout_ms=timeout_ms, trace=trace)
+        req = self.submit(rows, timeout_ms=timeout_ms, trace=trace,
+                          tenant=tenant)
         req.event.wait(timeout=(req.deadline - time.monotonic())
                        + self.max_wait + 30.0)
         if req.error is not None:
@@ -166,25 +189,34 @@ class MicroBatcher(Logger):
 
     # -- worker --------------------------------------------------------
 
+    def _head_locked(self):
+        """The next request under weighted fairness: the least
+        virtual-finish-tag among the per-tenant FIFO heads (tag ties
+        broken by tenant name for determinism). Caller holds the
+        lock; at least one queue is non-empty."""
+        return min((q[0] for q in self._queues.values() if q),
+                   key=lambda r: (r.vft, r.tenant or ""))
+
     def _collect(self):
         """Wait for work, then drain up to ``max_batch`` rows — holding
         the batch open at most ``max_wait`` past the OLDEST request's
         arrival (late joiners don't extend the window)."""
         with self._lock:
-            while self._running and not self._queue:
+            while self._running and not self._queued_rows:
                 self._have_work.wait()
-            if not self._running and not self._queue:
+            if not self._running and not self._queued_rows:
                 return None
-            head = self._queue[0]
-            close_at = head.t_enqueue + self.max_wait
+            oldest = min(q[0].t_enqueue
+                         for q in self._queues.values() if q)
+            close_at = oldest + self.max_wait
             while self._running:
                 left = close_at - time.monotonic()
                 if self._queued_rows >= self.max_batch or left <= 0:
                     break
                 self._have_work.wait(timeout=left)
             batch, total = [], 0
-            while self._queue:
-                head = self._queue[0]
+            while self._queued_rows:
+                head = self._head_locked()
                 n = head.rows.shape[0]
                 if batch and total + n > self.max_batch:
                     break
@@ -195,7 +227,11 @@ class MicroBatcher(Logger):
                     # own batch: concatenating would fail the WHOLE
                     # dispatch and 500 innocent co-batched requests
                     break
-                req = self._queue.popleft()
+                q = self._queues[head.tenant]
+                req = q.popleft()
+                if not q:
+                    del self._queues[head.tenant]
+                self._vtime = max(self._vtime, req.vft)
                 self._queued_rows -= n
                 batch.append(req)
                 total += n
@@ -300,10 +336,12 @@ class MicroBatcher(Logger):
         # and its own in-flight batch is no longer in the queue, so
         # completed requests are never clobbered here
         with self._lock:
-            while self._queue:
-                req = self._queue.popleft()
-                req.error = RuntimeError("batcher closed")
-                req.event.set()
+            for q in self._queues.values():
+                while q:
+                    req = q.popleft()
+                    req.error = RuntimeError("batcher closed")
+                    req.event.set()
+            self._queues.clear()
             self._queued_rows = 0
             if zero_gauge:
                 self._g_queue.get().set(0)

@@ -58,8 +58,16 @@ import numpy
 
 from veles import telemetry
 from veles.logger import Logger
+from veles.serving import tenants
 from veles.serving.batcher import DeadlineExceeded, QueueFull
 from veles.serving.model import FORWARD_OPS
+
+#: decoded-token attribution by resolved tenant (ISSUE 18; bounded —
+#: values are tenant-resolver output only, zlint telemetry-hygiene)
+_T_TOKENS = telemetry.LazyChild(
+    lambda: telemetry.counter(
+        "veles_serving_tenant_tokens_total",
+        "Tokens decoded by resolved tenant", ("tenant",)))
 
 #: unit types that are sequence-free at decode time — one token's
 #: activations flow through the SAME forward formula model.py serves
@@ -492,13 +500,18 @@ class GenRequest:
     lock, so no token is lost or duplicated)."""
 
     def __init__(self, prompt, max_tokens, temperature, eos,
-                 deadline, trace=None):
+                 deadline, trace=None, tenant=None):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.temperature = temperature
         self.eos = eos
         self.deadline = deadline
         self.trace = trace
+        #: resolved tenant (ISSUE 18) + virtual finish tag: KV slots
+        #: are granted least-tag-first so one tenant's burst cannot
+        #: monopolise the decode batch (see ContinuousBatcher)
+        self.tenant = tenant
+        self.vft = 0.0
         self.t_submit = time.perf_counter()
         self.t_first = None         # wall of the first decoded token
         self.tokens = []
@@ -599,6 +612,12 @@ class ContinuousBatcher(Logger):
         self._wake = threading.Condition(self._lock)
         self._queue = collections.deque()
         self._active = {}           # slot -> GenRequest
+        # weighted-fair slot grants (ISSUE 18): virtual time + last
+        # finish tag per tenant, cost = prompt + token budget over
+        # the tenant's priority weight. FIFO-equivalent with one
+        # tenant (or no tenant table installed).
+        self._vtime = 0.0
+        self._vfinish = {}
         self._running = True
         self.last_step = time.monotonic()
         n_slots = engine.pool.n_slots
@@ -669,12 +688,14 @@ class ContinuousBatcher(Logger):
     # -- client side ---------------------------------------------------
 
     def submit(self, prompt, max_tokens=None, temperature=0.0,
-               eos=None, timeout_ms=None, trace=None):
+               eos=None, timeout_ms=None, trace=None, tenant=None):
         """Enqueue one generation; -> :class:`GenRequest`. Raises
         :class:`QueueFull` (admission backpressure) or
         :class:`ValueError` (prompt/budget outside the pool
         geometry). ``timeout_ms`` bounds the wait for a KV slot, not
-        the decode itself (a granted sequence runs to completion)."""
+        the decode itself (a granted sequence runs to completion).
+        ``tenant`` (resolver output) keys the weighted-fair slot
+        grants."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must have at least one token")
@@ -691,7 +712,8 @@ class ContinuousBatcher(Logger):
                    else float(timeout_ms) / 1000.0)
         req = GenRequest(prompt, max_tokens, float(temperature),
                          None if eos is None else int(eos),
-                         time.monotonic() + timeout, trace=trace)
+                         time.monotonic() + timeout, trace=trace,
+                         tenant=tenant)
         with self._lock:
             if not self._running:
                 raise RuntimeError("decode batcher is closed")
@@ -701,6 +723,12 @@ class ContinuousBatcher(Logger):
                     "decode queue full (%d waiting, max %d)"
                     % (len(self._queue), self.max_queue))
             self._c_requests.get().inc()
+            # fair-share tag: a sequence's cost is its whole KV
+            # claim (prompt + token budget) over the tenant's weight
+            start = max(self._vtime, self._vfinish.get(tenant, 0.0))
+            req.vft = start + (len(prompt) + max_tokens) \
+                / tenants.weight(tenant)
+            self._vfinish[tenant] = req.vft
             req._notify = self._notify
             self._queue.append(req)
             self._g_queue.get().set(len(self._queue))
@@ -724,9 +752,11 @@ class ContinuousBatcher(Logger):
         """Sweep the queue: expired/cancelled requests fail WITHOUT
         prefill (even while the pool is saturated — a dead entry must
         not pin the bounded queue and shed live traffic), live ones
-        take free KV slots in FIFO order; -> the requests to
-        prefill. Lock held."""
-        admitted, waiting = [], []
+        take free KV slots in least-virtual-finish-tag order (ISSUE
+        18: weighted fairness across tenants — FIFO when every tag
+        came from one tenant); the rest keep their arrival order; ->
+        the requests to prefill. Lock held."""
+        live = []
         now = time.monotonic()
         while self._queue:
             req = self._queue.popleft()
@@ -737,13 +767,23 @@ class ContinuousBatcher(Logger):
                 req._finish(error=DeadlineExceeded(
                     "no KV slot before deadline"))
                 self._count_finish("expired")
-            elif self.engine.pool.free_slots:
+            else:
+                live.append(req)
+        admitted = []
+        if live and self.engine.pool.free_slots:
+            granted = set()
+            for req in sorted(live, key=lambda r: (r.vft,
+                                                   r.tenant or "")):
+                if not self.engine.pool.free_slots:
+                    break
                 req.slot = self.engine.pool.grant()
                 self._active[req.slot] = req
+                self._vtime = max(self._vtime, req.vft)
                 admitted.append(req)
-            else:
-                waiting.append(req)
-        self._queue.extend(waiting)     # FIFO preserved (lock held)
+                granted.add(id(req))
+            if granted:
+                live = [r for r in live if id(r) not in granted]
+        self._queue.extend(live)    # arrival order preserved
         self._g_queue.get().set(len(self._queue))
         self._g_slots.get().set(self.engine.pool.in_use)
         return admitted
@@ -779,6 +819,8 @@ class ContinuousBatcher(Logger):
         done; -> finish reason or None (keeps decoding)."""
         req._emit(tok)
         self._c_tokens.get().inc()
+        if req.tenant is not None:
+            _T_TOKENS.get().labels(req.tenant).inc()
         if req.cancelled is not None:
             return req.cancelled
         if req.eos is not None and tok == req.eos:

@@ -75,20 +75,46 @@ import numpy
 
 from veles import health, reactor, telemetry
 from veles.logger import Logger
+from veles.serving import tenants
 from veles.serving.batcher import DeadlineExceeded, QueueFull
 
-#: overload rejections by reason (satellite, ISSUE 8): "shed" = the
-#: micro-batcher's queue was full, "not_ready" = readiness was false
-#: (no warm model / breaker open / SLO firing), "disconnect" = a
-#: streaming /v1/generate client dropped (or overflowed its write
-#: queue) mid-decode and its KV slot was reclaimed (ISSUE 11)
-_REJECTED = {
-    reason: telemetry.LazyChild(
-        lambda r=reason: telemetry.counter(
-            "veles_serving_rejected_total",
-            "Requests rejected with 503 before any forward compute, "
-            "by reason", ("reason",)).labels(r))
-    for reason in ("shed", "not_ready", "disconnect")}
+#: overload rejections by reason (satellite, ISSUE 8; tenant label
+#: since ISSUE 18): "shed" = the micro-batcher's queue was full,
+#: "not_ready" = readiness was false (no warm model / breaker open /
+#: SLO firing), "disconnect" = a streaming /v1/generate client
+#: dropped (or overflowed its write queue) mid-decode and its KV slot
+#: was reclaimed (ISSUE 11), "quota" = the tenant's token bucket was
+#: dry (429), "priority" = a best-effort tenant shed first while the
+#: process was under pressure (503)
+_REJECTED = telemetry.LazyChild(
+    lambda: telemetry.counter(
+        "veles_serving_rejected_total",
+        "Requests rejected with 429/503 before any forward compute, "
+        "by reason and tenant", ("reason", "tenant")))
+
+#: tenant label used before any table is installed / outside HTTP —
+#: keeps the label set bounded without a resolver in the loop
+_NO_TENANT = tenants.DEFAULT_TENANT
+
+
+def _count_rejected(reason, tenant):
+    _REJECTED.get().labels(reason, tenant or _NO_TENANT).inc()
+
+
+#: per-tenant request/latency attribution (ISSUE 18). Tenant values
+#: are RESOLVER OUTPUT only (bounded; zlint telemetry-hygiene).
+#: Latency is observed for ANSWERED (2xx) requests — goodput latency,
+#: the series the per-tenant p99 burn-rate SLOs watch.
+_T_REQUESTS = telemetry.LazyChild(
+    lambda: telemetry.counter(
+        "veles_serving_tenant_requests_total",
+        "Serving requests by resolved tenant and route",
+        ("tenant", "route")))
+_T_LATENCY = telemetry.LazyChild(
+    lambda: telemetry.histogram(
+        "veles_serving_tenant_latency_seconds",
+        "End-to-end answered-request latency by resolved tenant",
+        ("tenant",)))
 
 #: Retry-After (seconds) sent with 503s: shed queues drain within a
 #: batching window; readiness usually needs a reload/recovery cycle
@@ -175,6 +201,15 @@ class ServingFrontend(Logger):
             # attribute read, safe inline on the loop
             from veles import model_health
             request.reply_json(200, model_health.debug_model_doc())
+        elif path.startswith("/debug/tenants"):
+            # tenant table + live bucket levels (ISSUE 18): a short
+            # lock around a dict walk, no I/O — loop-safe
+            table = tenants.get_table()
+            if table is None:
+                request.reply_json(
+                    404, {"error": "no tenant table (--tenants)"})
+            else:
+                request.reply_json(200, table.describe())
         elif path.startswith("/debug/"):
             payload = telemetry.debug_endpoint(path)
             if payload is None:
@@ -231,14 +266,25 @@ class ServingFrontend(Logger):
     @staticmethod
     def _reply_headers(code, reply, tp_header):
         """Response headers for one JSON reply: the traceparent echo
-        always; on 503 also Retry-After — an overload/readiness
-        rejection tells the caller WHEN to come back instead of a
-        generic failure."""
-        if code == 503:
+        always; on 429/503 also Retry-After — an overload/quota/
+        readiness rejection tells the caller WHEN to come back
+        instead of a generic failure."""
+        if code in (429, 503):
             return tp_header + (
                 ("Retry-After",
                  str(reply.get("retry_after_s", RETRY_AFTER_SHED))),)
         return tp_header
+
+    @staticmethod
+    def _tenant_of(request):
+        """Resolve the request's ``x-veles-tenant`` header to a
+        BOUNDED tenant name (known key, configured default, or the
+        ``other`` fold). With no table installed every caller is the
+        default tenant — raw header values never reach a label."""
+        table = tenants.get_table()
+        if table is None:
+            return _NO_TENANT
+        return table.resolve(request.headers.get("x-veles-tenant"))
 
     def _serve_predict(self, request):
         # join the caller's distributed trace, or root a new one:
@@ -257,7 +303,8 @@ class ServingFrontend(Logger):
             request.reply_json(400, {"error": "bad json"},
                                headers=tp_header)
             return
-        code, reply = self.predict_request(doc, trace=trace)
+        code, reply = self.predict_request(
+            doc, trace=trace, tenant=self._tenant_of(request))
         request.reply_json(code, reply,
                            headers=self._reply_headers(
                                code, reply, tp_header))
@@ -282,13 +329,17 @@ class ServingFrontend(Logger):
             return
         stream_mode = bool(doc.get("stream", True)) \
             if isinstance(doc, dict) else True
+        tenant = self._tenant_of(request)
         if not stream_mode:
-            code, reply = self.generate_request(doc, trace=trace)
+            code, reply = self.generate_request(doc, trace=trace,
+                                                tenant=tenant)
             request.reply_json(code, reply,
                                headers=self._reply_headers(
                                    code, reply, tp_header))
             return
-        code, reply, handle, entry = self._submit_generate(doc, trace)
+        t0 = time.perf_counter()
+        code, reply, handle, entry = self._submit_generate(
+            doc, trace, tenant)
         if handle is None:
             request.reply_json(code, reply,
                                headers=self._reply_headers(
@@ -297,7 +348,7 @@ class ServingFrontend(Logger):
         stream = request.begin_stream(
             200, "application/x-ndjson", headers=tp_header,
             on_close=lambda reason: self._generate_disconnect(
-                handle, reason))
+                handle, reason, tenant))
         stream.write(json.dumps(
             {"model": entry.name, "version": entry.version}) + "\n")
 
@@ -313,31 +364,32 @@ class ServingFrontend(Logger):
                     {"done": True, "n": len(req.tokens),
                      "tokens": [int(t) for t in req.tokens],
                      "finish_reason": req.finish_reason}) + "\n")
+                _T_LATENCY.get().labels(tenant or _NO_TENANT) \
+                    .observe(time.perf_counter() - t0)
             stream.end()
 
         handle.set_on_token(on_token)
         handle.set_on_done(on_done)
 
-    def _generate_disconnect(self, handle, reason):
+    def _generate_disconnect(self, handle, reason, tenant=None):
         """The stream's connection died before the terminal chunk
         (client gone, or its bounded write queue overflowed): stop
         decoding and give the KV slot back. Runs on the reactor loop
         — flag flips and a counter only, nothing blocking."""
         if handle.done.is_set():
             return                   # raced a normal finish: no-op
-        _REJECTED["disconnect"].get().inc()
+        _count_rejected("disconnect", tenant)
         handle.cancel("disconnect")
 
-    def _submit_generate(self, doc, trace):
+    def _submit_generate(self, doc, trace, tenant=None):
         """Validate + submit one generation; -> (code, error_reply,
         handle|None, entry|None). Shared by the streaming and
         one-shot paths."""
-        blocking = self._admission_block((":shedding",))
-        if blocking:
-            _REJECTED["not_ready"].get().inc()
-            return 503, {"error": "not ready", "reasons": blocking,
-                         "retry_after_s": RETRY_AFTER_NOT_READY}, \
-                None, None
+        _T_REQUESTS.get().labels(tenant or _NO_TENANT,
+                                 "generate").inc()
+        blocked = self._admission_block((":shedding",), tenant)
+        if blocked:
+            return blocked[0], blocked[1], None, None
         try:
             name = doc["model"]
             prompt = doc["prompt"]
@@ -359,9 +411,10 @@ class ServingFrontend(Logger):
                 prompt, max_tokens=doc.get("max_tokens"),
                 temperature=float(doc.get("temperature", 0.0)),
                 eos=doc.get("eos"),
-                timeout_ms=doc.get("timeout_ms"), trace=trace)
+                timeout_ms=doc.get("timeout_ms"), trace=trace,
+                tenant=tenant)
         except QueueFull as exc:
-            _REJECTED["shed"].get().inc()
+            _count_rejected("shed", tenant)
             return 503, {"error": str(exc),
                          "retry_after_s": RETRY_AFTER_SHED}, \
                 None, None
@@ -369,13 +422,14 @@ class ServingFrontend(Logger):
             return 400, {"error": str(exc)}, None, None
         return 200, None, handle, entry
 
-    def generate_request(self, doc, trace=None, wait_s=120.0):
+    def generate_request(self, doc, trace=None, wait_s=120.0,
+                         tenant=None):
         """One-shot (non-streaming) generate: -> (code, reply dict).
         Shared by the HTTP handler and tests (no socket needed)."""
         t0 = time.perf_counter()
         with telemetry.context(trace):
             code, reply, handle, entry = self._submit_generate(
-                doc, trace)
+                doc, trace, tenant)
             if handle is not None:
                 try:
                     tokens = handle.wait(wait_s)
@@ -385,6 +439,8 @@ class ServingFrontend(Logger):
                         "tokens": [int(t) for t in tokens],
                         "n": len(tokens),
                         "finish_reason": handle.finish_reason}
+                    _T_LATENCY.get().labels(tenant or _NO_TENANT) \
+                        .observe(time.perf_counter() - t0)
                 except DeadlineExceeded as exc:
                     # the client hears failure — the generation must
                     # not keep decoding into an answer nobody reads
@@ -503,18 +559,24 @@ class ServingFrontend(Logger):
 
     # -- request handling ----------------------------------------------
 
-    def predict_request(self, doc, trace=None):
+    def predict_request(self, doc, trace=None, tenant=None):
         """-> (http_code, reply_dict); shared by the HTTP handler and
         tests (no socket needed to exercise the logic). ``trace`` is
         the request's :class:`veles.telemetry.TraceContext` — threaded
         through batcher and engine so queue wait and batched execution
-        appear as spans of the caller's trace."""
+        appear as spans of the caller's trace. ``tenant`` is resolver
+        output (bounded; see :meth:`_tenant_of`)."""
         t0 = time.perf_counter()
+        _T_REQUESTS.get().labels(tenant or _NO_TENANT,
+                                 "predict").inc()
         # bind the request's trace as the thread's active context so
         # every log line emitted on its behalf carries the ids
         # (structured-log/trace correlation — veles/logger.py)
         with telemetry.context(trace):
-            code, reply = self._predict_request(doc, trace)
+            code, reply = self._predict_request(doc, trace, tenant)
+        if code == 200:
+            _T_LATENCY.get().labels(tenant or _NO_TENANT) \
+                .observe(time.perf_counter() - t0)
         if telemetry.tracer.active:
             args = {"code": code, "model": str(doc.get("model"))
                     if isinstance(doc, dict) else "?"}
@@ -524,30 +586,63 @@ class ServingFrontend(Logger):
                 "http.predict", t0, time.perf_counter() - t0, **args)
         return code, reply
 
-    def _admission_block(self, exclude):
-        """Reasons that should 503 new admissions, or None. A
-        not-ready process (cold registry, open breaker, firing SLO)
-        must shed load with an honest retry hint, not half-serve it —
-        EXCEPT the ``exclude`` check suffixes: shedding-only
-        unreadiness would flap at the monitor interval (no admissions
-        -> next tick sees zero sheds -> ready -> readmit the storm),
-        and a wedged DECODE loop must not refuse plain predicts.
-        /readyz still reports everything, so a router can drain.
-        Reasons are keyed on the check NAME part of "name: reason"
-        (several frontends may share this process's monitor)."""
-        ready, reasons = self._monitor.ready_state()
-        if ready:
-            return None
-        return [r for r in reasons
-                if not r.split(": ", 1)[0].endswith(exclude)] or None
+    def _admission_block(self, exclude, tenant=None):
+        """The (code, reply) that should reject this admission, or
+        None. Three gates, in order:
 
-    def _predict_request(self, doc, trace):
-        blocking = self._admission_block((":shedding", ":decode"))
-        if blocking:
-            _REJECTED["not_ready"].get().inc()
-            return 503, {"error": "not ready",
-                         "reasons": blocking,
-                         "retry_after_s": RETRY_AFTER_NOT_READY}
+        * **readiness** — a not-ready process (cold registry, open
+          breaker, firing SLO) must shed load with an honest retry
+          hint, not half-serve it — EXCEPT the ``exclude`` check
+          suffixes: shedding-only unreadiness would flap at the
+          monitor interval (no admissions -> next tick sees zero
+          sheds -> ready -> readmit the storm), and a wedged DECODE
+          loop must not refuse plain predicts. /readyz still reports
+          everything, so a router can drain. Reasons are keyed on
+          the check NAME part of "name: reason" (several frontends
+          may share this process's monitor). 503.
+        * **priority** (ISSUE 18) — while the shedding check fires,
+          best-effort tenants (priority class ``batch``) are shed
+          FIRST even though the check is excluded for everyone else:
+          pressure relief starts with the traffic that asked to be
+          preemptible. 503.
+        * **quota** (ISSUE 18) — the tenant's token bucket; a dry
+          bucket answers 429 with the exact Retry-After the bucket
+          computes.
+
+        Every rejection is counted
+        ``veles_serving_rejected_total{reason,tenant}``."""
+        ready, reasons = self._monitor.ready_state()
+        if not ready:
+            blocking = [r for r in reasons
+                        if not r.split(": ", 1)[0].endswith(exclude)]
+            if blocking:
+                _count_rejected("not_ready", tenant)
+                return 503, {"error": "not ready",
+                             "reasons": blocking,
+                             "retry_after_s": RETRY_AFTER_NOT_READY}
+        table = tenants.get_table()
+        if table is None or tenant is None:
+            return None
+        if not ready and table.best_effort(tenant) \
+                and any(r.split(": ", 1)[0].endswith(":shedding")
+                        for r in reasons):
+            _count_rejected("priority", tenant)
+            return 503, {"error": "shed: best-effort tenant %r "
+                         "under pressure" % tenant,
+                         "retry_after_s": RETRY_AFTER_SHED}
+        ok, retry_after = table.admit(tenant)
+        if not ok:
+            _count_rejected("quota", tenant)
+            return 429, {"error": "quota exceeded for tenant %r"
+                         % tenant,
+                         "retry_after_s": round(retry_after, 3)}
+        return None
+
+    def _predict_request(self, doc, trace, tenant=None):
+        blocked = self._admission_block((":shedding", ":decode"),
+                                        tenant)
+        if blocked:
+            return blocked
         try:
             name = doc["model"]
             inputs = numpy.asarray(doc["inputs"], numpy.float32)
@@ -575,9 +670,9 @@ class ServingFrontend(Logger):
         try:
             out = entry.predict(inputs,
                                 timeout_ms=doc.get("timeout_ms"),
-                                trace=trace)
+                                trace=trace, tenant=tenant)
         except QueueFull as exc:
-            _REJECTED["shed"].get().inc()
+            _count_rejected("shed", tenant)
             return 503, {"error": str(exc),
                          "retry_after_s": RETRY_AFTER_SHED}
         except DeadlineExceeded as exc:
@@ -691,6 +786,14 @@ def build_serve_argparser():
                    help="snapshot store (dir or http base) the "
                         "refresh poll scans for NAME; defaults to "
                         "the store implied by --checkpoint")
+    p.add_argument("--tenants", default=None, metavar="PATH",
+                   help="per-tenant QoS config (JSON: tenant -> "
+                        "rps/burst quota + priority class, default "
+                        "tenant for unkeyed callers; see "
+                        "veles/serving/tenants.py). Enables "
+                        "x-veles-tenant resolution, 429 quotas, "
+                        "weighted-fair batching and per-tenant p99 "
+                        "SLO burn rates")
     p.add_argument("--slo-config", default=None, metavar="PATH",
                    help="JSON list of SLO objectives evaluated by "
                         "the in-process health monitor (burn-rate "
@@ -728,6 +831,12 @@ def serve_main(argv=None):
         raise SystemExit("--checkpoint/--refresh-store for unloaded "
                          "model(s): %s" % ", ".join(unknown))
     telemetry.tracer.set_process_name("serving")
+    if args.tenants:
+        table = tenants.set_table(
+            tenants.TenantTable.from_file(args.tenants))
+        n = len(table.install_slos(health.get_monitor()))
+        print("tenant table: %d tenant(s), %d p99 SLO(s)"
+              % (len(table.names()), n), flush=True)
     registry = ModelRegistry(
         backend=args.backend, max_batch=args.max_batch,
         max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,

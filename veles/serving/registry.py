@@ -65,9 +65,9 @@ class ServedModel:
         #: request that warms it)
         self.warm = True
 
-    def predict(self, rows, timeout_ms=None, trace=None):
+    def predict(self, rows, timeout_ms=None, trace=None, tenant=None):
         return self.batcher.predict(rows, timeout_ms=timeout_ms,
-                                    trace=trace)
+                                    trace=trace, tenant=tenant)
 
     def cache_bytes(self):
         """Forward-cache memory ESTIMATE for this entry (ISSUE 10
