@@ -1173,12 +1173,43 @@ def lint_full_tree_seconds():
     return dt
 
 
+def lint_full_tree_warm_seconds():
+    """Wall time of a WARM cached full-tree zlint pass (--cache): a
+    priming run fills a fresh cache directory, the timed run answers
+    from it. Tracks the incremental-analysis win — the acceptance
+    floor is warm <= 50% of cold (up = bad, "seconds" key)."""
+    import tempfile
+
+    import veles
+    from veles.analysis import analyze_paths
+    from veles.analysis.cache import AnalysisCache
+    pkg = os.path.dirname(os.path.abspath(veles.__file__))
+    base = os.path.dirname(pkg)
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = AnalysisCache(tmp)
+        analyze_paths([pkg], base=base, cache=cache)        # prime
+        t0 = time.perf_counter()
+        findings = analyze_paths([pkg], base=base,
+                                 cache=AnalysisCache(tmp))
+        dt = time.perf_counter() - t0
+    if findings:
+        raise RuntimeError(
+            "full-tree lint found %d violation(s) — the row would "
+            "time a dirty tree" % len(findings))
+    return dt
+
+
 def _lint_row(extra):
     try:
         extra["lint_full_tree_seconds"] = round(
             lint_full_tree_seconds(), 3)
     except Exception as exc:
         extra["lint_full_tree_seconds_error"] = str(exc)[:200]
+    try:
+        extra["lint_full_tree_warm_seconds"] = round(
+            lint_full_tree_warm_seconds(), 3)
+    except Exception as exc:
+        extra["lint_full_tree_warm_seconds_error"] = str(exc)[:200]
 
 
 def _record(extra, key, fn):

@@ -1887,21 +1887,433 @@ class Step:
                     select=["stats-cadence"]) == []
 
 
+# -- taint rules (ISSUE 20) --------------------------------------------
+
+_GEOM_BAD = """\
+import numpy
+
+
+class Proto:
+    def handle(self, kind, payload):
+        self.buf = numpy.zeros(payload["shape"])
+"""
+
+_GEOM_GOOD = """\
+import numpy
+
+
+class Proto:
+    def handle(self, kind, payload):
+        self.buf = numpy.zeros(
+            self._validate_shape(payload["shape"]))
+
+    def _validate_shape(self, shape):
+        return [min(int(d), 64) for d in shape]
+"""
+
+
+def test_untrusted_geometry_fires_on_wire_shape(tmp_path):
+    """A wire-handler payload sizing an allocation fires; routing it
+    through a sanitizer-named bounder is quiet; a pragma'd site is
+    quiet."""
+    findings = lint_src(tmp_path, _GEOM_BAD,
+                        select=["untrusted-geometry"])
+    assert rule_ids(findings) == ["untrusted-geometry"]
+    assert "wire" in findings[0].message
+    assert lint_src(tmp_path, _GEOM_GOOD,
+                    select=["untrusted-geometry"]) == []
+    pragmad = _GEOM_BAD.replace(
+        'payload["shape"])',
+        'payload["shape"])  '
+        '# zlint: disable=untrusted-geometry (test fixture)')
+    assert lint_src(tmp_path, pragmad,
+                    select=["untrusted-geometry"]) == []
+
+
+def test_untrusted_geometry_crosses_calls(tmp_path):
+    """Interprocedural: the handler hands its payload to a helper
+    that allocates — the finding lands at the sink with the call
+    chain in the message."""
+    src = """\
+import numpy
+
+
+class Proto:
+    def handle(self, kind, payload):
+        self._apply(payload)
+
+    def _apply(self, doc):
+        self.buf = numpy.zeros(doc["shape"])
+"""
+    findings = lint_src(tmp_path, src,
+                        select=["untrusted-geometry"])
+    assert rule_ids(findings) == ["untrusted-geometry"]
+    assert "via" in findings[0].message
+    assert "handle" in findings[0].message
+
+
+_CARD_BAD = """\
+class Server:
+    def __init__(self):
+        self.stats = {}
+
+    def handle(self, kind, payload):
+        self.stats[kind] = payload
+"""
+
+_CARD_GOOD = """\
+class Server:
+    def __init__(self):
+        self.stats = {}
+
+    def handle(self, kind, payload):
+        self.stats[self._resolve_kind(kind)] = payload
+
+    def _resolve_kind(self, kind):
+        return kind if kind in ("job", "update") else "other"
+"""
+
+
+def test_unbounded_cardinality_fires_on_wire_keyed_growth(tmp_path):
+    findings = lint_src(tmp_path, _CARD_BAD,
+                        select=["unbounded-cardinality"])
+    assert rule_ids(findings) == ["unbounded-cardinality"]
+    assert lint_src(tmp_path, _CARD_GOOD,
+                    select=["unbounded-cardinality"]) == []
+    pragmad = _CARD_BAD.replace(
+        "self.stats[kind] = payload",
+        "self.stats[kind] = payload  "
+        "# zlint: disable=unbounded-cardinality (test fixture)")
+    assert lint_src(tmp_path, pragmad,
+                    select=["unbounded-cardinality"]) == []
+
+
+def test_unbounded_cardinality_http_source_and_bounded_class(
+        tmp_path):
+    """The http taint kind (request.body) reaches the same sink; a
+    container whose class is Bounded* by name is exempt."""
+    src = """\
+import json
+
+
+class Frontend:
+    def __init__(self):
+        self.seen = {}
+
+    def serve(self, request):
+        doc = json.loads(request.body)
+        self.seen[doc["name"]] = doc
+"""
+    findings = lint_src(tmp_path, src,
+                        select=["unbounded-cardinality"])
+    assert rule_ids(findings) == ["unbounded-cardinality"]
+    assert "http" in findings[0].message
+    bounded = src.replace("self.seen = {}",
+                          "self.seen = BoundedDict(256)")
+    assert lint_src(tmp_path, bounded,
+                    select=["unbounded-cardinality"]) == []
+
+
+_DESER_BAD = """\
+import pickle
+
+
+class Proto:
+    def handle(self, kind, payload):
+        return pickle.loads(payload)
+"""
+
+_DESER_GOOD = """\
+import hmac
+import pickle
+
+
+class Proto:
+    def handle(self, kind, payload, tag):
+        if not hmac.compare_digest(self._sign(payload), tag):
+            raise ValueError("bad tag")
+        return pickle.loads(payload)
+"""
+
+
+def test_unsafe_deserialize_fires_without_hmac(tmp_path):
+    findings = lint_src(tmp_path, _DESER_BAD,
+                        select=["unsafe-deserialize"])
+    assert rule_ids(findings) == ["unsafe-deserialize"]
+    assert lint_src(tmp_path, _DESER_GOOD,
+                    select=["unsafe-deserialize"]) == []
+    pragmad = _DESER_BAD.replace(
+        "return pickle.loads(payload)",
+        "return pickle.loads(payload)  "
+        "# zlint: disable=unsafe-deserialize (test fixture)")
+    assert lint_src(tmp_path, pragmad,
+                    select=["unsafe-deserialize"]) == []
+
+
+_PATH_BAD = """\
+class Store:
+    def handle(self, kind, payload):
+        with open(payload["path"]) as f:
+            return f.read()
+"""
+
+_PATH_GOOD = """\
+class Store:
+    def handle(self, kind, payload):
+        with open(self._resolve_path(payload["path"])) as f:
+            return f.read()
+
+    def _resolve_path(self, name):
+        return self.root + "/" + name.rsplit("/", 1)[-1]
+"""
+
+
+def test_untrusted_path_fires_on_wire_filename(tmp_path):
+    findings = lint_src(tmp_path, _PATH_BAD,
+                        select=["untrusted-path"])
+    assert rule_ids(findings) == ["untrusted-path"]
+    assert lint_src(tmp_path, _PATH_GOOD,
+                    select=["untrusted-path"]) == []
+    pragmad = _PATH_BAD.replace(
+        'with open(payload["path"]) as f:',
+        'with open(payload["path"]) as f:  '
+        '# zlint: disable=untrusted-path (test fixture)')
+    assert lint_src(tmp_path, pragmad,
+                    select=["untrusted-path"]) == []
+
+
+def test_sanitizer_annotation_kills_taint(tmp_path):
+    """The ``# zlint: sanitizer`` recipe: a bounded tenant-table
+    lookup that is NOT sanitizer-named still cleans what flows
+    through it — the sanitizer-kills-taint pin."""
+    src = """\
+import numpy
+
+
+def bounded_dims(doc):  # zlint: sanitizer (schema-checked upstream)
+    return doc["rows"], doc["cols"]
+
+
+class Proto:
+    def handle(self, kind, payload):
+        self.buf = numpy.zeros(bounded_dims(payload))
+"""
+    assert lint_src(tmp_path, src,
+                    select=["untrusted-geometry"]) == []
+    # the same flow WITHOUT the annotation fires — the pin is
+    # falsifiable
+    unannotated = src.replace(
+        "  # zlint: sanitizer (schema-checked upstream)", "")
+    findings = lint_src(tmp_path, unannotated,
+                        select=["untrusted-geometry"])
+    assert rule_ids(findings) == ["untrusted-geometry"]
+    # the engine's bounded-lookup shape needs no annotation at all:
+    # .get() off an untainted module table returns the TABLE's data
+    table = """\
+import numpy
+
+TABLE = {"small": (4, 4), "big": (64, 64)}
+
+
+class Proto:
+    def handle(self, kind, payload):
+        self.buf = numpy.zeros(TABLE.get(payload["profile"],
+                                         (4, 4)))
+"""
+    assert lint_src(tmp_path, table,
+                    select=["untrusted-geometry"]) == []
+
+
+def test_range_guard_kills_taint(tmp_path):
+    """An explicit comparison guard is a sanitizer: after the
+    programmer bounded the value, downstream sinks stay quiet."""
+    src = """\
+import numpy
+
+
+class Proto:
+    def handle(self, kind, payload):
+        n = payload["n"]
+        if n > 4096:
+            raise ValueError("too big")
+        self.buf = numpy.zeros(n)
+"""
+    assert lint_src(tmp_path, src,
+                    select=["untrusted-geometry"]) == []
+
+
+# -- incremental analysis cache (ISSUE 20) -----------------------------
+
+
+def _write_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+
+
+def _run_cached(tmp_path, cache_dir):
+    from veles.analysis.cache import AnalysisCache
+    stats = []
+    findings = analyze_paths([str(tmp_path / "pkg")],
+                             base=str(tmp_path),
+                             cache=AnalysisCache(str(cache_dir)),
+                             stats=stats)
+    return findings, {row["rule"]: row for row in stats}
+
+
+def test_cache_reuses_and_reanalyzes_only_dependents(tmp_path):
+    """THE cache-correctness pin: a warm run is all-cached with
+    byte-identical findings; editing one module re-analyzes only the
+    modules whose import closure contains it — and the findings
+    still match an uncached run byte for byte."""
+    _write_tree(tmp_path, {
+        "pkg/a.py": "import os\n\nfrom pkg import b\n\n\n"
+                    "def use():\n    return b.helper()\n",
+        "pkg/b.py": "def helper():\n    return 1\n",
+        "pkg/c.py": "X = 1\n",
+    })
+    cache_dir = tmp_path / "zc"
+    cold, stats_cold = _run_cached(tmp_path, cache_dir)
+    assert stats_cold["unused-import"]["fresh_modules"] == 3
+    # the planted finding: a.py's unused os import
+    assert [f.rule for f in cold] == ["unused-import"]
+    warm, stats_warm = _run_cached(tmp_path, cache_dir)
+    assert stats_warm["unused-import"]["fresh_modules"] == 0
+    assert stats_warm["unused-import"]["cached_modules"] == 3
+    assert json.dumps([f.as_dict() for f in warm]) \
+        == json.dumps([f.as_dict() for f in cold])
+    # edit b.py: a.py (imports b) and b.py re-analyze, c.py answers
+    # from cache; a project-scope rule re-runs over everything
+    (tmp_path / "pkg/b.py").write_text(
+        "def helper():\n    return 2\n")
+    edited, stats_edit = _run_cached(tmp_path, cache_dir)
+    assert stats_edit["unused-import"]["fresh_modules"] == 2
+    assert stats_edit["unused-import"]["cached_modules"] == 1
+    assert stats_edit["untrusted-geometry"]["fresh_modules"] == 3
+    uncached = analyze_paths([str(tmp_path / "pkg")],
+                             base=str(tmp_path))
+    assert json.dumps([f.as_dict() for f in edited]) \
+        == json.dumps([f.as_dict() for f in uncached])
+
+
+def test_cache_invalidates_on_import_graph_change(tmp_path):
+    """Adding an import EDGE re-keys the importer: before the edge,
+    editing b leaves a cached; after a.py gains ``import b``, an edit
+    to b.py alone re-analyzes a.py too."""
+    _write_tree(tmp_path, {
+        "pkg/a.py": "def use():\n    return 1\n",
+        "pkg/b.py": "def helper():\n    return 1\n",
+    })
+    cache_dir = tmp_path / "zc"
+    _run_cached(tmp_path, cache_dir)
+    (tmp_path / "pkg/b.py").write_text(
+        "def helper():\n    return 2\n")
+    _, stats = _run_cached(tmp_path, cache_dir)
+    # no edge yet: only b itself re-analyzes
+    assert stats["unused-import"]["fresh_modules"] == 1
+    (tmp_path / "pkg/a.py").write_text(
+        "from pkg import b\n\n\ndef use():\n    return b.helper()\n")
+    _run_cached(tmp_path, cache_dir)            # warm the new graph
+    (tmp_path / "pkg/b.py").write_text(
+        "def helper():\n    return 3\n")
+    _, stats = _run_cached(tmp_path, cache_dir)
+    # the edge exists: b's edit invalidates a's closure key as well
+    assert stats["unused-import"]["fresh_modules"] == 2
+
+
+def test_cache_pragma_edit_rekeys_the_module(tmp_path):
+    """Findings are stored post-pragma-filter — sound only because a
+    pragma edit changes the module's content hash and therefore its
+    key."""
+    _write_tree(tmp_path, {"pkg/a.py": "import os\n"})
+    cache_dir = tmp_path / "zc"
+    cold, _ = _run_cached(tmp_path, cache_dir)
+    assert [f.rule for f in cold] == ["unused-import"]
+    (tmp_path / "pkg/a.py").write_text(
+        "import os  # zlint: disable=unused-import (test)\n")
+    warm, _ = _run_cached(tmp_path, cache_dir)
+    assert warm == []
+
+
+def test_cli_cache_and_stats(tmp_path, capsys):
+    """--cache + --stats: the text table reports fresh/cached module
+    counts, --json wraps {findings, stats}, and a warm --format json
+    run (no --stats) is byte-identical to the cold one."""
+    p = tmp_path / "m.py"
+    p.write_text("import os\n\ntry:\n    pass\nexcept:\n    pass\n")
+    cache_dir = str(tmp_path / "zc")
+    rc = lint_main([str(p), "--cache", cache_dir, "--stats"])
+    out_cold = capsys.readouterr().out
+    assert rc == 1
+    assert "fresh" in out_cold and "cached" in out_cold
+    rc = lint_main([str(p), "--cache", cache_dir, "--stats",
+                    "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["rule"] for f in doc["findings"]} \
+        == {"unused-import", "bare-except"}
+    by_rule = {r["rule"]: r for r in doc["stats"]}
+    assert by_rule["bare-except"]["cached_modules"] == 1
+    assert by_rule["bare-except"]["fresh_modules"] == 0
+    # cold vs warm byte-identity of the findings document
+    lint_main([str(p), "--format", "json"])
+    plain = capsys.readouterr().out
+    lint_main([str(p), "--cache", cache_dir, "--format", "json"])
+    warm = capsys.readouterr().out
+    assert warm == plain
+
+
+def test_cli_precommit_invocation(tmp_path, capsys):
+    """The documented pre-commit hook line: ``velescli lint
+    --changed-only --cache .zlint-cache --format sarif``. With a
+    cache the full tree is kept (cross-file context intact) and the
+    SARIF document is byte-identical to an uncached full run."""
+    _git(tmp_path, "init", "-q")
+    a = tmp_path / "a.py"
+    a.write_text("X = 1\n")
+    b = tmp_path / "b.py"
+    b.write_text("Y = 2\n")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    b.write_text("try:\n    pass\nexcept:\n    pass\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        rc = lint_main([str(tmp_path), "--changed-only", "--cache",
+                        str(tmp_path / ".zlint-cache"), "--format",
+                        "sarif", "--select", "bare-except"])
+        sarif_warm = capsys.readouterr().out
+        rc_full = lint_main([str(tmp_path), "--format", "sarif",
+                             "--select", "bare-except"])
+        sarif_full = capsys.readouterr().out
+    finally:
+        os.chdir(cwd)
+    assert rc == 1 and rc_full == 1
+    assert sarif_warm == sarif_full
+    doc = json.loads(sarif_warm)
+    assert doc["runs"][0]["results"][0]["ruleId"] == "bare-except"
+
+
 # -- the permanent gate ------------------------------------------------
 
 
 def test_repo_wide_zero_findings_gate():
-    """THE gate: the whole veles package stays at zero findings.
+    """THE gate: the whole veles package — and bench.py, which
+    builds samples from target-advertised geometry — stays at zero
+    findings, the four taint rules included.
 
-    If this fails, `velescli lint veles/` reproduces it locally with
-    file:line + a fix hint per finding. Fix the code, or — for a
-    documented false positive / deliberate design — add
+    If this fails, `velescli lint veles bench.py` reproduces it
+    locally with file:line + a fix hint per finding. Fix the code,
+    or — for a documented false positive / deliberate design — add
     `# zlint: disable=RULE (reason)` on the flagged line."""
     import veles
     pkg = os.path.dirname(os.path.abspath(veles.__file__))
-    findings = analyze_paths([pkg], base=os.path.dirname(pkg))
+    repo = os.path.dirname(pkg)
+    findings = analyze_paths([pkg, os.path.join(repo, "bench.py")],
+                             base=repo)
     assert findings == [], (
-        "zlint found %d violation(s) in veles/:\n%s"
+        "zlint found %d violation(s) in veles/ + bench.py:\n%s"
         % (len(findings), "\n".join(f.render() for f in findings)))
 
 

@@ -352,7 +352,11 @@ def test_refresh_http_endpoint(tmp_path, mnist_archive):
     reg = ModelRegistry(backend="numpy")
     front = None
     try:
-        entry = reg.load("mnist", mnist_archive["archive"])
+        # the refresh plane only admits targets inside stores the
+        # OPERATOR configured at load time (zlint untrusted-path):
+        # attach the store here, not via the HTTP body
+        entry = reg.load("mnist", mnist_archive["archive"],
+                         refresh_store=str(tmp_path))
         t0 = time.time()
         _write_ckpt(tmp_path, "m_current-00000001.ckpt.npz.gz",
                     entry.model.params, 0.5, t0 - 5,
@@ -378,6 +382,17 @@ def test_refresh_http_endpoint(tmp_path, mnist_archive):
         with pytest.raises(urllib.error.HTTPError) as err:
             urllib.request.urlopen(req, timeout=10)
         assert err.value.code == 409
+        # a refresh target OUTSIDE every configured store is refused
+        # with 400 before any filesystem access
+        req = urllib.request.Request(
+            base + "/v1/models/mnist/refresh",
+            data=json.dumps(
+                {"store": "/etc"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 400
+        assert "outside" in json.load(err.value)["error"]
     finally:
         if front is not None:
             front.close()

@@ -189,6 +189,16 @@ def test_decode_shedding_and_validation(lm_env):
         decoder.submit([1] * 8, max_tokens=1000)
     with pytest.raises(ValueError):
         decoder.submit([], max_tokens=4)
+    # non-finite client numbers are admission errors, not wedged
+    # deadlines (NaN never compares expired) or OverflowError 500s
+    with pytest.raises(ValueError, match="timeout_ms"):
+        decoder.submit([1], timeout_ms=float("nan"))
+    with pytest.raises(ValueError, match="timeout_ms"):
+        decoder.submit([1], timeout_ms=float("inf"))
+    with pytest.raises(ValueError, match="timeout_ms"):
+        decoder.submit([1], timeout_ms=-5)
+    with pytest.raises(ValueError, match="max_tokens"):
+        decoder.submit([1], max_tokens=float("inf"))
     held = []
     try:
         for _ in range(4):                        # fill the 4 slots,
@@ -463,6 +473,20 @@ def test_http_generate_nonstream_and_errors(lm_env, front, tmp_path):
     with pytest.raises(urllib.error.HTTPError) as err:
         post(None, raw=b"{not json")
     assert err.value.code == 400
+    # JSON carries bare NaN/Infinity and Python's parser accepts
+    # them: a NaN timeout would mint a deadline that never expires
+    # and an Infinity budget would OverflowError into a 500 — both
+    # must be refused as client errors (admission hardening)
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post(None, raw=b'{"model": "lm", "prompt": [1], '
+             b'"timeout_ms": NaN, "stream": false}')
+    assert err.value.code == 400
+    assert "timeout_ms" in json.loads(err.value.read())["error"]
+    with pytest.raises(urllib.error.HTTPError) as err:
+        post(None, raw=b'{"model": "lm", "prompt": [1], '
+             b'"max_tokens": Infinity, "stream": false}')
+    assert err.value.code == 400
+    assert "max_tokens" in json.loads(err.value.read())["error"]
     # a loaded NON-generative model answers 400, not 500
     numpy.save(tmp_path / "fc_weights.npy",
                numpy.zeros((4, 4), numpy.float32))

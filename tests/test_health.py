@@ -542,6 +542,8 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                 "loadgen_shed_rate_pct": 1.0,
                 "serving_rejected_per_sec": 10.0,
                 "routed_capacity_rps_at_p99_slo": 100.0,
+                "lint_full_tree_seconds": 10.0,
+                "lint_full_tree_warm_seconds": 2.0,
                 "some_row_error": "boom",
             }}}
     path = tmp_path / "BENCH_r07.json"
@@ -580,6 +582,11 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
             "loadgen_shed_rate_pct": 5.0,                  # +400%: bad
             "serving_rejected_per_sec": 20.0,              # +100%: bad
             "routed_capacity_rps_at_p99_slo": 50.0,        # -50%: bad
+            # ISSUE 20: lint wall times are costs ("seconds" is in
+            # _LOWER_BETTER) — a warm-cache regression means the
+            # incremental cache stopped earning its keep
+            "lint_full_tree_seconds": 9.0,                 # -10%: fine
+            "lint_full_tree_warm_seconds": 6.0,            # +200%: bad
         }}
     regressed = bench.self_check(report, threshold_pct=10.0,
                                  baseline_path=str(path))
@@ -599,7 +606,8 @@ def test_bench_self_check_flags_directional_regressions(tmp_path,
                               "model_stats_overhead_pct",
                               "loadgen_shed_rate_pct",
                               "serving_rejected_per_sec",
-                              "routed_capacity_rps_at_p99_slo"}
+                              "routed_capacity_rps_at_p99_slo",
+                              "lint_full_tree_warm_seconds"}
     assert "REGRESSION" in err and "warn-only" in err
     assert "_best" not in err.split("rows in baseline")[0]
     # no baseline -> a note, no crash, nothing regressed

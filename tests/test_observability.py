@@ -226,6 +226,40 @@ def test_web_status(tmp_path):
         ws.close()
 
 
+def test_web_status_update_name_cap_413(monkeypatch):
+    """Admission hardening (zlint unbounded-cardinality): POST
+    /update names are the poster's choice and each novel one is a
+    dict kept forever — past the cap, novel names get 413 while
+    updates to existing names still land."""
+    import veles.web_status as web_status
+    from veles.web_status import WebStatus
+    monkeypatch.setattr(web_status, "_MAX_PUSHED", 2)
+    ws = WebStatus(port=0)
+    try:
+        base = "http://127.0.0.1:%d" % ws.port
+
+        def post(name):
+            req = urllib.request.Request(
+                base + "/update",
+                data=json.dumps({"name": name,
+                                 "epoch": 1}).encode(),
+                method="POST")
+            return urllib.request.urlopen(req, timeout=10).status
+
+        assert post("a") == 200
+        assert post("b") == 200
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post("c")
+        assert err.value.code == 413
+        # existing names keep updating under the cap
+        assert post("a") == 200
+        doc = json.loads(urllib.request.urlopen(
+            base + "/status.json", timeout=10).read())
+        assert sorted(doc) == ["a", "b"]
+    finally:
+        ws.close()
+
+
 def test_profile_dir_writes_trace(tmp_path):
     """--profile-dir wraps the run in jax.profiler.trace and leaves a
     trace artifact behind (SURVEY §5.1 kernel-level profiling)."""

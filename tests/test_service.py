@@ -240,6 +240,22 @@ def test_cli_end_to_end(tmp_path):
     assert "digraph" in graph.read_text()
 
 
+def test_request_kind_counter_is_bounded():
+    """Admission hardening (zlint unbounded-cardinality): the frame
+    chooses the request kind string, but the per-kind counter cache
+    and its Prometheus label universe must not be the wire's to grow
+    — unknown kinds fold into one ``other`` bucket."""
+    from veles.server import _REQUEST_KINDS, _resolve_request_kind
+    for kind in _REQUEST_KINDS:
+        assert _resolve_request_kind(kind) == kind
+    assert _resolve_request_kind("jailbreak") == "other"
+    assert _resolve_request_kind("job2") == "other"
+    assert _resolve_request_kind(b"\xff" * 64) == "other"
+    assert _resolve_request_kind(None) == "other"
+    # the dispatched universe is exactly the bounded label set
+    assert _REQUEST_KINDS == {"hello", "ping", "job", "update"}
+
+
 def test_master_slave_protocol():
     """In-process master + 2 slaves over localhost TCP: job/update
     round-trips, weight averaging, slave-drop requeue (§3.3, §4)."""

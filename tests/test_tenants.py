@@ -621,6 +621,23 @@ def test_loadgen_parse_and_mix():
     assert loadgen._percentile([1.0, 2.0, 3.0, 4.0], 0.5) == 2.0
 
 
+def test_loadgen_refuses_hostile_geometry():
+    """Admission hardening (zlint untrusted-geometry): the
+    /v1/models listing is the TARGET's data — a malicious or buggy
+    target advertising a huge input_sample_shape must not make the
+    load generator allocate it."""
+    from veles import loadgen
+    assert loadgen._validated_shape([4, 4]) == [4, 4]
+    assert loadgen._validated_shape([]) == [1]
+    assert loadgen._validated_shape([0, 3]) == [1, 3]
+    with pytest.raises(SystemExit, match="refusing"):
+        loadgen._validated_shape([1 << 30])
+    with pytest.raises(SystemExit, match="refusing"):
+        loadgen._validated_shape([2] * 9)          # rank cap
+    with pytest.raises(SystemExit, match="non-numeric"):
+        loadgen._validated_shape(["lots"])
+
+
 def test_loadgen_e2e_routed_fleet(clf_archive, capsys):
     """The acceptance run: loadgen drives a tenant mix at a REAL
     routed 2-replica fleet and reports per-tenant curves plus the

@@ -62,6 +62,19 @@ rule id                   checks
                           materialize on the host only behind the
                           ``stats_due`` cadence gate — never per step
 ``thread-lifecycle``      threads must be daemons or have a join path
+``untrusted-geometry``    wire/HTTP-tainted values must not size
+                          allocations (``zeros``/``bytearray``/
+                          ``range`` args, ``shape=``/``maxlen=``
+                          keywords, ``[0] * n``)
+``unbounded-cardinality``  tainted values must not key growth of
+                          persistent containers — route the key
+                          through a bounded resolver
+``unsafe-deserialize``    ``pickle.loads``/``marshal.loads`` on a
+                          tainted payload not dominated by an
+                          ``hmac.compare_digest`` verification
+``untrusted-path``        tainted values must not reach filesystem/
+                          store targets without an admission
+                          resolver
 ``bare-except``           ``except:`` swallows ``KeyboardInterrupt``
 ``unused-import``         dead module-level imports
 ``unused-variable``       locals assigned and never read
@@ -76,6 +89,15 @@ reactor-callback enumeration. Writing a new rule against the graph
 is ~50 lines: resolve calls with ``CallGraph.resolve``, or subclass
 ``ForwardDataflow`` when a fact must flow caller→callee.
 
+The four taint rules share one interprocedural taint pass
+(``engine.taint_hits``): wire handler parameters, HTTP request
+reads and env lookups are sources; sanitizer-named calls
+(``*resolve*``/``*validate*``/``*clamp*``/``*sanitize*``), defs and
+classes annotated ``# zlint: sanitizer (reason)``, and explicit
+comparison guards kill taint; sinks are allocation geometry,
+persistent-container growth, un-verified deserialization and
+filesystem targets.
+
 Findings carry file:line, rule id, severity and a one-line fix hint.
 A finding is suppressed by a pragma comment on its line::
 
@@ -83,10 +105,17 @@ A finding is suppressed by a pragma comment on its line::
 
 ``# zlint: disable=all`` silences every rule on that line. Run it as
 ``velescli lint [--format text|json|sarif] [--changed-only [REF]]
-[paths...]`` (exit 0 clean / 1 findings / 2 usage error); the tier-1
-gate ``tests/test_analysis.py`` keeps the whole ``veles/`` package at
-zero findings, and ``bench.py`` tracks the analyzer's own full-tree
-wall time as ``lint_full_tree_seconds``.
+[--cache DIR] [--stats] [paths...]`` (exit 0 clean / 1 findings / 2
+usage error). ``--cache DIR`` is the incremental mode
+(``veles/analysis/cache.py``): per-rule results keyed by content
+hashes over each module's import closure, so warm full-tree runs
+re-analyze only what changed with byte-identical output — the
+documented pre-commit line is ``velescli lint --changed-only --cache
+.zlint-cache --format sarif``. The tier-1 gate
+``tests/test_analysis.py`` keeps the whole ``veles/`` package (plus
+``bench.py``) at zero findings, and ``bench.py`` tracks the
+analyzer's own cold/warm full-tree wall time as
+``lint_full_tree_seconds`` / ``lint_full_tree_warm_seconds``.
 """
 
 from veles.analysis.core import (          # noqa: F401  (public API)

@@ -106,6 +106,22 @@ def _sarif_doc(findings):
     }
 
 
+def _stats_table(stats):
+    """``--stats`` rows -> an aligned text table (slowest first) with
+    a totals line."""
+    lines = ["%-24s %8s %9s %7s %7s"
+             % ("rule", "seconds", "findings", "fresh", "cached")]
+    for row in sorted(stats, key=lambda r: -r["seconds"]):
+        lines.append("%-24s %8.4f %9d %7d %7d"
+                     % (row["rule"], row["seconds"],
+                        row["findings"], row["fresh_modules"],
+                        row["cached_modules"]))
+    lines.append("%-24s %8.4f %9d"
+                 % ("total", sum(r["seconds"] for r in stats),
+                    sum(r["findings"] for r in stats)))
+    return "\n".join(lines)
+
+
 def lint_main(argv=None):
     from veles.analysis.core import (
         RULES, UnknownRuleError, _load_rules, analyze_paths,
@@ -138,7 +154,21 @@ def lint_main(argv=None):
                         "pre-commit mode. Falls back to the full "
                         "tree with a warning when git is "
                         "unavailable. Note: cross-file context "
-                        "shrinks to the changed set")
+                        "shrinks to the changed set — combine with "
+                        "--cache to keep the FULL tree and let "
+                        "unchanged modules answer from cache instead")
+    p.add_argument("--cache", default=None, metavar="DIR",
+                   help="incremental analysis cache directory: "
+                        "per-rule results keyed by content hashes "
+                        "over each module's import closure (see "
+                        "veles/analysis/cache.py) — warm full-tree "
+                        "runs re-analyze only what changed, with "
+                        "byte-identical output")
+    p.add_argument("--stats", action="store_true",
+                   help="per-rule wall time, finding counts and "
+                        "fresh/cached module counts; text appends a "
+                        "table, json wraps the array as {findings, "
+                        "stats}, sarif prints the table to stderr")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     try:
@@ -158,6 +188,16 @@ def lint_main(argv=None):
         select = [r.strip() for r in args.select.split(",")
                   if r.strip()]
     paths = args.paths or _default_paths()
+    cache = None
+    if args.cache:
+        from veles.analysis.cache import AnalysisCache
+        try:
+            cache = AnalysisCache(args.cache)
+        except OSError as exc:
+            print("error: cannot use cache dir %s: %s"
+                  % (args.cache, exc), file=sys.stderr)
+            return 2
+    stats = [] if args.stats else None
     try:
         if args.changed_only is not None:
             try:
@@ -169,10 +209,17 @@ def lint_main(argv=None):
             if changed is None:
                 print("warning: --changed-only: git unavailable — "
                       "linting the full tree", file=sys.stderr)
+            elif cache is not None:
+                # with a cache the full tree IS the fast path:
+                # unchanged modules answer from cache, and the lint
+                # keeps its complete cross-file view instead of
+                # narrowing context to the changed set
+                pass
             else:
                 paths = [f for f in iter_py_files(paths)
                          if os.path.abspath(f) in changed]
-        findings = analyze_paths(paths, select=select)
+        findings = analyze_paths(paths, select=select, cache=cache,
+                                 stats=stats)
     except FileNotFoundError as exc:
         print("error: no such file or directory: %s" % exc,
               file=sys.stderr)
@@ -194,15 +241,27 @@ def lint_main(argv=None):
         print("error: cannot read input: %s" % exc, file=sys.stderr)
         return 2
     if fmt == "json":
-        print(json.dumps([f.as_dict() for f in findings], indent=2))
+        if stats is not None:
+            print(json.dumps({"findings": [f.as_dict()
+                                           for f in findings],
+                              "stats": stats}, indent=2))
+        else:
+            print(json.dumps([f.as_dict() for f in findings],
+                             indent=2))
     elif fmt == "sarif":
         _load_rules()
         print(json.dumps(_sarif_doc(findings), indent=2,
                          sort_keys=True))
+        if stats is not None:
+            # the SARIF document must stay pure: the human-facing
+            # table goes to stderr
+            print(_stats_table(stats), file=sys.stderr)
     else:
         for f in findings:
             print(f.render())
         print("%d finding(s)" % len(findings))
+        if stats is not None:
+            print(_stats_table(stats))
     return 1 if findings else 0
 
 

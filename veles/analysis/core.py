@@ -12,11 +12,17 @@ indexes so a rule never re-parses anything.
 import ast
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass
 
 #: pragma grammar: ``# zlint: disable=rule-a,rule-b (free-text reason)``
 _PRAGMA_RE = re.compile(r"#\s*zlint:\s*disable=([A-Za-z0-9_,-]+)")
+
+#: sanitizer annotation: ``# zlint: sanitizer (free-text reason)`` on
+#: (or directly above) a def/class marks it a trusted bounding
+#: function / bounded container for the taint rules
+_SANITIZER_RE = re.compile(r"#\s*zlint:\s*sanitizer\b")
 
 SEVERITIES = ("error", "warning")
 
@@ -143,6 +149,8 @@ class Module:
         self.source = source
         self.tree = ast.parse(source, filename=path)
         self.pragmas = self._scan_pragmas(source)
+        #: line numbers carrying ``# zlint: sanitizer`` annotations
+        self.sanitizer_lines = self._scan_sanitizers(source)
         #: local name -> ("module", dotted) | ("symbol", dotted, name)
         self.imports = {}
         #: module-level classes by name
@@ -208,6 +216,24 @@ class Module:
                     rules = {r.strip() for r in m.group(1).split(",")}
                     pragmas.setdefault(i, set()).update(rules)
         return pragmas
+
+    @staticmethod
+    def _scan_sanitizers(source):
+        """Line numbers annotated ``# zlint: sanitizer`` — tokenize-
+        based like the pragma scan, same string-literal immunity."""
+        lines = set()
+        try:
+            tokens = tokenize.generate_tokens(
+                iter(source.splitlines(True)).__next__)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT \
+                        and _SANITIZER_RE.search(tok.string):
+                    lines.add(tok.start[0])
+        except (tokenize.TokenError, IndentationError):
+            for i, line in enumerate(source.splitlines(), 1):
+                if _SANITIZER_RE.search(line):
+                    lines.add(i)
+        return lines
 
     def suppressed(self, line, rule):
         rules = self.pragmas.get(line)
@@ -330,13 +356,24 @@ class Project:
 #: Populated by the rules_* modules at import time via register().
 RULES = {}
 
+#: rule id -> "module" | "project". A module-scope rule's findings in
+#: module M depend only on M plus its transitive imports (and same-
+#:name classes) — the incremental cache re-runs it on just the edited
+#: module's dependency closure. Project-scope rules (cross-module
+#: dataflow: wire-schema, lock cycles, taint) re-run whenever any
+#: module changed. Defaults to the conservative "project".
+RULE_SCOPES = {}
 
-def register(rule_id, severity, doc):
+
+def register(rule_id, severity, doc, scope="project"):
     if severity not in SEVERITIES:
         raise ValueError("severity must be one of %s" % (SEVERITIES,))
+    if scope not in ("module", "project"):
+        raise ValueError("scope must be 'module' or 'project'")
 
     def wrap(fn):
         RULES[rule_id] = (fn, severity, doc)
+        RULE_SCOPES[rule_id] = scope
         return fn
     return wrap
 
@@ -347,7 +384,8 @@ def _load_rules():
     from veles.analysis import (        # noqa: F401
         rules_hygiene, rules_loop, rules_model_stats, rules_probes,
         rules_profiler, rules_purity, rules_reactor, rules_resources,
-        rules_state, rules_telemetry, rules_threads, rules_wire)
+        rules_state, rules_taint, rules_telemetry, rules_threads,
+        rules_wire)
 
 
 def iter_py_files(paths):
@@ -390,9 +428,27 @@ def build_project(paths, base=None):
     return Project(modules)
 
 
-def analyze(project, select=None):
+def pragma_filtered(project, raw_findings):
+    """Drop findings suppressed by a same-line pragma."""
+    by_path = {m.relpath: m for m in project.modules}
+    out = []
+    for f in raw_findings:
+        mod = by_path.get(f.file)
+        if mod is not None and mod.suppressed(f.line, f.rule):
+            continue
+        out.append(f)
+    return out
+
+
+def analyze(project, select=None, cache=None, stats=None):
     """Run every (or the selected) registered rule; -> sorted,
-    pragma-filtered findings."""
+    pragma-filtered findings.
+
+    ``cache`` — an :class:`veles.analysis.cache.AnalysisCache` —
+    reuses stored per-rule results keyed by content hashes (see that
+    module for the invalidation model). ``stats`` — a caller-supplied
+    list — receives one dict per rule run: rule id, wall seconds,
+    finding count and fresh/cached module counts (``--stats``)."""
     _load_rules()
     if select:
         unknown = set(select) - set(RULES)
@@ -400,18 +456,31 @@ def analyze(project, select=None):
             raise UnknownRuleError("unknown rule(s): %s" % ", ".join(
                 sorted(unknown)))
     findings = []
-    by_path = {m.relpath: m for m in project.modules}
     for rule_id, (fn, _sev, _doc) in sorted(RULES.items()):
         if select and rule_id not in select:
             continue
-        for f in fn(project):
-            mod = by_path.get(f.file)
-            if mod is not None and mod.suppressed(f.line, f.rule):
-                continue
-            findings.append(f)
+        t0 = time.perf_counter()
+        if cache is not None:
+            got, fresh, cached = cache.run_rule(
+                project, rule_id, fn,
+                RULE_SCOPES.get(rule_id, "project"))
+        else:
+            got = pragma_filtered(project, fn(project))
+            fresh, cached = len(project.modules), 0
+        findings.extend(got)
+        if stats is not None:
+            stats.append({
+                "rule": rule_id,
+                "seconds": round(time.perf_counter() - t0, 4),
+                "findings": len(got),
+                "fresh_modules": fresh,
+                "cached_modules": cached,
+            })
     return sorted(findings)
 
 
-def analyze_paths(paths, base=None, select=None):
+def analyze_paths(paths, base=None, select=None, cache=None,
+                  stats=None):
     """One-call surface: parse + analyze. -> sorted [Finding]."""
-    return analyze(build_project(paths, base=base), select=select)
+    return analyze(build_project(paths, base=base), select=select,
+                   cache=cache, stats=stats)

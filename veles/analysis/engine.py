@@ -630,6 +630,729 @@ class ForwardDataflow:
                              out_fact, chain + (target.label,)))
 
 
+# -- taint analysis -----------------------------------------------------
+
+#: taint kinds — where an untrusted value originally entered
+TAINT_WIRE = "wire"    # pickled master<->slave frame payloads
+TAINT_HTTP = "http"    # HTTP bodies/headers/paths, fetched JSON
+TAINT_ENV = "env"      # process environment overrides
+_CONCRETE_KINDS = frozenset((TAINT_WIRE, TAINT_HTTP, TAINT_ENV))
+
+#: handler methods whose parameters ARE the wire payload: the frame
+#: dispatch entry points (transport HMAC authenticates the PEER, it
+#: does not bound what the payload asks for)
+WIRE_HANDLER_NAMES = frozenset((
+    "handle", "on_frame", "apply_data_from_master",
+    "apply_data_from_slave"))
+
+#: attribute reads that are HTTP input wherever they appear
+_HTTP_ATTRS = frozenset(("headers", "body"))
+#: request-only attributes (too generic to taint on any receiver)
+_HTTP_REQ_ATTRS = frozenset(("path", "query"))
+_REQUESTISH = frozenset(("request", "req"))
+
+#: unresolvable call names that read raw bytes off a socket
+_RECV_NAMES = frozenset(("recv", "recv_into", "recvfrom",
+                         "recv_frame", "recv_raw_frame"))
+
+#: substrings that mark a call a sanitizer by naming convention —
+#: the telemetry-hygiene ``*resolve*`` escape hatch, generalized
+_SANITIZER_MARKERS = ("resolve", "sanitize", "clamp", "validate")
+
+#: allocation-geometry sinks: first argument / shape keyword sizes
+#: the allocation
+_GEOMETRY_CALLS = frozenset(("zeros", "ones", "empty", "full",
+                             "arange", "bytearray", "range"))
+_GEOMETRY_KWARGS = frozenset(("shape", "size", "maxlen"))
+
+#: keyword names that denote a filesystem/store target at any call
+_PATH_KEYWORDS = frozenset(("path", "filename", "directory",
+                            "dirname", "checkpoint", "store",
+                            "refresh_store", "store_target"))
+#: os.* names that are NOT path sinks
+_PATH_SAFE = frozenset(("getenv", "environ", "getpid", "cpu_count",
+                        "urandom", "fspath", "getcwd", "strerror",
+                        "dup", "close", "read", "write", "pipe",
+                        "fork", "kill", "waitpid", "sched_getaffinity"))
+
+
+class TaintHit:
+    """One tainted value reaching a sink, with its diagnostic chain."""
+
+    __slots__ = ("module", "lineno", "sink", "kinds", "chain",
+                 "detail")
+
+    def __init__(self, module, lineno, sink, kinds, chain, detail):
+        self.module = module    # Module the sink statement lives in
+        self.lineno = lineno
+        self.sink = sink        # "geometry"|"cardinality"|"path"|...
+        self.kinds = kinds      # frozenset of TAINT_* kinds involved
+        self.chain = chain      # label tuple from the entry function
+        self.detail = detail    # human fragment naming the sink
+
+
+def _annotated_sanitizer(mod, node):
+    """True when a def/class carries ``# zlint: sanitizer`` on its
+    own line, the line above, or a decorator line."""
+    lines = mod.sanitizer_lines
+    if node.lineno in lines or (node.lineno - 1) in lines:
+        return True
+    return any(d.lineno in lines
+               for d in getattr(node, "decorator_list", ()))
+
+
+def _sanitizer_named(name):
+    low = (name or "").lower()
+    return any(m in low for m in _SANITIZER_MARKERS)
+
+
+def _bounded_container(mod_of_class, cls_name, project):
+    """True when a container's constructor class is bounded: the
+    class name says so (``Bounded*``/``*LRU*``) or the class def is
+    annotated ``# zlint: sanitizer`` (the recipe for custom capped
+    mappings)."""
+    low = (cls_name or "").lower()
+    if "bounded" in low or "lru" in low:
+        return True
+    for info in project.class_index.get(cls_name, ()):
+        if _annotated_sanitizer(info.module, info.node):
+            return True
+    return False
+
+
+def _guard_names(test):
+    """Names a test bounds by comparison, membership, or isinstance —
+    the 'explicit range/type guard' sanitizer: after the programmer
+    compared a value against anything, both branches are treated as
+    examined."""
+    out = set()
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare):
+            for operand in [sub.left] + list(sub.comparators):
+                for n in ast.walk(operand):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(sub, ast.Call) \
+                and call_name(sub) == "isinstance" and sub.args \
+                and isinstance(sub.args[0], ast.Name):
+            out.add(sub.args[0].id)
+    return out
+
+
+def _calls_compare_digest(node):
+    """True when the subtree performs an HMAC verification."""
+    return any(isinstance(sub, ast.Call)
+               and call_name(sub) == "compare_digest"
+               for sub in ast.walk(node))
+
+
+def _param_names(func, skip_self):
+    a = func.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if skip_self and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _map_call_args(call, target):
+    """{callee param name: caller arg expr} for one resolved call
+    (self offset applied for methods/constructors; *args stops the
+    positional map)."""
+    func = target.func
+    pos = list(func.args.posonlyargs) + list(func.args.args)
+    names = [p.arg for p in pos]
+    if target.cls is not None and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    out = {}
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(names):
+            out[names[i]] = arg
+    allowed = set(names) | {p.arg for p in func.args.kwonlyargs}
+    for kw in call.keywords:
+        if kw.arg and kw.arg in allowed:
+            out[kw.arg] = kw.value
+    return out
+
+
+def _merge_env(env, a, b):
+    for key in set(a) | set(b):
+        tags = a.get(key, frozenset()) | b.get(key, frozenset())
+        if tags:
+            env[key] = tags
+        else:
+            env.pop(key, None)
+
+
+class _TaintScan:
+    """One intraprocedural pass: statement-ordered taint tracking
+    with sink checks, guard/sanitizer kills, nested-def inlining and
+    per-call interprocedural hand-off facts."""
+
+    def __init__(self, eng, mod, cls, func, chain, summary_mode):
+        self.eng = eng
+        self.mod = mod
+        self.cls = cls
+        self.func = func
+        self.chain = chain
+        self.summary = summary_mode
+        self.ret_tags = set()
+        self.calls_out = []       # (call node, fact frozenset)
+        self.hmac_ok = False
+        self._nested = None       # lazy {name: FunctionDef}
+        self._nested_active = set()
+
+    # -- driving ---------------------------------------------------
+
+    def run(self, env, hmac_ok):
+        self.hmac_ok = hmac_ok
+        self._suite(self.func.body, env)
+
+    def _suite(self, stmts, env):
+        for stmt in stmts:
+            self._stmt(stmt, env)
+
+    def _stmt(self, stmt, env):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                     # inlined at call sites instead
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, env)
+            if _calls_compare_digest(stmt.test):
+                self.hmac_ok = True
+            for name in _guard_names(stmt.test):
+                env.pop(name, None)
+            body_env, else_env = dict(env), dict(env)
+            self._suite(stmt.body, body_env)
+            self._suite(stmt.orelse, else_env)
+            _merge_env(env, body_env, else_env)
+            return
+        if isinstance(stmt, (ast.While,)):
+            self._expr(stmt.test, env)
+            for name in _guard_names(stmt.test):
+                env.pop(name, None)
+            self._loop_body(stmt.body, env)
+            self._suite(stmt.orelse, env)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, env)
+            self._bind(stmt.target, self._taint_of(stmt.iter, env),
+                       env)
+            self._loop_body(stmt.body, env)
+            self._suite(stmt.orelse, env)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               self._taint_of(item.context_expr, env),
+                               env)
+            self._suite(stmt.body, env)
+            return
+        if isinstance(stmt, ast.Try):
+            self._suite(stmt.body, env)
+            for handler in stmt.handlers:
+                h_env = dict(env)
+                self._suite(handler.body, h_env)
+                _merge_env(env, env, h_env)
+            self._suite(stmt.orelse, env)
+            self._suite(stmt.finalbody, env)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value, env)
+            tags = self._taint_of(stmt.value, env)
+            for tgt in stmt.targets:
+                self._store(tgt, tags, env)
+            if _calls_compare_digest(stmt.value):
+                self.hmac_ok = True
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value, env)
+                self._store(stmt.target,
+                            self._taint_of(stmt.value, env), env)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value, env)
+            tags = self._taint_of(stmt.value, env)
+            if isinstance(stmt.target, ast.Name):
+                env[stmt.target.id] = \
+                    env.get(stmt.target.id, frozenset()) | tags
+            elif isinstance(stmt.target, ast.Subscript):
+                self._growth(stmt.target, env)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._expr(stmt.value, env)
+                if self.summary:
+                    self.ret_tags |= self._taint_of(stmt.value, env)
+            return
+        for kind, child in iter_stmt_children(stmt):
+            if kind == "expr":
+                self._expr(child, env)
+        if _calls_compare_digest(stmt):
+            self.hmac_ok = True
+
+    def _loop_body(self, body, env):
+        # two passes so loop-carried taint (buf += chunk) reaches
+        # uses textually above the assignment; sink dedup keeps the
+        # second pass from double-reporting
+        before = dict(env)
+        self._suite(body, env)
+        _merge_env(env, env, before)
+        self._suite(body, env)
+
+    def _bind(self, target, tags, env):
+        if isinstance(target, ast.Name):
+            if tags:
+                env[target.id] = tags
+            else:
+                env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, tags, env)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tags, env)
+
+    def _store(self, target, tags, env):
+        if isinstance(target, ast.Subscript):
+            self._growth(target, env)
+            return
+        self._bind(target, tags, env)
+
+    # -- expression taint ------------------------------------------
+
+    def _taint_of(self, expr, env):
+        if expr is None or isinstance(expr, (ast.Constant, ast.Lambda)):
+            return frozenset()
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, frozenset())
+        if isinstance(expr, ast.Attribute):
+            tags = set(self._taint_of(expr.value, env))
+            if expr.attr in _HTTP_ATTRS:
+                tags.add(TAINT_HTTP)
+            elif expr.attr in _HTTP_REQ_ATTRS \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id in _REQUESTISH:
+                tags.add(TAINT_HTTP)
+            elif expr.attr == "environ":
+                tags.add(TAINT_ENV)
+            return frozenset(tags)
+        if isinstance(expr, ast.Call):
+            return self._call_taint(expr, env)
+        if isinstance(expr, ast.Subscript):
+            # value chosen BY a tainted key out of a trusted bounded
+            # container is trusted; a tainted container's items are not
+            return self._taint_of(expr.value, env)
+        if isinstance(expr, ast.Compare):
+            return frozenset()         # a bool is bounded
+        if isinstance(expr, ast.IfExp):
+            guarded = _guard_names(expr.test)
+            inner = {k: v for k, v in env.items() if k not in guarded}
+            return self._taint_of(expr.body, inner) \
+                | self._taint_of(expr.orelse, inner)
+        if isinstance(expr, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            env2 = self._comp_env(expr, env)
+            if isinstance(expr, ast.DictComp):
+                return self._taint_of(expr.key, env2) \
+                    | self._taint_of(expr.value, env2)
+            return self._taint_of(expr.elt, env2)
+        out = set()
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                out |= self._taint_of(child, env)
+        return frozenset(out)
+
+    def _call_taint(self, call, env):
+        name = call_name(call) or ""
+        if _sanitizer_named(name):
+            return frozenset()
+        if name == "len":
+            # a length is proportional to bytes the transport already
+            # capped — not attacker amplification
+            return frozenset()
+        if name == "min" and len(call.args) >= 2:
+            arg_tags = [self._taint_of(a, env) for a in call.args]
+            if any(not t for t in arg_tags):
+                return frozenset()     # clamped by an untainted bound
+        if name == "getenv" or attr_chain(call.func) in (
+                "os.environ.get",):
+            return frozenset((TAINT_ENV,))
+        if name == "urlopen":
+            return frozenset((TAINT_HTTP,))
+        if name in _RECV_NAMES:
+            return frozenset((TAINT_WIRE,))
+        target = self.eng.graph.resolve(self.mod, self.cls, call)
+        if target is not None:
+            if _sanitizer_named(target.label) or _annotated_sanitizer(
+                    target.module, target.func):
+                return frozenset()
+            ret_kinds, ret_params = self.eng.summary_for(target.func)
+            tags = set(ret_kinds)
+            argmap = _map_call_args(call, target)
+            for pname in ret_params:
+                if pname in argmap:
+                    tags |= self._taint_of(argmap[pname], env)
+            return frozenset(tags)
+        if name == "get" and isinstance(call.func, ast.Attribute):
+            # bounded-lookup shape: dict.get(tainted_key) returns a
+            # value from the RECEIVER's universe
+            return self._taint_of(call.func.value, env)
+        out = set()
+        if isinstance(call.func, ast.Attribute):
+            out |= self._taint_of(call.func.value, env)
+        for arg in call.args:
+            out |= self._taint_of(arg, env)
+        for kw in call.keywords:
+            out |= self._taint_of(kw.value, env)
+        return frozenset(out)
+
+    def _comp_env(self, comp, env):
+        env2 = dict(env)
+        for gen in comp.generators:
+            self._bind(gen.target, self._taint_of(gen.iter, env2),
+                       env2)
+            for cond in gen.ifs:
+                for nm in _guard_names(cond):
+                    env2.pop(nm, None)
+        return env2
+
+    # -- sink + propagation walk -----------------------------------
+
+    def _expr(self, expr, env):
+        if expr is None or not isinstance(expr, ast.expr) \
+                or isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.Call):
+            self._check_call(expr, env)
+            self._expr(expr.func, env)
+            for arg in expr.args:
+                self._expr(arg, env)
+            for kw in expr.keywords:
+                self._expr(kw.value, env)
+            return
+        if isinstance(expr, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            env2 = self._comp_env(expr, env)
+            for gen in expr.generators:
+                self._expr(gen.iter, env)
+                for cond in gen.ifs:
+                    self._expr(cond, env2)
+            if isinstance(expr, ast.DictComp):
+                self._expr(expr.key, env2)
+                self._expr(expr.value, env2)
+            else:
+                self._expr(expr.elt, env2)
+            return
+        if isinstance(expr, ast.BinOp) \
+                and isinstance(expr.op, ast.Mult):
+            self._check_mult(expr, env)
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._expr(child, env)
+
+    def _check_mult(self, binop, env):
+        for side, other in ((binop.left, binop.right),
+                            (binop.right, binop.left)):
+            literal = isinstance(other, (ast.List, ast.Tuple)) or (
+                isinstance(other, ast.Constant)
+                and isinstance(other.value, (str, bytes)))
+            if not literal:
+                continue
+            kinds = self._taint_of(side, env) \
+                & frozenset((TAINT_WIRE, TAINT_HTTP))
+            if kinds:
+                self._hit(binop, "geometry", kinds,
+                          "sequence repetition count")
+
+    def _check_call(self, call, env):
+        name = call_name(call) or ""
+        target = self.eng.graph.resolve(self.mod, self.cls, call)
+        if _sanitizer_named(name) or (target is not None and (
+                _sanitizer_named(target.label)
+                or _annotated_sanitizer(target.module, target.func))):
+            # handing a tainted value TO a sanitizer is the
+            # sanctioned pattern, never a sink — and taint does not
+            # cross into it
+            return
+        wire_http = frozenset((TAINT_WIRE, TAINT_HTTP))
+        if name in _GEOMETRY_CALLS:
+            sized = list(call.args[:1]) + [
+                kw.value for kw in call.keywords
+                if kw.arg in _GEOMETRY_KWARGS]
+            if name == "range":
+                sized = list(call.args)
+            for arg in sized:
+                kinds = self._taint_of(arg, env) & wire_http
+                if kinds:
+                    self._hit(call, "geometry", kinds,
+                              "%s(...) extent" % name)
+                    break
+        chain = attr_chain(call.func) or ""
+        root = chain.split(".")[0] if chain else ""
+        if (name == "open" and isinstance(call.func, ast.Name)) or (
+                root in ("os", "shutil", "glob")
+                and name not in _PATH_SAFE):
+            for arg in call.args:
+                kinds = self._taint_of(arg, env) & wire_http
+                if kinds:
+                    self._hit(call, "path", kinds,
+                              "%s(...) filesystem argument"
+                              % (chain or name))
+                    break
+        for kw in call.keywords:
+            if kw.arg in _PATH_KEYWORDS:
+                kinds = self._taint_of(kw.value, env) & wire_http
+                if kinds:
+                    self._hit(call, "path", kinds,
+                              "%s=... store/filesystem target"
+                              % kw.arg)
+        if name in ("loads", "load") and root in ("pickle", "marshal") \
+                and not self.hmac_ok and call.args:
+            kinds = self._taint_of(call.args[0], env) \
+                & _CONCRETE_KINDS
+            if kinds:
+                self._hit(call, "deserialize", kinds,
+                          "%s.%s(...) of unverified input"
+                          % (root, name))
+        if name in ("setdefault", "add") \
+                and isinstance(call.func, ast.Attribute) \
+                and call.args:
+            kinds = self._taint_of(call.args[0], env) \
+                & _CONCRETE_KINDS
+            if kinds:
+                self._container_growth(call, call.func.value, kinds,
+                                       env)
+        self._propagate(call, env, target)
+
+    def _growth(self, subscript, env):
+        kinds = self._taint_of(subscript.slice, env) & _CONCRETE_KINDS
+        if kinds:
+            self._container_growth(subscript, subscript.value, kinds,
+                                   env)
+
+    def _container_growth(self, node, container, kinds, env):
+        """Persistent container keyed by a tainted value: self-attr
+        and module-global containers only — a function-local dict
+        dies with the call and cannot accumulate."""
+        project = self.eng.project
+        if isinstance(container, ast.Attribute) \
+                and isinstance(container.value, ast.Name) \
+                and container.value.id == "self" \
+                and self.cls is not None:
+            cname = project.class_attr_types(self.cls) \
+                .get(container.attr)
+            if cname and _bounded_container(self.mod, cname, project):
+                return
+            self._hit(node, "cardinality", kinds,
+                      "self.%s keyed by untrusted value"
+                      % container.attr)
+            return
+        if isinstance(container, ast.Name) \
+                and container.id in self.eng.module_globals(self.mod):
+            cname = self.mod.global_types.get(container.id)
+            if cname and _bounded_container(self.mod, cname, project):
+                return
+            self._hit(node, "cardinality", kinds,
+                      "module-global %s keyed by untrusted value"
+                      % container.id)
+
+    def _propagate(self, call, env, target):
+        if target is None:
+            fn = call.func
+            if isinstance(fn, ast.Name):
+                if self._nested is None:
+                    self._nested = nested_functions(self.func)
+                nested = self._nested.get(fn.id)
+                if nested is not None \
+                        and id(nested) not in self._nested_active:
+                    self._inline_nested(nested, call, env)
+            return
+        if self.summary:
+            return
+        argmap = _map_call_args(call, target)
+        fact = set()
+        for pname, argexpr in argmap.items():
+            for kind in self._taint_of(argexpr, env) \
+                    & _CONCRETE_KINDS:
+                fact.add("%s:%s" % (kind, pname))
+        if not fact:
+            return
+        if self.hmac_ok:
+            fact.add("<verified>")
+        self.calls_out.append((call, frozenset(fact)))
+
+    def _inline_nested(self, nested, call, env):
+        """Scan a closure defined in this function with the caller's
+        env — CallGraph cannot see nested defs, but loadgen-style
+        recursive allocators live there."""
+        env2 = dict(env)
+        names = _param_names(nested, skip_self=False)
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(names):
+                env2[names[i]] = self._taint_of(arg, env)
+        for kw in call.keywords:
+            if kw.arg:
+                env2[kw.arg] = self._taint_of(kw.value, env)
+        self._nested_active.add(id(nested))
+        try:
+            self._suite(nested.body, env2)
+        finally:
+            self._nested_active.discard(id(nested))
+
+    def _hit(self, node, sink, kinds, detail):
+        if self.summary:
+            return
+        self.eng.record(self.mod, node.lineno, sink, kinds,
+                        self.chain, detail)
+
+
+class _TaintFlow(ForwardDataflow):
+    """The interprocedural driver: every function seeds with an empty
+    fact (local source -> local sink), wire handlers seed with all
+    parameters wire-tainted; facts are frozensets of ``kind:param``
+    strings plus an optional ``<verified>`` HMAC marker."""
+
+    def __init__(self, eng):
+        ForwardDataflow.__init__(self, eng.project)
+        self.eng = eng
+        self.graph = eng.graph
+
+    def entries(self):
+        for mod, cls, func, label in self.eng.functions:
+            yield mod, cls, func, frozenset(), label
+            if func.name in WIRE_HANDLER_NAMES:
+                fact = frozenset(
+                    "%s:%s" % (TAINT_WIRE, p)
+                    for p in _param_names(func,
+                                          skip_self=cls is not None))
+                if fact:
+                    yield mod, cls, func, fact, label
+
+    def transfer(self, mod, cls, func, fact, chain):
+        env = {}
+        hmac_ok = False
+        for entry in fact:
+            if entry == "<verified>":
+                hmac_ok = True
+                continue
+            kind, _, pname = entry.partition(":")
+            env[pname] = env.get(pname, frozenset()) | {kind}
+        scan = _TaintScan(self.eng, mod, cls, func, chain,
+                          summary_mode=False)
+        scan.run(env, hmac_ok)
+        return scan.calls_out
+
+
+class TaintEngine:
+    """Whole-program taint analysis over a Project.
+
+    Sources: wire handler parameters and recv results, HTTP
+    headers/bodies/paths and fetched JSON, ``os.environ`` reads.
+    Sanitizers: ``*resolve*``/``*clamp*``/``*validate*``-named calls,
+    ``# zlint: sanitizer``-annotated defs, explicit comparison/
+    isinstance/membership guards, ``min()`` against an untainted
+    bound, and ``hmac.compare_digest`` domination (deserialize only).
+    Sinks: allocation geometry, persistent-container growth keyed by
+    tainted values, filesystem/store targets, unverified
+    ``pickle.loads``. Results are :class:`TaintHit` records the
+    ``rules_taint`` pack turns into findings."""
+
+    _SUMMARY_ROUNDS = 5
+
+    def __init__(self, project):
+        self.project = project
+        self.graph = CallGraph(project)
+        self.functions = list(self._iter_functions())
+        self.hits = []
+        self._hit_keys = set()
+        self._summaries = {}
+        self._globals = {}
+        self._classinfo = {id(cls.node): cls
+                           for mod in project.modules
+                           for cls in mod.classes.values()}
+
+    def _iter_functions(self):
+        for mod in self.project.modules:
+            for func in mod.functions.values():
+                yield mod, None, func, func.name
+            for cls in mod.classes.values():
+                for mname, meth in cls.methods.items():
+                    yield mod, cls, meth, "%s.%s" % (cls.name, mname)
+
+    def module_globals(self, mod):
+        names = self._globals.get(id(mod))
+        if names is None:
+            names = set(mod.global_types)
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+            self._globals[id(mod)] = names
+        return names
+
+    def summary_for(self, func):
+        return self._summaries.get(id(func),
+                                   (frozenset(), frozenset()))
+
+    def record(self, mod, lineno, sink, kinds, chain, detail):
+        key = (mod.relpath, lineno, sink)
+        if key in self._hit_keys:
+            return
+        self._hit_keys.add(key)
+        self.hits.append(TaintHit(mod, lineno, sink, kinds, chain,
+                                  detail))
+
+    def _compute_summaries(self):
+        """Per-function return summaries (source kinds + parameter
+        pass-through) to a cross-call fixpoint, so ``recv_frame``'s
+        result and a JSON-fetch helper's result taint their callers."""
+        for _ in range(self._SUMMARY_ROUNDS):
+            changed = False
+            for mod, cls, func, label in self.functions:
+                env = {}
+                for p in _param_names(func, skip_self=cls is not None):
+                    env[p] = frozenset(("param:%s" % p,))
+                scan = _TaintScan(self, mod, cls, func, (label,),
+                                  summary_mode=True)
+                scan.run(env, hmac_ok=False)
+                kinds = frozenset(t for t in scan.ret_tags
+                                  if not t.startswith("param:"))
+                params = frozenset(t[6:] for t in scan.ret_tags
+                                   if t.startswith("param:"))
+                new = (kinds & _CONCRETE_KINDS, params)
+                if self._summaries.get(id(func)) != new:
+                    self._summaries[id(func)] = new
+                    changed = True
+            if not changed:
+                break
+
+    def run(self):
+        self._compute_summaries()
+        _TaintFlow(self).run()
+        self.hits.sort(key=lambda h: (h.module.relpath, h.lineno,
+                                      h.sink))
+        return self.hits
+
+
+def taint_hits(project):
+    """Memoized whole-program taint pass — the four taint rules share
+    one engine run exactly like the reactor rules share
+    :func:`reactor_callbacks`."""
+    cached = getattr(project, "_taint_hits_cache", None)
+    if cached is None:
+        cached = TaintEngine(project).run()
+        project._taint_hits_cache = cached
+    return cached
+
+
 # -- graph utilities ----------------------------------------------------
 
 

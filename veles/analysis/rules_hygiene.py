@@ -30,7 +30,8 @@ _NOQA_RE = re.compile(r"#\s*noqa\b", re.IGNORECASE)
 
 
 @register("bare-except", "error",
-          "except: swallows KeyboardInterrupt/SystemExit")
+          "except: swallows KeyboardInterrupt/SystemExit",
+          scope="module")
 def check_bare_except(project):
     findings = []
     for mod in project.modules:
@@ -50,7 +51,8 @@ _DYNAMIC_SCOPE = ("locals", "vars", "eval", "exec")
 
 
 @register("unused-variable", "warning",
-          "locals assigned by simple statements and never read")
+          "locals assigned by simple statements and never read",
+          scope="module")
 def check_unused_variable(project):
     findings = []
     for mod in project.modules:
@@ -107,7 +109,7 @@ def check_unused_variable(project):
 
 
 @register("unused-import", "warning",
-          "dead module-level imports")
+          "dead module-level imports", scope="module")
 def check_unused_import(project):
     findings = []
     for mod in project.modules:

@@ -85,7 +85,8 @@ def _consults_gate(fn):
 
 @register("stats-cadence", "error",
           "in-graph model-stat outputs materialize on the host only "
-          "behind the cadence gate (stats_due), never per step")
+          "behind the cadence gate (stats_due), never per step",
+          scope="module")
 def check_stats_cadence(project):
     findings = []
     for mod in project.modules:

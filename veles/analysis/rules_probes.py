@@ -70,7 +70,8 @@ def _scan_branch(mod, body, findings):
 
 @register("probe-purity", "error",
           "/healthz and /readyz handler branches read cached state "
-          "only — no locks, no network, no live state pulls")
+          "only — no locks, no network, no live state pulls",
+          scope="module")
 def check_probe_purity(project):
     findings = []
     for mod in project.modules:

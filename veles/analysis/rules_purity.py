@@ -208,7 +208,8 @@ def _scan_traced(mod, cls_name, func, findings, seen_funcs,
 
 @register("tracer-purity", "error",
           "jit-traced step closures must not do host I/O, host "
-          "randomness, tracer concretization or self mutation")
+          "randomness, tracer concretization or self mutation",
+          scope="module")
 def check_tracer_purity(project):
     findings = []
     # ONE project-wide seen set: a shared helper (conv_math etc.) is

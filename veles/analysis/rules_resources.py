@@ -376,7 +376,7 @@ def _scan_function(mod, func, prefixes, findings):
 @register("resource-leak", "error",
           "acquired resources (sockets, registries, KV slots, "
           "background servers) must be released on every path, "
-          "exception edges included")
+          "exception edges included", scope="module")
 def check_resource_leak(project):
     findings = []
     for mod in project.modules:

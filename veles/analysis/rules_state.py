@@ -64,7 +64,8 @@ def _run_mutations(project, graph, cls):
 
 @register("checkpoint-state", "error",
           "Unit subclasses whose run() mutates instance state must "
-          "implement get_state/checkpoint_state")
+          "implement get_state/checkpoint_state",
+          scope="module")
 def check_checkpoint_state(project):
     findings = []
     graph = engine.CallGraph(project)

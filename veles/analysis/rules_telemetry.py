@@ -205,7 +205,7 @@ def _formatted_identity(node):
 
 @register("telemetry-hygiene", "error",
           "no instrument creation in loops; no unbounded identity "
-          "label values or span names")
+          "label values or span names", scope="module")
 def check_telemetry_hygiene(project):
     findings = []
     for mod in project.modules:

@@ -268,7 +268,7 @@ def _thread_target_names(methods):
 
 @register("unguarded-shared-state", "error",
           "instance attributes written both from a Thread target and "
-          "from unlocked public methods")
+          "from unlocked public methods", scope="module")
 def check_unguarded_shared_state(project):
     findings = []
     seen = set()       # (file, line, attr): base races re-surface
@@ -399,7 +399,8 @@ def _daemonized_names(mod):
 
 
 @register("thread-lifecycle", "error",
-          "started threads must be daemons or have a join path")
+          "started threads must be daemons or have a join path",
+          scope="module")
 def check_thread_lifecycle(project):
     findings = []
     for mod in project.modules:

@@ -143,6 +143,38 @@ def _one_request(url, body, tenant, timeout, stats):
     stats.record(code, time.perf_counter() - t0)
 
 
+#: ceiling on the zero-sample the target's advertised geometry may
+#: make us build — the /v1/models listing is the TARGET's data, and a
+#: malicious or buggy target advertising [1 << 30] must not OOM the
+#: load generator (zlint untrusted-geometry)
+_MAX_SAMPLE_ELEMENTS = 1 << 20
+_MAX_SAMPLE_RANK = 8
+
+
+def _validated_shape(shape):
+    """Bound target-advertised ``input_sample_shape`` before any
+    allocation keys off it; -> a list of positive ints, or
+    SystemExit naming the refused geometry."""
+    dims = []
+    total = 1
+    for dim in list(shape)[:_MAX_SAMPLE_RANK]:
+        try:
+            dim = int(dim)
+        except (TypeError, ValueError):
+            raise SystemExit(
+                "target advertises a non-numeric input_sample_shape "
+                "entry %r" % (dim,))
+        dims.append(max(dim, 1))
+        total *= max(dim, 1)
+    if len(list(shape)) > _MAX_SAMPLE_RANK \
+            or total > _MAX_SAMPLE_ELEMENTS:
+        raise SystemExit(
+            "target advertises input_sample_shape %r (%d elements) — "
+            "refusing to build a sample beyond %d elements"
+            % (list(shape), total, _MAX_SAMPLE_ELEMENTS))
+    return dims or [1]
+
+
 def _predict_body(base, model_arg, timeout=10.0):
     """(model name, canned /v1/predict body, generative?) derived
     from the target's ``/v1/models`` listing — a zero-valued sample
@@ -162,7 +194,7 @@ def _predict_body(base, model_arg, timeout=10.0):
     else:
         m = models[0]
     name = m["name"]
-    shape = m.get("input_sample_shape") or [1]
+    shape = _validated_shape(m.get("input_sample_shape") or [1])
 
     def zeros(dims):
         if not dims:
@@ -170,7 +202,7 @@ def _predict_body(base, model_arg, timeout=10.0):
         return [zeros(dims[1:]) for _ in range(int(dims[0]))]
 
     body = json.dumps({"model": name,
-                       "inputs": [zeros(list(shape))]}).encode()
+                       "inputs": [zeros(shape)]}).encode()
     return name, body, bool(m.get("generative"))
 
 

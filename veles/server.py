@@ -128,6 +128,20 @@ _WIRE_RX = telemetry.LazyChild(lambda: telemetry.counter(
     "Bytes moved over the framed master/slave protocol by direction "
     "(payload + length header + auth tag)", ("direction",)).labels("rx"))
 
+#: the request kinds the master dispatches on — also the bounded
+#: universe of the per-kind request-counter label
+_REQUEST_KINDS = frozenset(("hello", "ping", "job", "update"))
+
+
+def _resolve_request_kind(kind):
+    """Bounded resolver for the wire-supplied request kind: the frame
+    chooses the kind string, but the per-kind counter cache and its
+    Prometheus label set must not be the wire's to grow (zlint
+    unbounded-cardinality — the TenantTable.resolve convention:
+    unknown values fold into one ``other`` bucket)."""
+    kind = str(kind)
+    return kind if kind in _REQUEST_KINDS else "other"
+
 
 #: first payload byte of the buffer-carrying frame format below; a
 #: plain pickle starts with b"\x80" (the PROTO opcode), so the two
@@ -1049,7 +1063,7 @@ class MasterServer(Logger):
 
     def handle(self, request):
         kind = request[0]
-        kind_key = str(kind)
+        kind_key = _resolve_request_kind(kind)
         req_counter = self._req_counters.get(kind_key)
         if req_counter is None:
             # per-kind LazyChild cache: idle slaves poll here every

@@ -28,6 +28,7 @@ shape (served on ``/metrics.json``; the Prometheus scrape is
 """
 
 import collections
+import math
 import threading
 import time
 
@@ -42,6 +43,26 @@ class QueueFull(Exception):
 
 class DeadlineExceeded(Exception):
     """The request expired before a batch slot reached it."""
+
+
+def timeout_seconds(timeout_ms, default_s):
+    """Admit a client-supplied ``timeout_ms`` -> seconds. JSON can
+    carry bare ``NaN``/``Infinity`` (Python's parser accepts them) and
+    either would mint a deadline that never compares expired — the
+    request then pins its queue slot forever while live traffic gets
+    shed. Raises :class:`ValueError` (-> HTTP 400) for anything but a
+    finite non-negative number."""
+    if timeout_ms is None:
+        return default_s
+    try:
+        t = float(timeout_ms)
+    except (TypeError, ValueError):
+        raise ValueError("timeout_ms must be a number, got %r"
+                         % (timeout_ms,))
+    if not math.isfinite(t) or t < 0:
+        raise ValueError("timeout_ms must be finite and >= 0, got %r"
+                         % (timeout_ms,))
+    return t / 1000.0
 
 
 class _Request:
@@ -150,8 +171,7 @@ class MicroBatcher(Logger):
         if n < 1 or n > self.max_batch:
             raise ValueError("request rows %d outside [1, %d]"
                              % (n, self.max_batch))
-        timeout = (self.default_timeout if timeout_ms is None
-                   else float(timeout_ms) / 1000.0)
+        timeout = timeout_seconds(timeout_ms, self.default_timeout)
         req = _Request(rows, time.monotonic() + timeout, trace=trace,
                        tenant=tenant)
         with self._lock:

@@ -59,7 +59,8 @@ import numpy
 from veles import telemetry
 from veles.logger import Logger
 from veles.serving import tenants
-from veles.serving.batcher import DeadlineExceeded, QueueFull
+from veles.serving.batcher import (DeadlineExceeded, QueueFull,
+                                   timeout_seconds)
 from veles.serving.model import FORWARD_OPS
 
 #: decoded-token attribution by resolved tenant (ISSUE 18; bounded —
@@ -699,8 +700,13 @@ class ContinuousBatcher(Logger):
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must have at least one token")
-        max_tokens = (DEFAULT_MAX_TOKENS if max_tokens is None
-                      else int(max_tokens))
+        try:
+            max_tokens = (DEFAULT_MAX_TOKENS if max_tokens is None
+                          else int(max_tokens))
+        except OverflowError:
+            # int(float('inf')): keep the client-fixable 400 contract
+            raise ValueError("max_tokens must be a finite integer, "
+                             "got %r" % (max_tokens,))
         if max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
         if len(prompt) + max_tokens > self.engine.max_len:
@@ -708,8 +714,7 @@ class ContinuousBatcher(Logger):
                 "prompt %d + max_tokens %d exceeds the KV slot "
                 "length %d" % (len(prompt), max_tokens,
                                self.engine.max_len))
-        timeout = (self.default_timeout if timeout_ms is None
-                   else float(timeout_ms) / 1000.0)
+        timeout = timeout_seconds(timeout_ms, self.default_timeout)
         req = GenRequest(prompt, max_tokens, float(temperature),
                          None if eos is None else int(eos),
                          time.monotonic() + timeout, trace=trace,

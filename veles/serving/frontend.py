@@ -239,16 +239,26 @@ class ServingFrontend(Logger):
         except KeyError:
             request.reply_json(404, {"error": "no model %r" % name})
             return
-        checkpoint = doc.get("checkpoint")
+        # the body names filesystem/store targets: admit only paths
+        # inside the stores this entry was configured with server-side
+        # (zlint untrusted-path) — the HTTP plane must not get to
+        # point the registry at arbitrary directories
+        try:
+            checkpoint, store = self.registry.resolve_refresh_target(
+                entry, checkpoint=doc.get("checkpoint"),
+                store=doc.get("store"))
+        except ValueError as exc:
+            request.reply_json(400, {"error": str(exc)})
+            return
         try:
             if checkpoint:
                 entry = self.registry.load(
                     name, entry.source, checkpoint=checkpoint,
-                    refresh_store=doc.get("store"))
+                    refresh_store=store)
                 loaded = checkpoint
             else:
                 loaded = self.registry.refresh_newest(
-                    name, store_target=doc.get("store"))
+                    name, store_target=store)
                 entry = self.registry.get(name)
         except (ValueError, OSError) as exc:
             request.reply_json(409, {"error": str(exc)})

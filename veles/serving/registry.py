@@ -414,6 +414,58 @@ class ModelRegistry(Logger):
             "veles_serving_model_version",
             "Currently served model version", ("model",)).labels(name)
 
+    # -- refresh-target admission --------------------------------------
+
+    @staticmethod
+    def _within_store(root, target):
+        """True when ``target`` stays inside ``root`` (URL-prefix for
+        http stores, normpath-prefix for directories — ``..`` hops
+        are normalized away before the check)."""
+        if root.startswith(("http://", "https://")):
+            root = root.rstrip("/")
+            return target == root or target.startswith(root + "/")
+        root_abs = os.path.normpath(os.path.abspath(root))
+        t_abs = os.path.normpath(os.path.abspath(target))
+        return t_abs == root_abs or t_abs.startswith(root_abs + os.sep)
+
+    def resolve_refresh_target(self, entry, checkpoint=None,
+                               store=None):
+        """Admission bound for client-supplied refresh targets (zlint
+        ``untrusted-path``): ``POST /refresh`` bodies cross the HTTP
+        trust boundary, so a path they name must stay within a store
+        this entry was CONFIGURED with server-side — its
+        ``refresh_store``, the directory of its loaded checkpoint, or
+        its artifact source. -> ``(checkpoint, store)`` admitted
+        values (None where absent); raises ValueError (-> 400) for
+        anything outside those roots."""
+        roots = []
+        if entry.refresh_store:
+            roots.append(str(entry.refresh_store))
+        if entry.checkpoint:
+            ckpt = str(entry.checkpoint)
+            roots.append(ckpt.rsplit("/", 1)[0]
+                         if ckpt.startswith(("http://", "https://"))
+                         else (os.path.dirname(ckpt) or "."))
+        if entry.source:
+            roots.append(str(entry.source))
+        admitted = []
+        for target in (checkpoint, store):
+            if target is None or target == "":
+                admitted.append(None)
+                continue
+            if not isinstance(target, str):
+                raise ValueError("refresh target must be a string "
+                                 "path, got %s"
+                                 % type(target).__name__)
+            if not any(self._within_store(root, target)
+                       for root in roots):
+                raise ValueError(
+                    "refresh target %r is outside the model's "
+                    "configured stores — load the entry with "
+                    "refresh_store= to allow a new location" % target)
+            admitted.append(target)
+        return tuple(admitted)
+
     # -- lookup --------------------------------------------------------
 
     def get(self, name):

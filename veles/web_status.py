@@ -55,6 +55,11 @@ import threading
 from veles import health, model_health, reactor, telemetry
 from veles.logger import Logger
 
+#: admission bound for ``POST /update``: distinct status names one
+#: dashboard will hold (each novel name is a dict kept forever, and
+#: the name is the POSTER's choice) — beyond this, novel names get 413
+_MAX_PUSHED = 256
+
 _PAGE = """<!DOCTYPE html>
 <html><head><title>veles status</title>
 <meta http-equiv="refresh" content="5">
@@ -110,6 +115,14 @@ class WebStatus(Logger):
                 request.reply(400, b"bad status json")
                 return
             with self._lock:
+                # the poster chooses the name: cap the distinct-name
+                # universe or any client can grow this dict forever
+                # (zlint unbounded-cardinality)
+                if name not in self._pushed \
+                        and len(self._pushed) >= _MAX_PUSHED:
+                    request.reply(413, b"too many distinct status "
+                                  b"names")
+                    return
                 self._pushed[name] = doc
             request.reply(200, b"ok")
             return
